@@ -1,0 +1,19 @@
+"""estorch_tpu — a TPU-native Evolution Strategies framework.
+
+Re-designs the capabilities of the reference library (goktug97/estorch — ES,
+NS-ES, NSR-ES, NSRA-ES, VirtualBatchNorm, distributed population evaluation)
+for TPU hardware: one compiled XLA program per generation, shared-noise-table
+perturbations vmapped over the population in HBM, and a single ``lax.psum``
+over the device mesh in place of MPI gather + master broadcast.
+
+Public API mirrors the reference (SURVEY.md Appendix A); the algorithm
+classes are re-exported here as they land:
+
+    from estorch_tpu import ES, NS_ES, NSR_ES, NSRA_ES, VirtualBatchNorm
+"""
+
+__version__ = "0.1.0"
+
+from . import ops  # noqa: F401
+
+__all__ = ["ops", "__version__"]

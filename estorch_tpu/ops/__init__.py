@@ -1,0 +1,31 @@
+from .noise import (
+    DEFAULT_TABLE_SIZE,
+    NoiseTable,
+    make_noise_table,
+    member_noise,
+    member_offsets,
+    pair_signs,
+    sample_pair_offsets,
+)
+from .params import ParamSpec, count_params, make_param_spec
+from .ranks import centered_rank, compute_ranks, normalized_score
+from .gradient import es_gradient, fold_mirrored_weights, rank_weighted_noise_sum
+
+__all__ = [
+    "DEFAULT_TABLE_SIZE",
+    "NoiseTable",
+    "make_noise_table",
+    "member_noise",
+    "member_offsets",
+    "pair_signs",
+    "sample_pair_offsets",
+    "ParamSpec",
+    "count_params",
+    "make_param_spec",
+    "centered_rank",
+    "compute_ranks",
+    "normalized_score",
+    "es_gradient",
+    "fold_mirrored_weights",
+    "rank_weighted_noise_sum",
+]

@@ -1,0 +1,100 @@
+"""ES gradient estimator: rank-weighted noise reduction.
+
+Reference math (``estorch/estorch.py`` — SURVEY.md §2 item 1; Salimans et al.
+2017 eq. 1): given fitness-shaped weights w_i for perturbations ε_i,
+
+    ∇̂_θ E[f] = (1 / (n·σ)) Σ_i w_i · ε_i
+
+The reference materializes every ε_i and loops in Python on the master after
+an MPI gather.  TPU-native design: each device regenerates its own members'
+ε_i from the shared noise table (ops/noise.py) and accumulates a LOCAL
+partial sum as a single (chunk, dim) matvec — an MXU-friendly contraction —
+then one ``lax.psum`` over the population mesh axis produces the global sum
+on every device simultaneously.  No gather, no broadcast.
+
+Mirrored sampling is folded: members 2k (+ε_k) and 2k+1 (−ε_k) share table
+row k, so  Σ_i w_i·s_i·ε_i = Σ_k (w_{2k} − w_{2k+1})·ε_k  — half the table
+gathers and half the contraction size of a naive per-member reduction.
+
+Memory: the (chunk, dim) noise block is re-sliced from the table per chunk
+(scan), so peak memory is O(chunk·dim), not O(population·dim).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from .noise import NoiseTable
+
+
+@partial(jax.jit, static_argnames=("dim", "chunk"))
+def rank_weighted_noise_sum(
+    table: NoiseTable,
+    offsets: jax.Array,  # (n,) int32 table offsets (one per noise row)
+    weights: jax.Array,  # (n,) float32 weight per noise row
+    dim: int,
+    chunk: int = 256,
+) -> jax.Array:
+    """Σ_i weights_i · ε_i without materializing all n noise rows.
+
+    Scans over ⌈n/chunk⌉ blocks; within a block, a vmap of dynamic slices
+    builds (chunk, dim) and a single matvec contracts it.  Any ``n`` works:
+    non-multiples of ``chunk`` are zero-padded internally (zero-weight rows
+    contribute nothing, so the padding offsets just re-read row 0).
+    """
+    n = offsets.shape[0]
+    if n <= chunk:
+        rows = jax.vmap(lambda o: table.slice(o, dim))(offsets)
+        return weights @ rows
+
+    if n % chunk != 0:
+        pad = chunk - n % chunk
+        offsets = jnp.concatenate([offsets, jnp.zeros((pad,), offsets.dtype)])
+        weights = jnp.concatenate([weights, jnp.zeros((pad,), weights.dtype)])
+        n = n + pad
+
+    offsets = offsets.reshape(-1, chunk)
+    weights = weights.reshape(-1, chunk)
+
+    def body(acc, ow):
+        o, w = ow
+        rows = jax.vmap(lambda off: table.slice(off, dim))(o)  # (chunk, dim)
+        return acc + w @ rows, None
+
+    acc0 = jnp.zeros((dim,), dtype=table.data.dtype)
+    acc, _ = jax.lax.scan(body, acc0, (offsets, weights))
+    return acc
+
+
+def fold_mirrored_weights(rank_weights: jax.Array) -> jax.Array:
+    """Per-pair weights (w_{2k} − w_{2k+1}) from per-member rank weights.
+
+    Valid for the mirrored layout where member 2k uses +ε_k and member 2k+1
+    uses −ε_k (ops/noise.py pair_signs / member_offsets).
+    """
+    return rank_weights[0::2] - rank_weights[1::2]
+
+
+def es_gradient(
+    table: NoiseTable,
+    pair_offsets: jax.Array,  # (n_pairs,) int32 — ONE offset per antithetic pair
+    rank_weights: jax.Array,  # (2*n_pairs,) float32 per-member weights
+    sigma: float,
+    population_size: int,
+    dim: int,
+    chunk: int = 256,
+) -> jax.Array:
+    """Ascent direction ∇̂ = (1/(n·σ)) Σ_i w_i·s_i·ε_i (NEGATE for optax descent).
+
+    Takes per-PAIR offsets and per-MEMBER weights in the mirrored layout and
+    folds the antithetic signs into per-pair weights, so only ``n_pairs``
+    noise rows are gathered.  ``pair_offsets``/``rank_weights`` may be the
+    local shard only — the caller psums the result over the population axis,
+    and ``population_size`` is the GLOBAL population for correct scaling.
+    """
+    pw = fold_mirrored_weights(rank_weights)
+    total = rank_weighted_noise_sum(table, pair_offsets, pw, dim=dim, chunk=chunk)
+    return total / (population_size * sigma)

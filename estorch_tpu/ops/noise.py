@@ -1,0 +1,119 @@
+"""Shared noise table and antithetic (mirrored) perturbation sampling.
+
+TPU-native replacement for the reference's per-member ``torch.randn_like``
+noise draw (reference: ``estorch/estorch.py``, upstream path — see SURVEY.md
+§2 item 8; the mount was empty, so no line numbers).  Instead of generating
+fresh Gaussian noise per population member — and shipping it (or its seed)
+between processes — we keep ONE immutable float32 table in HBM and address
+it with per-member integer offsets.  This is the OpenAI-ES shared-noise-table
+design and the `north_star` of BASELINE.json:
+
+- noise never crosses the wire: every device derives identical offsets from a
+  shared PRNG key, so the update is reconstructed locally and reduced with a
+  single ``lax.psum``;
+- perturbation is a ``vmap``-ed dynamic-slice + axpy — a contiguous HBM read
+  that XLA fuses into the policy matmuls, instead of Python-loop RNG;
+- antithetic pairs (mirrored sampling, Salimans et al. 2017 §2) share an
+  offset with flipped sign, halving table reads and variance.
+
+All functions are pure and jit/shard_map compatible.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+DEFAULT_TABLE_SIZE = 1 << 25  # 32M floats = 128 MiB of HBM; OpenAI-ES used 250M.
+
+
+@dataclasses.dataclass(frozen=True)
+class NoiseTable:
+    """An immutable shared Gaussian noise table.
+
+    ``data`` lives in HBM (or host RAM under the CPU backend).  ``size`` is
+    static so slice shapes stay known to XLA.
+    """
+
+    data: jax.Array  # (size,) float32, ~N(0, 1)
+    seed: int
+    size: int
+
+    def slice(self, offset: jax.Array, dim: int) -> jax.Array:
+        """Noise vector of length ``dim`` starting at ``offset`` (traced ok)."""
+        return jax.lax.dynamic_slice(self.data, (offset,), (dim,))
+
+
+def _tree_flatten(t: NoiseTable):
+    return (t.data,), (t.seed, t.size)
+
+
+def _tree_unflatten(aux, children):
+    (data,) = children
+    seed, size = aux
+    return NoiseTable(data=data, seed=seed, size=size)
+
+
+jax.tree_util.register_pytree_node(NoiseTable, _tree_flatten, _tree_unflatten)
+
+
+def make_noise_table(
+    size: int = DEFAULT_TABLE_SIZE, seed: int = 0, dtype=jnp.float32
+) -> NoiseTable:
+    """Build the shared table once, deterministically from ``seed``.
+
+    Every host/device that calls this with the same ``(size, seed)`` holds a
+    bit-identical table — the precondition for broadcast-free updates.
+    Generated in one XLA call (threefry is counter-based, so this is
+    reproducible across backends).
+    """
+    key = jax.random.key(seed)
+    data = jax.random.normal(key, (size,), dtype=dtype)
+    return NoiseTable(data=data, seed=seed, size=size)
+
+
+def sample_pair_offsets(
+    key: jax.Array, n_pairs: int, table_size: int, dim: int
+) -> jax.Array:
+    """Uniform offsets for ``n_pairs`` antithetic pairs, each in [0, size-dim].
+
+    Deterministic in ``key``: all devices compute the identical offset vector
+    and slice their own shard — this replaces the reference's parameter
+    broadcast entirely (BASELINE.json north_star).
+    """
+    if dim > table_size:
+        raise ValueError(
+            f"parameter dim {dim} exceeds noise table size {table_size}; "
+            "grow the table (noise_table_size) to at least a few times dim"
+        )
+    return jax.random.randint(key, (n_pairs,), 0, table_size - dim + 1, dtype=jnp.int32)
+
+
+def pair_signs(population_size: int) -> jax.Array:
+    """Signs (+1, -1, +1, -1, ...) for mirrored sampling.
+
+    Member ``2k`` evaluates ``θ + σ·ε_k``; member ``2k+1`` evaluates
+    ``θ - σ·ε_k``.  ``population_size`` must be even.
+    """
+    if population_size % 2 != 0:
+        raise ValueError(f"mirrored sampling needs an even population, got {population_size}")
+    return jnp.where(jnp.arange(population_size) % 2 == 0, 1.0, -1.0).astype(jnp.float32)
+
+
+def member_offsets(pair_offsets: jax.Array) -> jax.Array:
+    """Expand per-pair offsets to per-member offsets: (n_pairs,) → (2*n_pairs,)."""
+    return jnp.repeat(pair_offsets, 2)
+
+
+@partial(jax.jit, static_argnames=("dim",))
+def member_noise(table: NoiseTable, offsets: jax.Array, signs: jax.Array, dim: int) -> jax.Array:
+    """Materialize signed noise rows for a batch of members: (n, dim).
+
+    Only used for small batches (tests, chunked gradient accumulation);
+    the engine never materializes the full population's noise at once.
+    """
+    rows = jax.vmap(lambda o: table.slice(o, dim))(offsets)
+    return rows * signs[:, None]

@@ -1,0 +1,41 @@
+"""Parameter pytree <-> flat vector utilities.
+
+The reference flattens ``policy.parameters()`` into a single vector to add
+noise and to apply the estimated gradient (reference: ``estorch/estorch.py``
+flatten/unflatten helpers — SURVEY.md §2 item 8).  In JAX the policy params
+are a pytree; we use ``jax.flatten_util.ravel_pytree`` once at setup to get a
+static ``unravel`` closure, then all hot-path math runs on the flat vector —
+which is exactly the layout the noise-table slice and the rank-weighted
+reduction want.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+
+
+@dataclasses.dataclass(frozen=True)
+class ParamSpec:
+    """Static description of a policy's parameter pytree."""
+
+    dim: int
+    unravel: Callable[[jax.Array], Any]
+
+    def flatten(self, tree: Any) -> jax.Array:
+        flat, _ = ravel_pytree(tree)
+        return flat
+
+
+def make_param_spec(params: Any) -> tuple[jax.Array, ParamSpec]:
+    """Flatten ``params`` once; return the flat vector and its static spec."""
+    flat, unravel = ravel_pytree(params)
+    return flat, ParamSpec(dim=int(flat.shape[0]), unravel=unravel)
+
+
+def count_params(params: Any) -> int:
+    return sum(int(jnp.size(p)) for p in jax.tree_util.tree_leaves(params))

@@ -1,0 +1,48 @@
+"""Centered rank transformation (fitness shaping).
+
+Reference behavior: estorch's rank transform maps raw episode returns to
+centered ranks in [-0.5, 0.5] before the gradient estimate, making the update
+invariant to reward scale/outliers (reference: ``estorch/estorch.py`` rank
+helpers, upstream path — SURVEY.md §2 item 2; Salimans et al. 2017 §2.1).
+
+TPU-native notes: computed on-device with a double argsort so the whole
+generation stays one compiled program.  Every device ranks the SAME globally
+all-gathered fitness vector, so the resulting weights are bit-identical
+everywhere — a precondition for the broadcast-free update.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def compute_ranks(x: jax.Array) -> jax.Array:
+    """Integer ranks in [0, n): rank of the smallest element is 0.
+
+    Ties broken by position (stable argsort), matching ``np.argsort`` — the
+    same tie behavior a NumPy implementation of the reference has.
+    """
+    n = x.shape[0]
+    order = jnp.argsort(x)
+    ranks = jnp.zeros((n,), dtype=jnp.int32).at[order].set(jnp.arange(n, dtype=jnp.int32))
+    return ranks
+
+
+def centered_rank(x: jax.Array) -> jax.Array:
+    """Map fitness to centered ranks in [-0.5, 0.5].
+
+    ``centered_rank(x)_i = rank(x_i)/(n-1) - 0.5``; the result sums to zero,
+    so the ES update is invariant to adding a constant to all returns.
+    """
+    n = x.shape[0]
+    if n < 2:
+        return jnp.zeros_like(x, dtype=jnp.float32)
+    ranks = compute_ranks(x).astype(jnp.float32)
+    return ranks / (n - 1) - 0.5
+
+
+def normalized_score(x: jax.Array) -> jax.Array:
+    """Z-score alternative to rank shaping (exposed for parity/testing)."""
+    std = jnp.std(x)
+    return (x - jnp.mean(x)) / jnp.where(std > 0, std, 1.0)

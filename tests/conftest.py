@@ -1,0 +1,24 @@
+"""Test harness configuration.
+
+Forces the CPU backend with 8 virtual devices BEFORE any jax computation, so
+the multi-device sharding tests run without TPU hardware — the standard JAX
+"multi-node tests without a cluster" pattern (SURVEY.md §4).
+
+Note: this image's sitecustomize pins ``JAX_PLATFORMS=axon`` (the TPU tunnel),
+so env vars are not enough — we override via jax.config, which works because
+pytest imports this conftest before any test module touches a device.
+"""
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {devs}"
+    return devs

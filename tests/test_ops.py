@@ -1,0 +1,170 @@
+"""Unit tests for the core ES math ops (SURVEY.md §4 'Unit' bullet)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from estorch_tpu.ops import (
+    NoiseTable,
+    centered_rank,
+    compute_ranks,
+    es_gradient,
+    fold_mirrored_weights,
+    make_noise_table,
+    make_param_spec,
+    member_noise,
+    member_offsets,
+    pair_signs,
+    rank_weighted_noise_sum,
+    sample_pair_offsets,
+)
+
+
+class TestRanks:
+    def test_known_permutation(self):
+        x = jnp.array([3.0, 1.0, 2.0])
+        assert compute_ranks(x).tolist() == [2, 0, 1]
+        cr = centered_rank(x)
+        np.testing.assert_allclose(np.asarray(cr), [0.5, -0.5, 0.0], atol=1e-7)
+
+    def test_centered_rank_sums_to_zero(self):
+        x = jax.random.normal(jax.random.key(0), (101,))
+        assert abs(float(centered_rank(x).sum())) < 1e-5
+
+    def test_scale_invariance(self):
+        x = jax.random.normal(jax.random.key(1), (64,))
+        a = centered_rank(x)
+        b = centered_rank(1000.0 * x + 5.0)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_matches_numpy_oracle(self):
+        x = np.random.RandomState(7).randn(257).astype(np.float32)
+        ranks_np = np.empty(len(x), dtype=np.int32)
+        ranks_np[np.argsort(x)] = np.arange(len(x))
+        expected = ranks_np.astype(np.float32) / (len(x) - 1) - 0.5
+        np.testing.assert_allclose(np.asarray(centered_rank(jnp.array(x))), expected, atol=1e-7)
+
+    def test_degenerate_sizes(self):
+        assert centered_rank(jnp.array([5.0])).tolist() == [0.0]
+
+
+class TestNoiseTable:
+    def test_determinism_same_seed(self):
+        t1 = make_noise_table(4096, seed=3)
+        t2 = make_noise_table(4096, seed=3)
+        np.testing.assert_array_equal(np.asarray(t1.data), np.asarray(t2.data))
+
+    def test_different_seed_differs(self):
+        t1 = make_noise_table(1024, seed=0)
+        t2 = make_noise_table(1024, seed=1)
+        assert not np.array_equal(np.asarray(t1.data), np.asarray(t2.data))
+
+    def test_slice_matches_direct_index(self):
+        t = make_noise_table(1000, seed=0)
+        sl = t.slice(jnp.int32(17), 5)
+        np.testing.assert_array_equal(np.asarray(sl), np.asarray(t.data[17:22]))
+
+    def test_offsets_in_bounds(self):
+        key = jax.random.key(0)
+        offs = sample_pair_offsets(key, 1000, table_size=5000, dim=300)
+        assert int(offs.min()) >= 0
+        assert int(offs.max()) <= 5000 - 300
+
+    def test_offsets_reject_oversized_dim(self):
+        with pytest.raises(ValueError):
+            sample_pair_offsets(jax.random.key(0), 4, table_size=10, dim=11)
+
+    def test_antithetic_signs(self):
+        s = pair_signs(6)
+        assert s.tolist() == [1.0, -1.0, 1.0, -1.0, 1.0, -1.0]
+        with pytest.raises(ValueError):
+            pair_signs(5)
+
+    def test_member_offsets_repeat_pairs(self):
+        m = member_offsets(jnp.array([10, 20], dtype=jnp.int32))
+        assert m.tolist() == [10, 10, 20, 20]
+
+    def test_mirrored_noise_cancels(self):
+        """θ+σε and θ-σε reconstruct from one offset: signed rows sum to 0."""
+        t = make_noise_table(2048, seed=0)
+        pair_offs = sample_pair_offsets(jax.random.key(5), 4, t.size, 16)
+        offs = member_offsets(pair_offs)
+        signs = pair_signs(8)
+        rows = member_noise(t, offs, signs, 16)
+        np.testing.assert_allclose(np.asarray(rows.sum(0)), np.zeros(16), atol=1e-5)
+
+
+class TestParamSpec:
+    def test_roundtrip(self):
+        tree = {"w": jnp.arange(6, dtype=jnp.float32).reshape(2, 3), "b": jnp.ones(3)}
+        flat, spec = make_param_spec(tree)
+        assert spec.dim == 9
+        back = spec.unravel(flat)
+        np.testing.assert_array_equal(np.asarray(back["w"]), np.asarray(tree["w"]))
+        np.testing.assert_array_equal(np.asarray(back["b"]), np.asarray(tree["b"]))
+
+
+class TestGradient:
+    def test_weighted_sum_matches_dense(self):
+        t = make_noise_table(8192, seed=2)
+        dim = 37
+        n = 10
+        offs = sample_pair_offsets(jax.random.key(1), n, t.size, dim)
+        w = jax.random.normal(jax.random.key(2), (n,))
+        dense = np.asarray(member_noise(t, offs, jnp.ones(n), dim))
+        expected = np.asarray(w) @ dense
+        got = np.asarray(rank_weighted_noise_sum(t, offs, w, dim=dim, chunk=4))
+        np.testing.assert_allclose(got, expected, rtol=2e-5, atol=1e-5)
+
+    def test_chunking_invariance(self):
+        t = make_noise_table(8192, seed=2)
+        dim = 21
+        n = 24
+        offs = sample_pair_offsets(jax.random.key(3), n, t.size, dim)
+        w = jax.random.normal(jax.random.key(4), (n,))
+        a = rank_weighted_noise_sum(t, offs, w, dim=dim, chunk=24)
+        b = rank_weighted_noise_sum(t, offs, w, dim=dim, chunk=8)
+        c = rank_weighted_noise_sum(t, offs, w, dim=dim, chunk=7)  # non-divisor → pad
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(c), rtol=1e-5, atol=1e-6)
+
+    def test_pair_folding_matches_per_member_sum(self):
+        """Folded Σ(w2k−w2k+1)εk must equal the naive per-member Σ wᵢsᵢεᵢ."""
+        t = make_noise_table(4096, seed=5)
+        dim, n_pairs = 19, 16
+        pair_offs = sample_pair_offsets(jax.random.key(8), n_pairs, t.size, dim)
+        offs = member_offsets(pair_offs)
+        signs = pair_signs(2 * n_pairs)
+        w = jax.random.normal(jax.random.key(9), (2 * n_pairs,))
+        dense = np.asarray(member_noise(t, offs, signs, dim))  # signed rows
+        expected = np.asarray(w) @ dense
+        folded = rank_weighted_noise_sum(
+            t, pair_offs, fold_mirrored_weights(w), dim=dim, chunk=8
+        )
+        np.testing.assert_allclose(np.asarray(folded), expected, rtol=2e-5, atol=1e-5)
+
+    def test_gradient_estimator_on_quadratic_bowl(self):
+        """E[f(θ+σε)ε]/σ ≈ ∇f: check the estimator points downhill on f(x)=-|x-c|²."""
+        dim = 8
+        center = jnp.arange(dim, dtype=jnp.float32) / 4.0
+        theta = jnp.zeros(dim)
+        sigma = 0.1
+        n_pairs = 4096
+        t = make_noise_table(1 << 20, seed=9)
+        pair_offs = sample_pair_offsets(jax.random.key(11), n_pairs, t.size, dim)
+        offs = member_offsets(pair_offs)
+        signs = pair_signs(2 * n_pairs)
+        eps = member_noise(t, offs, signs, dim)  # signed noise rows
+        fitness = -jnp.sum((theta + sigma * eps - center) ** 2, axis=1)
+        weights = centered_rank(fitness)
+        grad = es_gradient(
+            t, pair_offs, weights, sigma=sigma,
+            population_size=2 * n_pairs, dim=dim, chunk=512,
+        )
+        true_grad = -2.0 * (theta - center)  # ascent direction of fitness
+        cos = float(
+            jnp.dot(grad, true_grad)
+            / (jnp.linalg.norm(grad) * jnp.linalg.norm(true_grad))
+        )
+        assert cos > 0.95, f"estimator misaligned with true gradient: cos={cos}"
