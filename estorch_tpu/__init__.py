@@ -14,6 +14,20 @@ classes are re-exported here as they land:
 
 __version__ = "0.1.0"
 
-from . import ops  # noqa: F401
+from . import envs, models, ops, parallel  # noqa: F401
+from .algo import ES
+from .envs.agent import JaxAgent
+from .models import MLPPolicy, NatureCNN, VirtualBatchNorm
 
-__all__ = ["ops", "__version__"]
+__all__ = [
+    "ES",
+    "JaxAgent",
+    "MLPPolicy",
+    "NatureCNN",
+    "VirtualBatchNorm",
+    "envs",
+    "models",
+    "ops",
+    "parallel",
+    "__version__",
+]

@@ -1,0 +1,3 @@
+from .es import ES
+
+__all__ = ["ES"]

@@ -1,0 +1,235 @@
+"""ES — the user-facing algorithm class, API-parity with the reference.
+
+Reference surface (SURVEY.md Appendix A, ``estorch/estorch.py`` class ``ES``):
+
+    es = ES(policy, agent, optimizer, population_size=..., sigma=...,
+            device=..., policy_kwargs={}, agent_kwargs={}, optimizer_kwargs={})
+    es.train(n_steps, n_proc=1)
+    es.policy; es.best_policy; es.best_reward
+
+estorch_tpu keeps that shape.  Differences forced by the TPU-first design:
+
+- ``policy`` is a flax ``nn.Module`` class (or instance); ``agent`` is a
+  ``JaxAgent`` naming a device-native env (host Gym agents are served by the
+  host backend, envs/host_pool.py).  ``optimizer`` is an optax factory
+  (``optax.adam``) or transformation — ``optimizer_kwargs`` go to the
+  factory, so ``ES(..., optimizer=optax.adam, optimizer_kwargs={"learning_rate": 1e-2})``
+  reads like the reference's ``torch.optim.Adam`` usage.
+- ``device`` selects the mesh: ``None`` → all local devices (population DP
+  over chips via one psum — the reference's n_proc workers, minus the MPI).
+- ``train(n_steps, n_proc)``: ``n_proc`` is accepted for compatibility and
+  ignored on the device path (the mesh already parallelizes).
+
+Where the reference's generation is a Python loop + MPI round-trips
+(SURVEY.md §3.2), here it is ONE jitted XLA program (parallel/engine.py).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..envs.agent import JaxAgent, collect_reference_batch
+from ..models.vbn import capture_reference_stats
+from ..ops.noise import DEFAULT_TABLE_SIZE, make_noise_table
+from ..ops.params import make_param_spec
+from ..parallel.engine import EngineConfig, ESEngine
+from ..parallel.mesh import population_mesh
+
+
+def _as_optax(optimizer, optimizer_kwargs) -> optax.GradientTransformation:
+    if isinstance(optimizer, optax.GradientTransformation):
+        if optimizer_kwargs:
+            raise ValueError(
+                "optimizer_kwargs were given alongside an already-constructed "
+                f"optax transformation; they would be ignored: {optimizer_kwargs}. "
+                "Pass the factory (e.g. optax.adam) with optimizer_kwargs, or "
+                "the instance without them."
+            )
+        return optimizer
+    if callable(optimizer):
+        return optimizer(**optimizer_kwargs)
+    raise TypeError(f"optimizer must be an optax factory or GradientTransformation, got {optimizer!r}")
+
+
+def _instantiate(cls_or_obj, kwargs):
+    return cls_or_obj(**kwargs) if isinstance(cls_or_obj, type) else cls_or_obj
+
+
+class ES:
+    """Vanilla OpenAI-ES (Salimans et al. 2017) on the TPU-native engine."""
+
+    def __init__(
+        self,
+        policy,
+        agent,
+        optimizer,
+        population_size: int = 256,
+        sigma: float = 0.02,
+        device=None,
+        policy_kwargs: dict | None = None,
+        agent_kwargs: dict | None = None,
+        optimizer_kwargs: dict | None = None,
+        seed: int = 0,
+        table_size: int = DEFAULT_TABLE_SIZE,
+        eval_chunk: int = 0,
+        grad_chunk: int = 256,
+        weight_decay: float = 0.0,
+        mesh=None,
+        vbn_batch: int = 128,
+    ):
+        self.population_size = population_size
+        self.sigma = sigma
+        self.seed = seed
+
+        self.agent: JaxAgent = _instantiate(agent, dict(agent_kwargs or {}))
+        if not hasattr(self.agent, "env"):
+            raise TypeError(
+                "device-path agent must be a JaxAgent (wrap your JaxEnv in "
+                "estorch_tpu.JaxAgent); reference-style host agents with a "
+                "rollout() method use the host backend — see "
+                "estorch_tpu/envs/host_pool.py"
+            )
+        self.env = self.agent.env
+        self.module = _instantiate(policy, dict(policy_kwargs or {}))
+
+        # --- init policy variables from a real observation shape
+        init_key, state_key, vbn_key = jax.random.split(jax.random.PRNGKey(seed), 3)
+        _, obs0 = self.env.reset(jax.random.PRNGKey(0))
+        variables = self.module.init(init_key, obs0)
+        params = variables["params"]
+        self._frozen = {k: v for k, v in variables.items() if k != "params"}
+
+        # --- VirtualBatchNorm: freeze reference-batch statistics once
+        if "vbn_stats" in variables:
+            ref_batch = collect_reference_batch(self.env, vbn_key, n_steps=vbn_batch)
+            self._frozen["vbn_stats"] = capture_reference_stats(
+                self.module, variables, ref_batch
+            )
+
+        frozen = self._frozen
+
+        def policy_apply(p, obs):
+            return self.module.apply({"params": p, **frozen}, obs)
+
+        self._policy_apply = policy_apply
+
+        flat, self._spec = make_param_spec(params)
+        self.table = make_noise_table(table_size, seed=seed)
+        self.optimizer = _as_optax(optimizer, dict(optimizer_kwargs or {}))
+        self.mesh = mesh if mesh is not None else population_mesh(
+            [device] if device is not None and not isinstance(device, (list, tuple)) else device
+        )
+        self.config = EngineConfig(
+            population_size=population_size,
+            sigma=sigma,
+            horizon=self.agent.rollout_horizon,
+            eval_chunk=eval_chunk,
+            grad_chunk=grad_chunk,
+            weight_decay=weight_decay,
+        )
+        self.engine = ESEngine(
+            self.env, policy_apply, self._spec, self.table,
+            self.optimizer, self.config, self.mesh,
+        )
+        self.state = self.engine.init_state(flat, state_key)
+
+        self.best_reward = -np.inf
+        self._best_flat: np.ndarray | None = None
+        self.history: list[dict] = []
+        self.generation = 0
+        self.compile_time_s: float | None = None
+
+    # ------------------------------------------------------------------ train
+
+    def train(
+        self,
+        n_steps: int,
+        n_proc: int = 1,
+        log_fn: Callable[[dict], None] | None = None,
+        verbose: bool = True,
+    ):
+        """Run ``n_steps`` generations (reference: ``es.train(n_steps, n_proc)``).
+
+        ``n_proc`` is accepted for API parity; device-path parallelism comes
+        from the mesh (SURVEY.md §2 'Parallelism strategies').
+        """
+        del n_proc
+        if self.compile_time_s is None:
+            # AOT-compile outside the timed loop so env_steps_per_sec (the
+            # primary metric) never includes XLA trace+compile time
+            self.compile_time_s = self.engine.compile(self.state)
+        for _ in range(n_steps):
+            t0 = time.perf_counter()
+            prev_state = self.state
+            self.state, metrics = self.engine.generation_step(prev_state)
+            fitness = np.asarray(metrics["fitness"])
+            jax.block_until_ready(self.state.params_flat)
+            dt = time.perf_counter() - t0
+
+            gen_best = float(fitness.max())
+            if gen_best > self.best_reward:
+                self.best_reward = gen_best
+                idx = int(fitness.argmax())
+                self._best_flat = np.asarray(
+                    self.engine.member_params(prev_state, idx)
+                )
+
+            steps = int(metrics["steps"])
+            record = {
+                "generation": self.generation,
+                "reward_max": gen_best,
+                "reward_mean": float(fitness.mean()),
+                "reward_min": float(fitness.min()),
+                "best_reward": self.best_reward,
+                "env_steps": steps,
+                "env_steps_per_sec": steps / dt if dt > 0 else 0.0,
+                "grad_norm": float(np.asarray(metrics["grad_norm"])),
+                "wall_time_s": dt,
+            }
+            self.history.append(record)
+            self.generation += 1
+            if log_fn is not None:
+                log_fn(record)
+            elif verbose:
+                print(
+                    f"gen {record['generation']:4d}  "
+                    f"max {record['reward_max']:9.2f}  "
+                    f"mean {record['reward_mean']:9.2f}  "
+                    f"best {record['best_reward']:9.2f}  "
+                    f"steps/s {record['env_steps_per_sec']:,.0f}"
+                )
+        return self
+
+    # ------------------------------------------------------------- inspection
+
+    @property
+    def policy(self):
+        """Current center policy parameters as a pytree (reference: es.policy)."""
+        return self._spec.unravel(self.state.params_flat)
+
+    @property
+    def policy_variables(self):
+        """Full flax variables for ``module.apply`` (params + frozen stats)."""
+        return {"params": self.policy, **self._frozen}
+
+    @property
+    def best_policy(self):
+        """Best-ever member's parameters (reference: es.best_policy)."""
+        if self._best_flat is None:
+            return self.policy
+        return self._spec.unravel(jnp.asarray(self._best_flat))
+
+    @property
+    def best_policy_variables(self):
+        return {"params": self.best_policy, **self._frozen}
+
+    def predict(self, obs, use_best: bool = False):
+        """Policy forward pass with current (or best) parameters."""
+        p = self.best_policy if use_best else self.policy
+        return self._policy_apply(p, obs)

@@ -1,0 +1,17 @@
+from .base import EnvSpec, JaxEnv
+from .cartpole import CartPole
+from .mountain_car import MountainCarContinuous
+from .pendulum import Pendulum
+from .rollout import RolloutResult, make_population_rollout, make_rollout, select_action
+
+__all__ = [
+    "EnvSpec",
+    "JaxEnv",
+    "CartPole",
+    "MountainCarContinuous",
+    "Pendulum",
+    "RolloutResult",
+    "make_population_rollout",
+    "make_rollout",
+    "select_action",
+]

@@ -1,0 +1,59 @@
+"""Agent wrappers — the bridge from the reference's rollout contract.
+
+The reference's ``Agent`` is duck-typed host code: ``rollout(policy) ->
+reward`` or ``(reward, bc)`` (SURVEY.md §1, Appendix A).  estorch_tpu keeps
+that host contract for arbitrary Gym envs (envs/host_pool.py), and adds the
+device-native equivalent: a ``JaxAgent`` simply names a ``JaxEnv`` and a
+horizon, and the engine compiles the rollouts itself — the agent never steps
+anything in Python.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class JaxAgent:
+    """Device-native agent: wraps a pure-JAX env for the compiled path.
+
+    Parameters mirror what the reference's Agent constructor would close
+    over (the env); ``horizon`` bounds the fixed-length rollout scan.
+    """
+
+    env: Any
+    horizon: int | None = None
+
+    @property
+    def rollout_horizon(self) -> int:
+        return int(self.horizon or self.env.default_horizon)
+
+
+def collect_reference_batch(env: Any, key: jax.Array, n_steps: int = 128) -> jax.Array:
+    """Observations from a random-action rollout, for VirtualBatchNorm.
+
+    The OpenAI-ES trick: VBN statistics come from a fixed batch of states
+    gathered with random actions at startup; the reference leaves this to
+    user code, we bundle it.  Runs as one compiled scan on device.
+    """
+
+    def step_fn(carry, k):
+        state, obs = carry
+        if env.discrete:
+            action = jax.random.randint(k, (), 0, env.action_dim)
+        else:
+            action = jax.random.uniform(k, (env.action_dim,), minval=-1.0, maxval=1.0)
+        nstate, nobs, _, done = env.step(state, action)
+        # restart from the same initial state on termination to keep shapes static
+        keep = lambda new, old: jnp.where(done, old, new)
+        return (jax.tree_util.tree_map(keep, nstate, state), keep(nobs, obs)), obs
+
+    key, rkey = jax.random.split(key)
+    state0, obs0 = env.reset(rkey)
+    keys = jax.random.split(key, n_steps)
+    _, obs_batch = jax.lax.scan(step_fn, (state0, obs0), keys)
+    return obs_batch

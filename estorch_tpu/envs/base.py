@@ -1,0 +1,71 @@
+"""JaxEnv — the device-native environment contract.
+
+The reference's rollout contract is a duck-typed host object:
+``Agent.rollout(policy) -> reward`` (or ``(reward, bc)`` for the novelty
+variants) stepping a Gym env in a Python while-loop (SURVEY.md §3.3).  That
+per-step host↔device ping-pong is the reference's throughput ceiling.
+
+The TPU-native contract is a pair of PURE functions over explicit state:
+
+    state, obs = env.reset(key)
+    state, obs, reward, done = env.step(state, action)
+
+so an entire episode compiles into one ``lax.scan`` (envs/rollout.py) and an
+entire population of episodes into one ``vmap`` — the whole generation is a
+single XLA program.  Host-side envs (MuJoCo, Atari, arbitrary Gym) remain
+supported through envs/host_pool.py, which implements the same duck-typed
+``Agent.rollout`` surface as the reference.
+
+Envs are frozen dataclasses of static Python scalars (closed over at trace
+time, never traced), with state as a small pytree of arrays.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Protocol, Tuple
+
+import jax
+
+EnvState = Any  # pytree of arrays
+
+
+class JaxEnv(Protocol):
+    """Structural type for device-native envs."""
+
+    obs_dim: int
+    action_dim: int  # number of discrete actions, or continuous action dims
+    discrete: bool
+    default_horizon: int
+    bc_dim: int  # behavior-characterization dims (novelty variants)
+
+    def reset(self, key: jax.Array) -> Tuple[EnvState, jax.Array]: ...
+
+    def step(
+        self, state: EnvState, action: jax.Array
+    ) -> Tuple[EnvState, jax.Array, jax.Array, jax.Array]: ...
+
+    def behavior(self, state: EnvState, obs: jax.Array) -> jax.Array:
+        """BC vector for novelty search; default impls use final observation."""
+        ...
+
+
+@dataclasses.dataclass(frozen=True)
+class EnvSpec:
+    """Static facts the engine needs about an env (shapes, modes)."""
+
+    obs_dim: int
+    action_dim: int
+    discrete: bool
+    horizon: int
+    bc_dim: int
+
+    @staticmethod
+    def of(env: JaxEnv, horizon: int | None = None) -> "EnvSpec":
+        return EnvSpec(
+            obs_dim=env.obs_dim,
+            action_dim=env.action_dim,
+            discrete=env.discrete,
+            horizon=int(horizon or env.default_horizon),
+            bc_dim=env.bc_dim,
+        )

@@ -1,0 +1,69 @@
+"""Pure-JAX CartPole-v1 (classic control), bit-faithful to the Gym dynamics.
+
+The reference's README example is CartPole ES through a host Gym env
+(SURVEY.md §2 item 9).  Here the same physics run ON the TPU inside the
+rollout scan, so population × horizon env steps happen in one compiled
+program.  Dynamics follow the standard Barto-Sutton-Anderson cart-pole with
+Euler integration and the Gym constants; parity with ``gymnasium``'s
+CartPole-v1 is asserted step-for-step in tests/test_envs.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class CartPole:
+    gravity: float = 9.8
+    masscart: float = 1.0
+    masspole: float = 0.1
+    length: float = 0.5  # half the pole's length
+    force_mag: float = 10.0
+    tau: float = 0.02
+    theta_threshold: float = 12 * 2 * jnp.pi / 360
+    x_threshold: float = 2.4
+
+    obs_dim: int = 4
+    action_dim: int = 2
+    discrete: bool = True
+    default_horizon: int = 500
+    bc_dim: int = 2
+
+    def reset(self, key: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        state = jax.random.uniform(key, (4,), minval=-0.05, maxval=0.05)
+        return state, state
+
+    def step(self, state, action):
+        x, x_dot, theta, theta_dot = state[0], state[1], state[2], state[3]
+        force = jnp.where(action == 1, self.force_mag, -self.force_mag)
+        costheta = jnp.cos(theta)
+        sintheta = jnp.sin(theta)
+        total_mass = self.masscart + self.masspole
+        polemass_length = self.masspole * self.length
+
+        temp = (force + polemass_length * theta_dot**2 * sintheta) / total_mass
+        thetaacc = (self.gravity * sintheta - costheta * temp) / (
+            self.length * (4.0 / 3.0 - self.masspole * costheta**2 / total_mass)
+        )
+        xacc = temp - polemass_length * thetaacc * costheta / total_mass
+
+        x = x + self.tau * x_dot
+        x_dot = x_dot + self.tau * xacc
+        theta = theta + self.tau * theta_dot
+        theta_dot = theta_dot + self.tau * thetaacc
+
+        new_state = jnp.stack([x, x_dot, theta, theta_dot])
+        done = (
+            (jnp.abs(x) > self.x_threshold) | (jnp.abs(theta) > self.theta_threshold)
+        )
+        reward = jnp.float32(1.0)
+        return new_state, new_state, reward, done
+
+    def behavior(self, state, obs) -> jax.Array:
+        """BC = final cart position and pole angle."""
+        return jnp.stack([state[0], state[2]])

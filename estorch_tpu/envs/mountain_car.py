@@ -1,0 +1,56 @@
+"""Pure-JAX MountainCarContinuous-v0, faithful to the Gym dynamics.
+
+A sparse-reward continuous env — the classic novelty-search showcase (a
+reward-only ES stalls; NS-ES explores by final-position behavior).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class MountainCarContinuous:
+    min_position: float = -1.2
+    max_position: float = 0.6
+    max_speed: float = 0.07
+    goal_position: float = 0.45
+    goal_velocity: float = 0.0
+    power: float = 0.0015
+
+    obs_dim: int = 2
+    action_dim: int = 1
+    discrete: bool = False
+    default_horizon: int = 999
+    bc_dim: int = 1
+
+    def reset(self, key: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        pos = jax.random.uniform(key, (), minval=-0.6, maxval=-0.4)
+        state = jnp.stack([pos, jnp.float32(0.0)])
+        return state, state
+
+    def step(self, state, action):
+        position, velocity = state[0], state[1]
+        force = jnp.clip(action.reshape(()), -1.0, 1.0)
+
+        velocity = velocity + force * self.power - 0.0025 * jnp.cos(3 * position)
+        velocity = jnp.clip(velocity, -self.max_speed, self.max_speed)
+        position = position + velocity
+        position = jnp.clip(position, self.min_position, self.max_position)
+        velocity = jnp.where(
+            (position == self.min_position) & (velocity < 0), 0.0, velocity
+        )
+
+        done = (position >= self.goal_position) & (velocity >= self.goal_velocity)
+        reward = jnp.where(done, 100.0, 0.0) - 0.1 * force**2
+
+        new_state = jnp.stack([position, velocity])
+        return new_state, new_state, reward, done
+
+    def behavior(self, state, obs) -> jax.Array:
+        """BC = final position (the NS-ES paper's BC for deceptive mazes)."""
+        return state[:1]

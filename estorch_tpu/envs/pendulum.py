@@ -1,0 +1,60 @@
+"""Pure-JAX Pendulum-v1 (continuous control), faithful to the Gym dynamics.
+
+Continuous-action counterpart for the device-native rollout path; parity
+with ``gymnasium``'s Pendulum-v1 asserted in tests/test_envs.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _angle_normalize(x):
+    return ((x + jnp.pi) % (2 * jnp.pi)) - jnp.pi
+
+
+@dataclasses.dataclass(frozen=True)
+class Pendulum:
+    max_speed: float = 8.0
+    max_torque: float = 2.0
+    dt: float = 0.05
+    g: float = 10.0
+    m: float = 1.0
+    l: float = 1.0
+
+    obs_dim: int = 3
+    action_dim: int = 1
+    discrete: bool = False
+    default_horizon: int = 200
+    bc_dim: int = 2
+
+    def _obs(self, state):
+        th, thdot = state[0], state[1]
+        return jnp.stack([jnp.cos(th), jnp.sin(th), thdot])
+
+    def reset(self, key: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        hi = jnp.array([jnp.pi, 1.0])
+        state = jax.random.uniform(key, (2,), minval=-hi, maxval=hi)
+        return state, self._obs(state)
+
+    def step(self, state, action):
+        th, thdot = state[0], state[1]
+        u = jnp.clip(action.reshape(()), -self.max_torque, self.max_torque)
+        cost = _angle_normalize(th) ** 2 + 0.1 * thdot**2 + 0.001 * u**2
+
+        newthdot = thdot + (
+            3 * self.g / (2 * self.l) * jnp.sin(th) + 3.0 / (self.m * self.l**2) * u
+        ) * self.dt
+        newthdot = jnp.clip(newthdot, -self.max_speed, self.max_speed)
+        newth = th + newthdot * self.dt
+
+        new_state = jnp.stack([newth, newthdot])
+        return new_state, self._obs(new_state), -cost, jnp.bool_(False)
+
+    def behavior(self, state, obs) -> jax.Array:
+        """BC = final angle (cos, sin) — where the pendulum ended up."""
+        return jnp.stack([jnp.cos(state[0]), jnp.sin(state[0])])

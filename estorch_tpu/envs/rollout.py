@@ -1,0 +1,96 @@
+"""Compiled episode rollouts: fixed-horizon ``lax.scan`` with done masking.
+
+Replaces the reference's host-side ``while not done: policy(obs); env.step``
+loop (SURVEY.md §3.3) with a single traced scan so XLA sees the whole
+episode — and, after ``vmap``, the whole population — as one program:
+policy matmuls batch onto the MXU, env math fuses into the surrounding ops,
+and nothing touches the host until the generation's fitness vector exists.
+
+Done masking: after an episode terminates, further steps still execute
+(static shapes — the TPU way) but rewards are masked and state is frozen,
+so results are exactly equal to early termination.  ``steps`` counts the
+genuinely-alive steps for honest env-steps/sec accounting.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class RolloutResult(NamedTuple):
+    total_reward: jax.Array  # () float32 — the episode return (fitness)
+    bc: jax.Array  # (bc_dim,) float32 — behavior characterization
+    steps: jax.Array  # () int32 — alive steps actually taken
+
+
+def select_action(policy_out: jax.Array, discrete: bool) -> jax.Array:
+    """Reference action rule: argmax for discrete policies (SURVEY.md §3.3);
+    continuous policies emit actions directly (models apply their own squash)."""
+    if discrete:
+        return jnp.argmax(policy_out, axis=-1)
+    return policy_out
+
+
+def make_rollout(
+    env: Any,
+    policy_apply: Callable[[Any, jax.Array], jax.Array],
+    horizon: int,
+) -> Callable[[Any, jax.Array], RolloutResult]:
+    """Build ``rollout(params, key) -> RolloutResult`` for one episode.
+
+    ``policy_apply(params, obs) -> action logits/values``.  The returned
+    function is pure and jit/vmap-safe; vmap it over ``(params, key)`` to
+    evaluate a whole population slice in one program.
+    """
+    discrete = bool(env.discrete)
+
+    def rollout(params: Any, key: jax.Array) -> RolloutResult:
+        state0, obs0 = env.reset(key)
+
+        def step_fn(carry, _):
+            state, obs, done, total, steps = carry
+            out = policy_apply(params, obs)
+            action = select_action(out, discrete)
+            nstate, nobs, reward, ndone = env.step(state, action)
+            alive = jnp.logical_not(done)
+            alive_f = alive.astype(jnp.float32)
+            total = total + reward * alive_f
+            steps = steps + alive.astype(jnp.int32)
+            # freeze state/obs after termination so BC reads the final frame
+            keep = lambda new, old: jnp.where(alive, new, old)
+            state_next = jax.tree_util.tree_map(keep, nstate, state)
+            obs_next = keep(nobs, obs)
+            done_next = done | ndone
+            return (state_next, obs_next, done_next, total, steps), None
+
+        init = (
+            state0,
+            obs0,
+            jnp.bool_(False),
+            jnp.float32(0.0),
+            jnp.int32(0),
+        )
+        (state, obs, done, total, steps), _ = jax.lax.scan(
+            step_fn, init, None, length=horizon
+        )
+        bc = env.behavior(state, obs).astype(jnp.float32)
+        return RolloutResult(total_reward=total, bc=bc, steps=steps)
+
+    return rollout
+
+
+def make_population_rollout(
+    env: Any,
+    policy_apply: Callable[[Any, jax.Array], jax.Array],
+    horizon: int,
+) -> Callable[[Any, jax.Array], RolloutResult]:
+    """vmap of ``make_rollout`` over stacked params and per-member keys.
+
+    ``params`` leaves have a leading population axis; ``keys`` is (n,).
+    Returns batched RolloutResult arrays — (n,), (n, bc_dim), (n,).
+    """
+    single = make_rollout(env, policy_apply, horizon)
+    return jax.vmap(single, in_axes=(0, 0))

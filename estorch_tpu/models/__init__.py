@@ -1,0 +1,4 @@
+from .policies import MLPPolicy, NatureCNN
+from .vbn import VirtualBatchNorm, capture_reference_stats
+
+__all__ = ["MLPPolicy", "NatureCNN", "VirtualBatchNorm", "capture_reference_stats"]

@@ -1,0 +1,79 @@
+"""Bundled policy networks (flax), mirroring the reference's example policies.
+
+The reference leaves policies entirely to the user (any ``torch.nn.Module``,
+SURVEY.md §1 'rollout contract'); its examples use small MLPs, and the Atari
+config implies the Nature DQN CNN.  We bundle TPU-idiomatic equivalents:
+
+- ``MLPPolicy`` — tanh MLP for classic-control / MuJoCo configs.  Continuous
+  heads tanh-squash and scale, discrete heads emit logits (argmax action
+  selection happens in envs/rollout.py, matching the reference).
+- ``NatureCNN`` — the 84×84×4 Atari trunk (conv 32×8s4, 64×4s2, 64×3s1,
+  dense 512) with an optional VirtualBatchNorm after each conv, which is the
+  OpenAI-ES Atari setup the reference's VBN module exists for.
+
+All modules are shape-static and bf16-friendly; matmuls/convs land on the
+MXU when vmapped across the population.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .vbn import VirtualBatchNorm
+
+
+class MLPPolicy(nn.Module):
+    """Tanh MLP policy.
+
+    ``action_dim`` is the number of discrete actions (``discrete=True``) or
+    the action dimensionality (continuous, squashed to ±``action_scale``).
+    """
+
+    action_dim: int
+    hidden: Sequence[int] = (64, 64)
+    discrete: bool = True
+    action_scale: float = 1.0
+    activation: Callable = nn.tanh
+    use_vbn: bool = False
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, update_stats: bool = False) -> jnp.ndarray:
+        for i, h in enumerate(self.hidden):
+            x = nn.Dense(h, name=f"dense_{i}")(x)
+            if self.use_vbn:
+                x = VirtualBatchNorm(h, name=f"vbn_{i}")(x, update_stats=update_stats)
+            x = self.activation(x)
+        x = nn.Dense(self.action_dim, name="head")(x)
+        if not self.discrete:
+            x = jnp.tanh(x) * self.action_scale
+        return x
+
+
+class NatureCNN(nn.Module):
+    """Nature-DQN CNN policy for Atari-style (84, 84, C) observations."""
+
+    action_dim: int
+    use_vbn: bool = True
+    discrete: bool = True
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, update_stats: bool = False) -> jnp.ndarray:
+        squeeze = x.ndim == 3
+        if squeeze:  # single observation -> add batch axis for convs
+            x = x[None]
+        x = x.astype(jnp.float32) / 255.0
+        for i, (feat, kern, stride) in enumerate(
+            [(32, 8, 4), (64, 4, 2), (64, 3, 1)]
+        ):
+            x = nn.Conv(feat, (kern, kern), strides=(stride, stride), padding="VALID",
+                        name=f"conv_{i}")(x)
+            if self.use_vbn:
+                x = VirtualBatchNorm(feat, name=f"vbn_{i}")(x, update_stats=update_stats)
+            x = nn.relu(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(512, name="fc")(x))
+        x = nn.Dense(self.action_dim, name="head")(x)
+        return x[0] if squeeze else x

@@ -1,0 +1,69 @@
+"""VirtualBatchNorm — the OpenAI-ES Atari normalization trick, flax-native.
+
+Reference: ``estorch.VirtualBatchNorm`` (``estorch/estorch.py`` — SURVEY.md
+§2 item 6): normalization statistics are computed ONCE from a fixed reference
+batch and frozen; rollouts then normalize with those frozen statistics plus a
+learned affine, so ES policies see stable activations without per-batch stats.
+
+TPU-native design: statistics live in a separate flax variable collection
+(``vbn_stats``), NOT in ``params`` — so the ES perturbation (which flattens
+only ``params``) never touches them, and the whole population shares one
+frozen copy, exactly matching the reference semantics (and avoiding
+per-member stat drift under vmap, SURVEY.md §7 hard-part 5).
+
+Usage:
+    stats = capture_reference_stats(module, params, reference_batch)
+    out = module.apply({"params": params, "vbn_stats": stats}, obs)
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class VirtualBatchNorm(nn.Module):
+    """Normalize with frozen reference-batch statistics + learned affine.
+
+    During a reference pass (``mutable=["vbn_stats"]`` with
+    ``update_stats=True``), the module computes mean/var over the batch axes
+    of the reference batch and stores them.  All later calls normalize with
+    the stored values.  Works on (features,) single observations and
+    (batch, features) batches alike.
+    """
+
+    num_features: int
+    eps: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, update_stats: bool = False) -> jnp.ndarray:
+        mean = self.variable(
+            "vbn_stats", "mean", lambda: jnp.zeros((self.num_features,), jnp.float32)
+        )
+        var = self.variable(
+            "vbn_stats", "var", lambda: jnp.ones((self.num_features,), jnp.float32)
+        )
+        gamma = self.param("scale", nn.initializers.ones, (self.num_features,))
+        beta = self.param("bias", nn.initializers.zeros, (self.num_features,))
+
+        if update_stats:
+            axes = tuple(range(x.ndim - 1))  # all but the feature axis
+            mean.value = jnp.mean(x, axis=axes)
+            var.value = jnp.var(x, axis=axes)
+
+        inv = jax.lax.rsqrt(var.value + self.eps)
+        return (x - mean.value) * inv * gamma + beta
+
+
+def capture_reference_stats(module: nn.Module, variables: dict, reference_batch):
+    """Run the reference batch once, returning the frozen ``vbn_stats``.
+
+    ``variables`` is the dict from ``module.init`` (contains ``params`` and
+    initial ``vbn_stats``).  Returns the updated ``vbn_stats`` collection to
+    be passed (immutably) to every subsequent apply.
+    """
+    _, updated = module.apply(
+        variables, reference_batch, update_stats=True, mutable=["vbn_stats"]
+    )
+    return updated["vbn_stats"]

@@ -1,0 +1,13 @@
+from .engine import EngineConfig, ESEngine, ESState, EvalResult
+from .mesh import POP_AXIS, pairs_per_device, population_mesh, single_device_mesh
+
+__all__ = [
+    "EngineConfig",
+    "ESEngine",
+    "ESState",
+    "EvalResult",
+    "POP_AXIS",
+    "pairs_per_device",
+    "population_mesh",
+    "single_device_mesh",
+]

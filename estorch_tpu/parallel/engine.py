@@ -1,0 +1,320 @@
+"""The sharded ES generation engine — one XLA program per generation.
+
+This is the TPU-native replacement for the reference's entire distributed
+runtime (SURVEY.md §3.2: Python per-member loop → MPI gather of fitness →
+master-only update → parameter broadcast).  Design, per BASELINE.json's
+north star:
+
+- **Population DP over a device mesh**: each device owns a contiguous shard
+  of antithetic pairs (layout in parallel/mesh.py).  Inside ``shard_map``,
+  a ``lax.scan`` over evaluation chunks × ``vmap`` within a chunk rolls out
+  every member's episode on-device (envs/rollout.py).
+- **No noise on the wire**: every device derives the SAME pair offsets from
+  the replicated ``(key, generation)`` via a counter-based PRNG and slices
+  its shard by ``axis_index`` — ε is regenerated locally from the shared
+  table (ops/noise.py).
+- **One small all_gather + one psum**: fitness (O(population) floats) is
+  all-gathered so every device computes identical centered ranks; the
+  rank-weighted noise sum is reduced with a single ``lax.psum`` riding ICI.
+- **No parameter broadcast**: the psum result — and hence the optax update —
+  is bit-identical on every device, so parameters stay replicated by
+  construction.  This deletes the reference's broadcast entirely.
+
+Two entry points share all machinery:
+  * ``generation_step`` — fused evaluate+rank+update for vanilla ES.
+  * ``evaluate`` / ``apply_weights`` — the split path for the novelty family
+    (NS/NSR/NSRA), whose rank weights depend on a host-side archive.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..envs.rollout import make_rollout
+from ..ops.gradient import fold_mirrored_weights, rank_weighted_noise_sum
+from ..ops.noise import NoiseTable, member_offsets, pair_signs, sample_pair_offsets
+from ..ops.params import ParamSpec
+from ..ops.ranks import centered_rank
+from .mesh import POP_AXIS, pairs_per_device
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    """Static engine configuration (hashable; closed over at trace time)."""
+
+    population_size: int
+    sigma: float
+    horizon: int
+    eval_chunk: int = 0  # members per rollout chunk; 0 → whole local shard
+    grad_chunk: int = 256  # pairs per gradient-reduction chunk
+    weight_decay: float = 0.0  # L2 pull toward 0, applied with the update
+
+
+class ESState(NamedTuple):
+    """Replicated across devices; everything needed to resume exactly."""
+
+    params_flat: jax.Array  # (dim,) float32 — center of the search distribution
+    opt_state: Any
+    key: jax.Array  # PRNG key, folded with generation for per-gen streams
+    generation: jax.Array  # () int32
+
+
+class EvalResult(NamedTuple):
+    fitness: jax.Array  # (population,) float32, global member order
+    bc: jax.Array  # (population, bc_dim) float32
+    steps: jax.Array  # () int32 — total alive env steps this generation
+
+
+def _gen_keys(state: ESState) -> tuple[jax.Array, jax.Array]:
+    """Per-generation streams: (offset key, rollout key). Identical everywhere."""
+    base = jax.random.fold_in(state.key, state.generation)
+    return jax.random.fold_in(base, 0), jax.random.fold_in(base, 1)
+
+
+def _choose_eval_chunk(requested: int, local_members: int) -> int:
+    """Largest divisor of ``local_members`` that is ≤ the requested chunk."""
+    if requested <= 0 or requested >= local_members:
+        return local_members
+    c = min(requested, local_members)
+    while local_members % c != 0:
+        c -= 1
+    return c
+
+
+class ESEngine:
+    """Compiles and caches the per-generation XLA programs for one setup."""
+
+    def __init__(
+        self,
+        env: Any,
+        policy_apply: Callable[[Any, jax.Array], jax.Array],
+        spec: ParamSpec,
+        table: NoiseTable,
+        optimizer: optax.GradientTransformation,
+        config: EngineConfig,
+        mesh: Mesh,
+    ):
+        self.env = env
+        self.policy_apply = policy_apply
+        self.spec = spec
+        self.table = table
+        self.optimizer = optimizer
+        self.config = config
+        self.mesh = mesh
+        self.n_devices = mesh.devices.size
+        self.pairs_local = pairs_per_device(config.population_size, self.n_devices)
+        self.members_local = 2 * self.pairs_local
+        self.eval_chunk = _choose_eval_chunk(config.eval_chunk, self.members_local)
+        self.bc_dim = int(env.bc_dim)
+
+        self._rollout = make_rollout(env, policy_apply, config.horizon)
+
+        # All inputs/outputs are fully replicated (P()); the population axis
+        # only exists INSIDE the program (axis_index-derived shards).
+        self._generation_step = jax.jit(
+            jax.shard_map(
+                self._generation_body,
+                mesh=mesh,
+                in_specs=(P(),),
+                out_specs=(P(), P()),
+                check_vma=False,
+            )
+        )
+        # split path: evaluate, then apply host-computed weights
+        self._evaluate = jax.jit(
+            jax.shard_map(
+                self._evaluate_body,
+                mesh=mesh,
+                in_specs=(P(),),
+                out_specs=P(),
+                check_vma=False,
+            )
+        )
+        self._apply_weights = jax.jit(
+            jax.shard_map(
+                self._apply_weights_body,
+                mesh=mesh,
+                in_specs=(P(), P()),
+                out_specs=(P(), P()),
+                check_vma=False,
+            )
+        )
+
+        def center_eval(state: ESState):
+            _, rkey = _gen_keys(state)
+            ckey = jax.random.fold_in(rkey, 2**31 - 1)  # stream disjoint from members
+            return self._rollout(self.spec.unravel(state.params_flat), ckey)
+
+        # evaluates the unperturbed center policy (reference's `es.policy`):
+        # used for best-snapshot logging and the novelty family's archive BCs
+        self._center_eval = jax.jit(center_eval)
+
+    # ---- shard-local bodies (run once per device under shard_map) ----
+
+    def _local_offsets_signs_keys(self, state: ESState):
+        """Derive this device's pair offsets, member signs, rollout keys."""
+        cfg = self.config
+        okey, rkey = _gen_keys(state)
+        all_pair_offsets = sample_pair_offsets(
+            okey, cfg.population_size // 2, self.table.size, self.spec.dim
+        )
+        d = jax.lax.axis_index(POP_AXIS)
+        pair_offs = jax.lax.dynamic_slice(
+            all_pair_offsets, (d * self.pairs_local,), (self.pairs_local,)
+        )
+        signs = pair_signs(self.members_local)
+        # mirrored members share a rollout key (common random numbers):
+        pair_keys = jax.random.split(rkey, cfg.population_size // 2)
+        local_pair_keys = jax.lax.dynamic_slice(
+            pair_keys, (d * self.pairs_local, 0), (self.pairs_local, pair_keys.shape[1])
+        )
+        member_keys = jnp.repeat(local_pair_keys, 2, axis=0)
+        return pair_offs, signs, member_keys
+
+    def _eval_local(self, state: ESState, pair_offs, signs, member_keys):
+        """Rollout this device's members in eval_chunk-sized compiled chunks."""
+        cfg = self.config
+        dim = self.spec.dim
+        member_offs = member_offsets(pair_offs)
+        n_chunks = self.members_local // self.eval_chunk
+
+        def chunk_body(_, xs):
+            offs_c, signs_c, keys_c = xs
+
+            def member_eval(off, sign, key):
+                eps = self.table.slice(off, dim)
+                theta = state.params_flat + cfg.sigma * sign * eps
+                res = self._rollout(self.spec.unravel(theta), key)
+                return res.total_reward, res.bc, res.steps
+
+            f, bc, st = jax.vmap(member_eval)(offs_c, signs_c, keys_c)
+            return 0, (f, bc, st)
+
+        xs = (
+            member_offs.reshape(n_chunks, self.eval_chunk),
+            signs.reshape(n_chunks, self.eval_chunk),
+            member_keys.reshape(n_chunks, self.eval_chunk, -1),
+        )
+        _, (f, bc, st) = jax.lax.scan(chunk_body, 0, xs)
+        fitness_local = f.reshape(self.members_local)
+        bc_local = bc.reshape(self.members_local, self.bc_dim)
+        steps_local = st.reshape(self.members_local)
+        return fitness_local, bc_local, steps_local
+
+    def _gather_global(self, fitness_local, bc_local, steps_local):
+        """Device-major all_gather → identical global arrays on every device."""
+        fitness = jax.lax.all_gather(fitness_local, POP_AXIS).reshape(-1)
+        bc = jax.lax.all_gather(bc_local, POP_AXIS).reshape(-1, self.bc_dim)
+        steps = jax.lax.psum(steps_local.sum(), POP_AXIS)
+        return fitness, bc, steps
+
+    def _update_from_weights(self, state: ESState, weights, pair_offs):
+        """Optax step from per-member rank weights. Identical on all devices."""
+        cfg = self.config
+        d = jax.lax.axis_index(POP_AXIS)
+        w_local = jax.lax.dynamic_slice(
+            weights, (d * self.members_local,), (self.members_local,)
+        )
+        pw = fold_mirrored_weights(w_local)
+        partial_sum = rank_weighted_noise_sum(
+            self.table, pair_offs, pw, dim=self.spec.dim, chunk=cfg.grad_chunk
+        )
+        total = jax.lax.psum(partial_sum, POP_AXIS)
+        grad_ascent = total / (cfg.population_size * cfg.sigma)
+        if cfg.weight_decay > 0.0:
+            grad_ascent = grad_ascent - cfg.weight_decay * state.params_flat
+        updates, new_opt_state = self.optimizer.update(
+            -grad_ascent, state.opt_state, state.params_flat
+        )
+        new_params = optax.apply_updates(state.params_flat, updates)
+        new_state = ESState(
+            params_flat=new_params,
+            opt_state=new_opt_state,
+            key=state.key,
+            generation=state.generation + 1,
+        )
+        return new_state, jnp.linalg.norm(grad_ascent)
+
+    # ---- shard_map bodies ----
+
+    def _generation_body(self, state: ESState):
+        pair_offs, signs, member_keys = self._local_offsets_signs_keys(state)
+        f_l, bc_l, st_l = self._eval_local(state, pair_offs, signs, member_keys)
+        fitness, bc, steps = self._gather_global(f_l, bc_l, st_l)
+        weights = centered_rank(fitness)
+        new_state, gnorm = self._update_from_weights(state, weights, pair_offs)
+        metrics = {
+            "fitness": fitness,
+            "bc": bc,
+            "steps": steps,
+            "grad_norm": gnorm,
+        }
+        return new_state, metrics
+
+    def _evaluate_body(self, state: ESState):
+        pair_offs, signs, member_keys = self._local_offsets_signs_keys(state)
+        f_l, bc_l, st_l = self._eval_local(state, pair_offs, signs, member_keys)
+        fitness, bc, steps = self._gather_global(f_l, bc_l, st_l)
+        return EvalResult(fitness=fitness, bc=bc, steps=steps)
+
+    def _apply_weights_body(self, state: ESState, weights):
+        pair_offs, _, _ = self._local_offsets_signs_keys(state)
+        new_state, gnorm = self._update_from_weights(state, weights, pair_offs)
+        return new_state, gnorm
+
+    # ---- public API ----
+
+    def init_state(self, params_flat: jax.Array, key: jax.Array) -> ESState:
+        return ESState(
+            params_flat=params_flat,
+            opt_state=self.optimizer.init(params_flat),
+            key=key,
+            generation=jnp.int32(0),
+        )
+
+    def compile(self, state: ESState) -> float:
+        """AOT-compile the fused generation program; returns seconds spent.
+
+        Called once before the timed loop so env-steps/sec — the primary
+        metric — never includes XLA trace+compile time.
+        """
+        import time as _time
+
+        t0 = _time.perf_counter()
+        self._generation_step.lower(state).compile()
+        return _time.perf_counter() - t0
+
+    def generation_step(self, state: ESState):
+        """Fused ES generation: returns (new_state, metrics dict)."""
+        return self._generation_step(state)
+
+    def evaluate(self, state: ESState) -> EvalResult:
+        """Population evaluation only (novelty family / center evaluation)."""
+        return self._evaluate(state)
+
+    def apply_weights(self, state: ESState, weights: jax.Array):
+        """Update from host-computed per-member weights (novelty family)."""
+        return self._apply_weights(state, weights)
+
+    def evaluate_center(self, state: ESState):
+        """One episode with the unperturbed center params → RolloutResult."""
+        return self._center_eval(state)
+
+    def member_params(self, state: ESState, member_index: int) -> jax.Array:
+        """Reconstruct one member's flat params from the noise table (host
+        convenience — e.g. to snapshot the best member, reference's
+        ``best_policy``)."""
+        okey, _ = _gen_keys(state)
+        all_pair_offsets = sample_pair_offsets(
+            okey, self.config.population_size // 2, self.table.size, self.spec.dim
+        )
+        pair = member_index // 2
+        sign = 1.0 if member_index % 2 == 0 else -1.0
+        eps = self.table.slice(all_pair_offsets[pair], self.spec.dim)
+        return state.params_flat + self.config.sigma * sign * eps

@@ -1,0 +1,48 @@
+"""Device mesh helpers for population data-parallelism.
+
+The reference's distributed runtime is ``torch.distributed`` gather/broadcast
+over ``n_proc`` CPU processes (SURVEY.md §2 item 7).  The TPU-native
+equivalent is a 1-D ``jax.sharding.Mesh`` over the available chips with a
+single named axis ``POP_AXIS``: each device evaluates its population shard
+and the update travels through one ``lax.psum`` riding ICI.  On multi-slice
+deployments the same axis spans slices — XLA routes the reduction
+hierarchically (ICI within a slice, DCN across) without code changes.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+from jax.sharding import Mesh
+
+POP_AXIS = "pop"
+
+
+def population_mesh(devices: Sequence[jax.Device] | None = None) -> Mesh:
+    """1-D mesh over ``devices`` (default: all) with the population axis."""
+    devs = list(devices) if devices is not None else jax.devices()
+    return jax.make_mesh((len(devs),), (POP_AXIS,), devices=devs)
+
+
+def single_device_mesh(device: jax.Device | None = None) -> Mesh:
+    dev = device if device is not None else jax.devices()[0]
+    return jax.make_mesh((1,), (POP_AXIS,), devices=[dev])
+
+
+def pairs_per_device(population_size: int, n_devices: int) -> int:
+    """Antithetic pairs each device owns; validates divisibility.
+
+    The population is laid out device-major: device d owns pairs
+    [d·k, (d+1)·k) and members [2·d·k, 2·(d+1)·k), so an all_gather of
+    per-device fitness reproduces the global member order.
+    """
+    if population_size % 2 != 0:
+        raise ValueError(f"population_size must be even (mirrored sampling), got {population_size}")
+    n_pairs = population_size // 2
+    if n_pairs % n_devices != 0:
+        raise ValueError(
+            f"population pairs ({n_pairs}) must divide evenly over {n_devices} "
+            f"devices; use a population that is a multiple of {2 * n_devices}"
+        )
+    return n_pairs // n_devices

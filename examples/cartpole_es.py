@@ -1,0 +1,34 @@
+"""CartPole ES — the reference's README example, TPU-native.
+
+Reference equivalent (estorch README, upstream — SURVEY.md §2 item 9):
+a 2-layer MLP policy + Gym CartPole agent, ``ES(...).train(n_steps)``.
+Here the env itself runs on the accelerator inside the rollout scan, so a
+whole generation is one XLA program.  BASELINE config 1.
+
+Run: python examples/cartpole_es.py
+"""
+
+import optax
+
+from estorch_tpu import ES, JaxAgent, MLPPolicy
+from estorch_tpu.envs import CartPole
+
+
+def main():
+    es = ES(
+        policy=MLPPolicy,
+        agent=JaxAgent,
+        optimizer=optax.adam,
+        population_size=64,
+        sigma=0.1,
+        policy_kwargs={"action_dim": 2, "hidden": (32, 32)},
+        agent_kwargs={"env": CartPole()},
+        optimizer_kwargs={"learning_rate": 3e-2},
+    )
+    es.train(n_steps=20)
+    print(f"\nbest reward: {es.best_reward}")
+    return es
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,122 @@
+"""estorch-parity API surface tests (SURVEY.md Appendix A)."""
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from estorch_tpu import ES, JaxAgent, MLPPolicy
+from estorch_tpu.envs import CartPole, Pendulum
+
+
+def _make_es(**over):
+    kw = dict(
+        policy=MLPPolicy,
+        agent=JaxAgent,
+        optimizer=optax.adam,
+        population_size=32,
+        sigma=0.1,
+        seed=0,
+        policy_kwargs={"action_dim": 2, "hidden": (16,)},
+        agent_kwargs={"env": CartPole(), "horizon": 100},
+        optimizer_kwargs={"learning_rate": 3e-2},
+        table_size=1 << 17,
+    )
+    kw.update(over)
+    return ES(**kw)
+
+
+class TestESAPI:
+    def test_constructor_mirrors_reference_signature(self):
+        es = _make_es()
+        assert es.population_size == 32
+        assert es.sigma == 0.1
+
+    def test_train_returns_self_and_logs(self):
+        es = _make_es()
+        out = es.train(2, verbose=False)
+        assert out is es
+        assert len(es.history) == 2
+        rec = es.history[0]
+        for k in ("generation", "reward_max", "reward_mean", "reward_min",
+                  "best_reward", "env_steps", "env_steps_per_sec", "grad_norm"):
+            assert k in rec, k
+
+    def test_policy_and_best_policy_exposed(self):
+        es = _make_es()
+        es.train(3, verbose=False)
+        p = es.policy
+        assert "dense_0" in p  # flax param tree
+        assert es.best_reward > -np.inf
+        bp = es.best_policy
+        assert jax.tree_util.tree_structure(p) == jax.tree_util.tree_structure(bp)
+
+    def test_best_reward_monotone(self):
+        es = _make_es()
+        bests = []
+        for _ in range(3):
+            es.train(1, verbose=False)
+            bests.append(es.best_reward)
+        assert bests == sorted(bests)
+
+    def test_predict(self):
+        es = _make_es()
+        out = es.predict(np.zeros(4, dtype=np.float32))
+        assert out.shape == (2,)
+        out_best = es.predict(np.zeros(4, dtype=np.float32), use_best=True)
+        assert out_best.shape == (2,)
+
+    def test_continuous_env(self):
+        es = _make_es(
+            policy_kwargs={"action_dim": 1, "hidden": (16,), "discrete": False,
+                           "action_scale": 2.0},
+            agent_kwargs={"env": Pendulum(), "horizon": 50},
+        )
+        es.train(2, verbose=False)
+        assert len(es.history) == 2
+        # pendulum rewards are negative costs
+        assert es.history[0]["reward_max"] <= 0.0
+
+    def test_n_proc_accepted_for_parity(self):
+        es = _make_es()
+        es.train(1, n_proc=4, verbose=False)  # must not raise
+        assert len(es.history) == 1
+
+    def test_optimizer_instance_accepted(self):
+        es = _make_es(optimizer=optax.sgd(1e-2), optimizer_kwargs={})
+        es.train(1, verbose=False)
+        assert len(es.history) == 1
+
+    def test_log_fn_hook(self):
+        seen = []
+        es = _make_es()
+        es.train(2, log_fn=seen.append)
+        assert len(seen) == 2
+
+
+class TestVBN:
+    def test_vbn_policy_trains_and_stats_frozen(self):
+        es = _make_es(
+            policy_kwargs={"action_dim": 2, "hidden": (16,), "use_vbn": True},
+        )
+        stats_before = jax.tree_util.tree_map(
+            np.asarray, es._frozen["vbn_stats"]
+        )
+        es.train(2, verbose=False)
+        stats_after = jax.tree_util.tree_map(np.asarray, es._frozen["vbn_stats"])
+        for a, b in zip(
+            jax.tree_util.tree_leaves(stats_before),
+            jax.tree_util.tree_leaves(stats_after),
+        ):
+            np.testing.assert_array_equal(a, b)
+
+    def test_vbn_stats_not_in_perturbed_params(self):
+        es = _make_es(
+            policy_kwargs={"action_dim": 2, "hidden": (16,), "use_vbn": True},
+        )
+        # the ES parameter vector must contain ONLY the 'params' collection:
+        # scale/bias (affine) are learned, mean/var (stats) are not
+        flat_names = jax.tree_util.tree_leaves_with_path(es.policy)
+        names = ["/".join(str(p) for p in path) for path, _ in flat_names]
+        assert not any("mean" in n or "var" in n for n in names)
+        assert any("vbn_0" in n for n in names)  # affine present

@@ -1,0 +1,177 @@
+"""Device-native env parity (vs gymnasium oracles) and rollout-scan tests."""
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from estorch_tpu.envs import (
+    CartPole,
+    MountainCarContinuous,
+    Pendulum,
+    make_population_rollout,
+    make_rollout,
+)
+
+
+def _drive_gym(env_id, set_state, actions, read_obs):
+    """Step a gymnasium env through a fixed action sequence from a set state."""
+    genv = gym.make(env_id)
+    genv.reset(seed=0)
+    set_state(genv.unwrapped)
+    traj = []
+    for a in actions:
+        obs, r, term, trunc, _ = genv.step(a)
+        traj.append((read_obs(genv.unwrapped, obs), float(r), bool(term)))
+        if term or trunc:
+            break
+    genv.close()
+    return traj
+
+
+class TestCartPoleParity:
+    def test_step_for_step_vs_gymnasium(self):
+        start = np.array([0.01, -0.02, 0.03, 0.015], dtype=np.float64)
+        actions = [1, 1, 0, 1, 0, 0, 1, 1, 1, 0, 1, 0, 1, 1, 0, 0, 0, 1, 1, 1]
+
+        def set_state(u):
+            u.state = start.copy()
+
+        gym_traj = _drive_gym("CartPole-v1", set_state, actions, lambda u, o: np.array(u.state))
+
+        env = CartPole()
+        state = jnp.array(start, dtype=jnp.float32)
+        for i, ((gobs, grew, gterm), a) in enumerate(zip(gym_traj, actions)):
+            state, obs, rew, done = env.step(state, jnp.int32(a))
+            np.testing.assert_allclose(np.asarray(obs), gobs, rtol=1e-5, atol=1e-6,
+                                       err_msg=f"diverged at step {i}")
+            assert float(rew) == grew
+            assert bool(done) == gterm
+
+    def test_termination_bounds(self):
+        env = CartPole()
+        st = jnp.array([2.5, 0.0, 0.0, 0.0])  # |x| beyond threshold after step
+        _, _, _, done = env.step(st, jnp.int32(0))
+        assert bool(done)
+
+    def test_reset_range(self):
+        env = CartPole()
+        st, obs = env.reset(jax.random.key(0))
+        assert np.all(np.abs(np.asarray(st)) <= 0.05)
+        np.testing.assert_array_equal(np.asarray(st), np.asarray(obs))
+
+
+class TestPendulumParity:
+    def test_step_for_step_vs_gymnasium(self):
+        start = np.array([0.7, -0.3], dtype=np.float64)  # (theta, thdot)
+        actions = [np.array([0.5]), np.array([-1.2]), np.array([2.5]), np.array([0.0]),
+                   np.array([-2.5]), np.array([1.0])]
+
+        def set_state(u):
+            u.state = start.copy()
+
+        gym_traj = _drive_gym("Pendulum-v1", set_state, actions,
+                              lambda u, o: np.asarray(o, dtype=np.float64))
+
+        env = Pendulum()
+        state = jnp.array(start, dtype=jnp.float32)
+        for i, ((gobs, grew, _), a) in enumerate(zip(gym_traj, actions)):
+            state, obs, rew, done = env.step(state, jnp.array(a, dtype=jnp.float32))
+            np.testing.assert_allclose(np.asarray(obs), gobs, rtol=1e-4, atol=1e-5,
+                                       err_msg=f"diverged at step {i}")
+            np.testing.assert_allclose(float(rew), grew, rtol=1e-4, atol=1e-5)
+
+
+class TestMountainCarParity:
+    def test_step_for_step_vs_gymnasium(self):
+        start = np.array([-0.5, 0.0], dtype=np.float64)
+        actions = [np.array([1.0]), np.array([1.0]), np.array([-0.3]), np.array([0.8])]
+
+        def set_state(u):
+            u.state = start.copy()
+
+        gym_traj = _drive_gym("MountainCarContinuous-v0", set_state, actions,
+                              lambda u, o: np.asarray(o, dtype=np.float64))
+
+        env = MountainCarContinuous()
+        state = jnp.array(start, dtype=jnp.float32)
+        for i, ((gobs, grew, _), a) in enumerate(zip(gym_traj, actions)):
+            state, obs, rew, done = env.step(state, jnp.array(a, dtype=jnp.float32))
+            np.testing.assert_allclose(np.asarray(obs), gobs, rtol=1e-4, atol=1e-5,
+                                       err_msg=f"diverged at step {i}")
+            np.testing.assert_allclose(float(rew), grew, rtol=1e-4, atol=1e-5)
+
+
+class TestRolloutScan:
+    def _zero_policy(self, params, obs):
+        # always pushes left (action 0 for discrete argmax of [1, 0])
+        return jnp.array([1.0, 0.0])
+
+    def test_done_masking_freezes_reward(self):
+        """Always-left on CartPole falls quickly; return == alive steps, < horizon."""
+        env = CartPole()
+        rollout = make_rollout(env, self._zero_policy, horizon=200)
+        res = jax.jit(rollout)({}, jax.random.key(0))
+        assert 1 <= int(res.steps) < 200
+        # CartPole gives +1 per alive step, so return must equal steps
+        assert float(res.total_reward) == float(res.steps)
+
+    def test_rollout_matches_python_loop(self):
+        """Scan result == plain Python loop over env.step with same policy."""
+        env = CartPole()
+        horizon = 50
+        rollout = make_rollout(env, self._zero_policy, horizon)
+        key = jax.random.key(3)
+        res = rollout({}, key)
+
+        state, obs = env.reset(key)
+        total, steps, done = 0.0, 0, False
+        for _ in range(horizon):
+            if done:
+                break
+            action = jnp.argmax(self._zero_policy({}, obs))
+            state, obs, r, d = env.step(state, action)
+            total += float(r)
+            steps += 1
+            done = bool(d)
+        assert float(res.total_reward) == pytest.approx(total)
+        assert int(res.steps) == steps
+
+    def test_bc_reads_final_alive_frame(self):
+        """BC must come from the state at termination, not the horizon end."""
+        env = CartPole()
+        horizon = 300
+        rollout = make_rollout(env, self._zero_policy, horizon)
+        key = jax.random.key(1)
+        res = rollout({}, key)
+
+        state, obs = env.reset(key)
+        done = False
+        for _ in range(horizon):
+            if done:
+                break
+            action = jnp.argmax(self._zero_policy({}, obs))
+            state, obs, r, d = env.step(state, action)
+            done = bool(d)
+        expected_bc = np.asarray(env.behavior(state, obs))
+        np.testing.assert_allclose(np.asarray(res.bc), expected_bc, rtol=1e-5, atol=1e-6)
+
+    def test_population_vmap_shapes(self):
+        env = Pendulum()
+        n = 8
+
+        def policy(params, obs):
+            return jnp.tanh(params["w"] @ obs) * 2.0
+
+        pop_rollout = make_population_rollout(env, policy, horizon=20)
+        params = {"w": jax.random.normal(jax.random.key(0), (n, 1, 3))}
+        keys = jax.random.split(jax.random.key(1), n)
+        res = jax.jit(pop_rollout)(params, keys)
+        assert res.total_reward.shape == (n,)
+        assert res.bc.shape == (n, env.bc_dim)
+        assert res.steps.shape == (n,)
+        # pendulum never terminates: all members run the full horizon
+        assert np.all(np.asarray(res.steps) == 20)
+        # different params must give different returns
+        assert len(set(np.asarray(res.total_reward).round(4).tolist())) > 1
