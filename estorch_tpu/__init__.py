@@ -15,12 +15,16 @@ classes are re-exported here as they land:
 __version__ = "0.1.0"
 
 from . import envs, models, ops, parallel  # noqa: F401
-from .algo import ES
+from .algo import ES, NS_ES, NSR_ES, NSRA_ES, NoveltyArchive
 from .envs.agent import JaxAgent
 from .models import MLPPolicy, NatureCNN, VirtualBatchNorm
 
 __all__ = [
     "ES",
+    "NS_ES",
+    "NSR_ES",
+    "NSRA_ES",
+    "NoveltyArchive",
     "JaxAgent",
     "MLPPolicy",
     "NatureCNN",

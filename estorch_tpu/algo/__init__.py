@@ -1,3 +1,5 @@
+from .archive import NoveltyArchive
 from .es import ES
+from .nses import NS_ES, NSR_ES, NSRA_ES
 
-__all__ = ["ES"]
+__all__ = ["ES", "NS_ES", "NSR_ES", "NSRA_ES", "NoveltyArchive"]

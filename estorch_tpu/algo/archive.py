@@ -1,0 +1,76 @@
+"""Novelty archive + k-NN novelty (Conti et al. 2018, NS-ES family).
+
+Reference: the archive of behavior characterizations and the mean-k-NN
+novelty inside ``estorch/estorch.py`` class ``NS_ES`` (SURVEY.md §2 item 3).
+
+Stays HOST-side on purpose (BASELINE.json north star: "the NS-ES / NSR-ES
+novelty archive and behavior-characterization k-NN stay host-side but consume
+device-gathered BCs"): the archive is tiny (one BC per generation), grows
+dynamically — a shape XLA hates — and the k-NN over it is O(|archive|·pop)
+flops, noise compared to the rollouts.  BCs arrive as one device->host
+transfer of the already-all-gathered (population, bc_dim) array.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class NoveltyArchive:
+    """Append-only store of behavior characterizations with mean-k-NN novelty."""
+
+    def __init__(self, k: int = 10, bc_dim: int | None = None):
+        self.k = int(k)
+        self.bc_dim = bc_dim
+        self._bcs: list[np.ndarray] = []
+
+    def __len__(self) -> int:
+        return len(self._bcs)
+
+    @property
+    def bcs(self) -> np.ndarray:
+        if not self._bcs:
+            return np.zeros((0, self.bc_dim or 0), dtype=np.float32)
+        return np.stack(self._bcs)
+
+    def add(self, bc) -> None:
+        bc = np.asarray(bc, dtype=np.float32).reshape(-1)
+        if self.bc_dim is None:
+            self.bc_dim = bc.shape[0]
+        elif bc.shape[0] != self.bc_dim:
+            raise ValueError(f"BC dim {bc.shape[0]} != archive dim {self.bc_dim}")
+        self._bcs.append(bc)
+
+    def novelty(self, bcs) -> np.ndarray:
+        """Mean distance to the k nearest archived BCs, per query row.
+
+        ``bcs``: (n, bc_dim) or (bc_dim,).  With an empty archive every
+        query is maximally novel — returns ones (any positive constant works:
+        only relative novelty matters for selection and ranking).
+        """
+        q = np.asarray(bcs, dtype=np.float32)
+        single = q.ndim == 1
+        q = np.atleast_2d(q)
+        if not self._bcs:
+            out = np.ones(q.shape[0], dtype=np.float32)
+            return out[0] if single else out
+        a = self.bcs  # (m, d)
+        # pairwise Euclidean distances, (n, m)
+        d2 = ((q[:, None, :] - a[None, :, :]) ** 2).sum(-1)
+        d = np.sqrt(np.maximum(d2, 0.0))
+        k = min(self.k, d.shape[1])
+        part = np.partition(d, k - 1, axis=1)[:, :k]
+        out = part.mean(axis=1).astype(np.float32)
+        return out[0] if single else out
+
+    def state_dict(self) -> dict:
+        """For checkpointing (utils/checkpoint.py)."""
+        return {"k": self.k, "bc_dim": self.bc_dim, "bcs": self.bcs}
+
+    @classmethod
+    def from_state_dict(cls, d: dict) -> "NoveltyArchive":
+        bc_dim = d.get("bc_dim")
+        ar = cls(k=int(d["k"]), bc_dim=None if bc_dim is None else int(bc_dim))
+        for row in np.asarray(d["bcs"]):
+            ar.add(row)
+        return ar

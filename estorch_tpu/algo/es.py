@@ -172,39 +172,57 @@ class ES:
             jax.block_until_ready(self.state.params_flat)
             dt = time.perf_counter() - t0
 
-            gen_best = float(fitness.max())
-            if gen_best > self.best_reward:
-                self.best_reward = gen_best
-                idx = int(fitness.argmax())
-                self._best_flat = np.asarray(
-                    self.engine.member_params(prev_state, idx)
-                )
-
-            steps = int(metrics["steps"])
-            record = {
-                "generation": self.generation,
-                "reward_max": gen_best,
-                "reward_mean": float(fitness.mean()),
-                "reward_min": float(fitness.min()),
-                "best_reward": self.best_reward,
-                "env_steps": steps,
-                "env_steps_per_sec": steps / dt if dt > 0 else 0.0,
-                "grad_norm": float(np.asarray(metrics["grad_norm"])),
-                "wall_time_s": dt,
-            }
-            self.history.append(record)
-            self.generation += 1
-            if log_fn is not None:
-                log_fn(record)
-            elif verbose:
-                print(
-                    f"gen {record['generation']:4d}  "
-                    f"max {record['reward_max']:9.2f}  "
-                    f"mean {record['reward_mean']:9.2f}  "
-                    f"best {record['best_reward']:9.2f}  "
-                    f"steps/s {record['env_steps_per_sec']:,.0f}"
-                )
+            record = self._base_record(
+                prev_state, fitness, int(metrics["steps"]),
+                float(np.asarray(metrics["grad_norm"])), dt,
+            )
+            self._emit_record(record, log_fn, verbose)
         return self
+
+    # ------------------------------------------- shared generation plumbing
+
+    def _track_best(self, prev_state, fitness: np.ndarray) -> tuple[float, bool]:
+        """Best-member snapshot (reference: es.best_policy/best_reward).
+        Returns (generation max, whether a new best was set)."""
+        gen_best = float(fitness.max())
+        improved = gen_best > self.best_reward
+        if improved:
+            self.best_reward = gen_best
+            idx = int(fitness.argmax())
+            self._best_flat = np.asarray(self.engine.member_params(prev_state, idx))
+        return gen_best, improved
+
+    def _base_record(self, prev_state, fitness, steps, grad_norm, dt) -> dict:
+        gen_best, improved = self._track_best(prev_state, fitness)
+        return {
+            "generation": self.generation,
+            "reward_max": gen_best,
+            "reward_mean": float(fitness.mean()),
+            "reward_min": float(fitness.min()),
+            "best_reward": self.best_reward,
+            "improved_best": improved,
+            "env_steps": steps,
+            "env_steps_per_sec": steps / dt if dt > 0 else 0.0,
+            "grad_norm": grad_norm,
+            "wall_time_s": dt,
+        }
+
+    def _emit_record(self, record: dict, log_fn, verbose: bool) -> None:
+        self.history.append(record)
+        self.generation += 1
+        if log_fn is not None:
+            log_fn(record)
+        elif verbose:
+            print(self._format_record(record))
+
+    def _format_record(self, r: dict) -> str:
+        return (
+            f"gen {r['generation']:4d}  "
+            f"max {r['reward_max']:9.2f}  "
+            f"mean {r['reward_mean']:9.2f}  "
+            f"best {r['best_reward']:9.2f}  "
+            f"steps/s {r['env_steps_per_sec']:,.0f}"
+        )
 
     # ------------------------------------------------------------- inspection
 

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def compute_ranks(x: jax.Array) -> jax.Array:
@@ -40,6 +41,19 @@ def centered_rank(x: jax.Array) -> jax.Array:
         return jnp.zeros_like(x, dtype=jnp.float32)
     ranks = compute_ranks(x).astype(jnp.float32)
     return ranks / (n - 1) - 0.5
+
+
+def centered_rank_np(x) -> np.ndarray:
+    """NumPy twin of :func:`centered_rank` for host-side weighting (novelty
+    family): must match the device version bit-for-bit on tie-free input and
+    tie-behavior-for-tie-behavior otherwise (both use stable argsort)."""
+    x = np.asarray(x)
+    n = x.shape[0]
+    if n < 2:
+        return np.zeros_like(x, dtype=np.float32)
+    ranks = np.empty(n, dtype=np.int32)
+    ranks[np.argsort(x, kind="stable")] = np.arange(n, dtype=np.int32)
+    return (ranks.astype(np.float32) / (n - 1) - 0.5).astype(np.float32)
 
 
 def normalized_score(x: jax.Array) -> jax.Array:

@@ -290,6 +290,18 @@ class ESEngine:
         self._generation_step.lower(state).compile()
         return _time.perf_counter() - t0
 
+    def compile_split(self, state: ESState) -> float:
+        """AOT-compile the split-path programs (evaluate, apply_weights,
+        center eval) used by the novelty family; returns seconds spent."""
+        import time as _time
+
+        t0 = _time.perf_counter()
+        self._evaluate.lower(state).compile()
+        dummy_w = jnp.zeros((self.config.population_size,), jnp.float32)
+        self._apply_weights.lower(state, dummy_w).compile()
+        self._center_eval.lower(state).compile()
+        return _time.perf_counter() - t0
+
     def generation_step(self, state: ESState):
         """Fused ES generation: returns (new_state, metrics dict)."""
         return self._generation_step(state)

@@ -1,0 +1,43 @@
+"""Novelty-search ES on MountainCarContinuous — the deceptive-reward demo.
+
+Reference equivalent: the NS-ES example script (SURVEY.md §2 item 9) whose
+Agent.rollout returns ``(reward, bc)``.  Here the behavior characterization
+(final car position) is produced on-device by the env's ``behavior`` method;
+the archive and k-NN stay host-side (BASELINE.json north star).
+
+Run: python examples/novelty_es.py [ns|nsr|nsra]
+"""
+
+import sys
+
+import optax
+
+from estorch_tpu import NS_ES, NSR_ES, NSRA_ES, JaxAgent, MLPPolicy
+from estorch_tpu.envs import MountainCarContinuous
+
+ALGOS = {"ns": NS_ES, "nsr": NSR_ES, "nsra": NSRA_ES}
+
+
+def main(algo: str = "nsra"):
+    cls = ALGOS[algo]
+    extra = {"weight": 1.0} if cls is NSRA_ES else {}
+    es = cls(
+        policy=MLPPolicy,
+        agent=JaxAgent,
+        optimizer=optax.adam,
+        population_size=128,
+        sigma=0.05,
+        k=10,
+        meta_population_size=3,
+        policy_kwargs={"action_dim": 1, "hidden": (32, 32), "discrete": False},
+        agent_kwargs={"env": MountainCarContinuous(), "horizon": 500},
+        optimizer_kwargs={"learning_rate": 1e-2},
+        **extra,
+    )
+    es.train(n_steps=15)
+    print(f"\nbest reward: {es.best_reward:.2f}  archive size: {len(es.archive)}")
+    return es
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else "nsra")

@@ -1,0 +1,171 @@
+"""Novelty family tests: archive k-NN oracle, weight mixing, NSRA schedule,
+and the full NS/NSR/NSRA training loops (SURVEY.md §4)."""
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from estorch_tpu import NS_ES, NSR_ES, NSRA_ES, JaxAgent, MLPPolicy, NoveltyArchive
+from estorch_tpu.envs import CartPole
+from estorch_tpu.ops import centered_rank_np
+
+
+class TestArchive:
+    def test_knn_matches_bruteforce_oracle(self):
+        rng = np.random.RandomState(0)
+        ar = NoveltyArchive(k=3)
+        for _ in range(20):
+            ar.add(rng.randn(4))
+        queries = rng.randn(7, 4).astype(np.float32)
+        got = ar.novelty(queries)
+        # brute force oracle
+        a = ar.bcs
+        for i, q in enumerate(queries):
+            d = np.sort(np.linalg.norm(a - q, axis=1))
+            expected = d[:3].mean()
+            np.testing.assert_allclose(got[i], expected, rtol=1e-5)
+
+    def test_empty_archive_is_uniformly_novel(self):
+        ar = NoveltyArchive(k=5)
+        out = ar.novelty(np.random.randn(4, 2))
+        np.testing.assert_array_equal(out, np.ones(4, dtype=np.float32))
+
+    def test_k_larger_than_archive(self):
+        ar = NoveltyArchive(k=10)
+        ar.add(np.zeros(2))
+        ar.add(np.ones(2))
+        # k=10 > 2 entries: averages over all available
+        out = ar.novelty(np.zeros(2))
+        expected = (0.0 + np.sqrt(2.0)) / 2
+        np.testing.assert_allclose(out, expected, rtol=1e-6)
+
+    def test_dim_mismatch_rejected(self):
+        ar = NoveltyArchive(k=2)
+        ar.add(np.zeros(3))
+        with pytest.raises(ValueError, match="dim"):
+            ar.add(np.zeros(4))
+
+    def test_single_query_returns_scalar(self):
+        ar = NoveltyArchive(k=2)
+        ar.add(np.zeros(2))
+        out = ar.novelty(np.ones(2))
+        assert np.ndim(out) == 0 or out.shape == ()
+
+    def test_state_dict_roundtrip(self):
+        ar = NoveltyArchive(k=4)
+        for i in range(5):
+            ar.add(np.full(3, float(i)))
+        ar2 = NoveltyArchive.from_state_dict(ar.state_dict())
+        assert len(ar2) == 5
+        q = np.random.randn(2, 3)
+        np.testing.assert_allclose(ar.novelty(q), ar2.novelty(q))
+
+
+class TestWeightMixing:
+    fitness = np.array([3.0, 1.0, 2.0, 5.0], dtype=np.float32)
+    novelty = np.array([0.1, 0.9, 0.5, 0.2], dtype=np.float32)
+
+    def _mk(self, cls, **extra):
+        return cls(
+            MLPPolicy, JaxAgent, optax.adam,
+            population_size=16, sigma=0.1, seed=0,
+            policy_kwargs={"action_dim": 2, "hidden": (8,)},
+            agent_kwargs={"env": CartPole(), "horizon": 20},
+            optimizer_kwargs={"learning_rate": 1e-2},
+            table_size=1 << 16, meta_population_size=2,
+            **extra,
+        )
+
+    def test_ns_uses_novelty_only(self):
+        es = self._mk(NS_ES)
+        w = es._combine_weights(self.fitness, self.novelty)
+        np.testing.assert_array_equal(w, centered_rank_np(self.novelty))
+
+    def test_nsr_is_equal_mix(self):
+        es = self._mk(NSR_ES)
+        w = es._combine_weights(self.fitness, self.novelty)
+        expected = 0.5 * centered_rank_np(self.fitness) + 0.5 * centered_rank_np(self.novelty)
+        np.testing.assert_allclose(w, expected)
+
+    def test_nsra_respects_weight(self):
+        es = self._mk(NSRA_ES, weight=0.25)
+        w = es._combine_weights(self.fitness, self.novelty)
+        expected = 0.25 * centered_rank_np(self.fitness) + 0.75 * centered_rank_np(self.novelty)
+        np.testing.assert_allclose(w, expected)
+
+
+class TestNSRASchedule:
+    def test_w_rises_on_improvement_and_decays_on_stagnation(self):
+        es = TestWeightMixing()._mk(
+            NSRA_ES, weight=0.5, weight_delta=0.1, stagnation_patience=2
+        )
+        # improvement → w up
+        es._post_update({"improved_best": True})
+        assert es.weight == pytest.approx(0.6)
+        # two stagnant generations → one decay step
+        es._post_update({"improved_best": False})
+        assert es.weight == pytest.approx(0.6)
+        es._post_update({"improved_best": False})
+        assert es.weight == pytest.approx(0.5)
+        # bounds: repeated improvement pushes w up, capped at 1.0
+        for _ in range(30):
+            es._post_update({"improved_best": True})
+        assert es.weight == 1.0
+
+    def test_w_floor_at_zero(self):
+        es = TestWeightMixing()._mk(
+            NSRA_ES, weight=0.1, weight_delta=0.2, stagnation_patience=1
+        )
+        es._post_update({"improved_best": False})
+        assert es.weight == 0.0
+        es._post_update({"improved_best": False})
+        assert es.weight == 0.0
+
+
+class TestNoveltyTraining:
+    def _train(self, cls, **extra):
+        es = cls(
+            MLPPolicy, JaxAgent, optax.adam,
+            population_size=16, sigma=0.1, seed=1,
+            policy_kwargs={"action_dim": 2, "hidden": (8,)},
+            agent_kwargs={"env": CartPole(), "horizon": 50},
+            optimizer_kwargs={"learning_rate": 1e-2},
+            table_size=1 << 16, meta_population_size=2, k=3,
+            **extra,
+        )
+        es.train(3, verbose=False)
+        return es
+
+    def test_ns_es_trains_and_archive_grows(self):
+        es = self._train(NS_ES)
+        # archive: meta_population_size seeds + 1 per generation
+        assert len(es.archive) == 2 + 3
+        assert len(es.history) == 3
+        rec = es.history[-1]
+        for key in ("meta_index", "novelty_mean", "archive_size", "center_reward"):
+            assert key in rec
+
+    def test_nsr_es_trains(self):
+        es = self._train(NSR_ES)
+        assert len(es.history) == 3
+
+    def test_nsra_es_trains_and_logs_weight(self):
+        es = self._train(NSRA_ES, weight=0.8)
+        assert "nsra_weight" in es.history[-1]
+        assert 0.0 <= es.history[-1]["nsra_weight"] <= 1.0
+
+    def test_fixed_seed_determinism(self):
+        a = self._train(NS_ES)
+        b = self._train(NS_ES)
+        np.testing.assert_array_equal(
+            np.asarray(a.meta_states[0].params_flat),
+            np.asarray(b.meta_states[0].params_flat),
+        )
+        assert a.history[-1]["reward_mean"] == b.history[-1]["reward_mean"]
+
+    def test_meta_population_centers_start_distinct(self):
+        es = self._train(NS_ES)
+        p0 = np.asarray(es.meta_states[0].params_flat)
+        p1 = np.asarray(es.meta_states[1].params_flat)
+        assert not np.array_equal(p0, p1)
