@@ -1,0 +1,95 @@
+"""Benchmark: device-native ES generation throughput on the flagship config.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Metric: env-steps/sec/chip (BASELINE.json primary metric) for a full ES
+generation — noise-table perturbation, vmapped policy rollouts, centered
+ranks, psum'd rank-weighted update — on Pendulum (never terminates, so every
+scanned step is a real env step; no done-mask inflation) with a 64x64 MLP,
+population 4096, horizon 200: ~819k env steps per generation.
+
+vs_baseline: ratio against a reference-style estorch loop measured live on
+this host — per-member Python loop, torch CPU MLP forward per step,
+gymnasium Pendulum env.step — the architecture SURVEY.md §3.2/§3.3 documents
+(single process; the reference scales it by n_proc workers, so divide by
+core count for a per-core figure if comparing to the 720-core runs).
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def measure_tpu(population=4096, horizon=200, gens=5) -> float:
+    import jax
+    import optax
+
+    from estorch_tpu import ES, JaxAgent, MLPPolicy
+    from estorch_tpu.envs import Pendulum
+
+    es = ES(
+        policy=MLPPolicy,
+        agent=JaxAgent,
+        optimizer=optax.adam,
+        population_size=population,
+        sigma=0.05,
+        policy_kwargs={"action_dim": 1, "hidden": (64, 64), "discrete": False,
+                       "action_scale": 2.0},
+        agent_kwargs={"env": Pendulum(), "horizon": horizon},
+        optimizer_kwargs={"learning_rate": 1e-2},
+        eval_chunk=512,
+    )
+    es.train(1, verbose=False)  # warm-up generation (post-AOT sanity)
+    t0 = time.perf_counter()
+    es.train(gens, verbose=False)
+    dt = time.perf_counter() - t0
+    steps = sum(r["env_steps"] for r in es.history[-gens:])
+    n_chips = es.mesh.devices.size
+    return steps / dt / n_chips
+
+
+def measure_reference_style_baseline(budget_s=6.0) -> float:
+    """Single-process estorch-style loop: torch MLP + gymnasium Pendulum."""
+    import gymnasium as gym
+    import torch
+
+    policy = torch.nn.Sequential(
+        torch.nn.Linear(3, 64), torch.nn.Tanh(),
+        torch.nn.Linear(64, 64), torch.nn.Tanh(),
+        torch.nn.Linear(64, 1), torch.nn.Tanh(),
+    )
+    env = gym.make("Pendulum-v1")
+    obs, _ = env.reset(seed=0)
+    steps = 0
+    t0 = time.perf_counter()
+    with torch.no_grad():
+        while time.perf_counter() - t0 < budget_s:
+            for _ in range(200):
+                a = policy(torch.from_numpy(np.asarray(obs, dtype=np.float32)))
+                obs, r, term, trunc, _ = env.step(a.numpy() * 2.0)
+                steps += 1
+                if term or trunc:
+                    obs, _ = env.reset()
+    env.close()
+    return steps / (time.perf_counter() - t0)
+
+
+def main():
+    tpu_rate = measure_tpu()
+    base_rate = measure_reference_style_baseline()
+    print(
+        json.dumps(
+            {
+                "metric": "env_steps_per_sec_per_chip",
+                "value": round(tpu_rate, 1),
+                "unit": "env-steps/s/chip (Pendulum MLP64x64 pop4096 h200)",
+                "vs_baseline": round(tpu_rate / base_rate, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

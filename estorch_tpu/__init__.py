@@ -16,7 +16,7 @@ __version__ = "0.1.0"
 
 from . import envs, models, ops, parallel  # noqa: F401
 from .algo import ES, NS_ES, NSR_ES, NSRA_ES, NoveltyArchive
-from .envs.agent import JaxAgent
+from .envs.agent import JaxAgent, PooledAgent
 from .models import MLPPolicy, NatureCNN, VirtualBatchNorm
 
 __all__ = [
@@ -26,6 +26,7 @@ __all__ = [
     "NSRA_ES",
     "NoveltyArchive",
     "JaxAgent",
+    "PooledAgent",
     "MLPPolicy",
     "NatureCNN",
     "VirtualBatchNorm",

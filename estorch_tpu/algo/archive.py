@@ -55,8 +55,18 @@ class NoveltyArchive:
             out = np.ones(q.shape[0], dtype=np.float32)
             return out[0] if single else out
         a = self.bcs  # (m, d)
-        # pairwise Euclidean distances, (n, m)
-        d2 = ((q[:, None, :] - a[None, :, :]) ** 2).sum(-1)
+        # pairwise Euclidean distances, (n, m), via the matmul identity
+        # |q-a|² = |q|² + |a|² − 2 q·a — no (n, m, d) intermediate, so host
+        # memory stays O(n·m) even for pop-10k × multi-k-generation archives.
+        # Accumulated in float64: the identity cancels catastrophically in
+        # float32 when |q|,|a| are large and the true distance is small.
+        q64 = q.astype(np.float64)
+        a64 = a.astype(np.float64)
+        d2 = (
+            (q64**2).sum(1)[:, None]
+            + (a64**2).sum(1)[None, :]
+            - 2.0 * (q64 @ a64.T)
+        )
         d = np.sqrt(np.maximum(d2, 0.0))
         k = min(self.k, d.shape[1])
         part = np.partition(d, k - 1, axis=1)[:, :k]

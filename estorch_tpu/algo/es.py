@@ -57,8 +57,25 @@ def _as_optax(optimizer, optimizer_kwargs) -> optax.GradientTransformation:
     raise TypeError(f"optimizer must be an optax factory or GradientTransformation, got {optimizer!r}")
 
 
-def _instantiate(cls_or_obj, kwargs):
-    return cls_or_obj(**kwargs) if isinstance(cls_or_obj, type) else cls_or_obj
+def _is_jax_env(env) -> bool:
+    """A JaxEnv has pure reset/step plus the static-shape attributes of
+    envs/base.py — a gym env (which also has reset/step) does not."""
+    return env is not None and all(
+        hasattr(env, a)
+        for a in ("reset", "step", "obs_dim", "action_dim", "discrete", "bc_dim")
+    )
+
+
+def _instantiate(cls_or_obj, kwargs, what: str):
+    if isinstance(cls_or_obj, type):
+        return cls_or_obj(**kwargs)
+    if kwargs:
+        raise ValueError(
+            f"{what}_kwargs were given alongside an already-constructed "
+            f"{what} instance; they would be ignored: {kwargs}. Pass the "
+            f"class with {what}_kwargs, or the instance without them."
+        )
+    return cls_or_obj
 
 
 class ES:
@@ -87,20 +104,48 @@ class ES:
         self.sigma = sigma
         self.seed = seed
 
-        self.agent: JaxAgent = _instantiate(agent, dict(agent_kwargs or {}))
-        if not hasattr(self.agent, "env"):
+        self._policy_arg = policy
+        self._policy_kwargs = dict(policy_kwargs or {})
+        self._agent_arg = agent
+        self._agent_kwargs = dict(agent_kwargs or {})
+
+        self.agent = _instantiate(agent, dict(agent_kwargs or {}), "agent")
+        # Dispatch order matters: a reference-style Agent usually holds a
+        # `self.env` (a *gym* env) AND a rollout() — the rollout contract is
+        # the host marker, so it is checked first; `env` only routes to the
+        # device path when it is a JaxEnv (pure reset/step + static dims).
+        if hasattr(self.agent, "rollout"):
+            self.backend = "host"
+            self._init_host(
+                optimizer, dict(optimizer_kwargs or {}), table_size, device
+            )
+            self._post_engine_init()
+            return
+        if _is_jax_env(getattr(self.agent, "env", None)):
+            self.backend = "device"
+        elif hasattr(self.agent, "env_name"):
+            # pooled path: C++ envpool stepping + device-batched inference
+            self.backend = "pooled"
+            self._init_pooled(
+                policy, dict(policy_kwargs or {}), optimizer,
+                dict(optimizer_kwargs or {}), table_size, eval_chunk,
+                grad_chunk, weight_decay, mesh, device, vbn_batch,
+            )
+            self._post_engine_init()
+            return
+        else:
             raise TypeError(
-                "device-path agent must be a JaxAgent (wrap your JaxEnv in "
-                "estorch_tpu.JaxAgent); reference-style host agents with a "
-                "rollout() method use the host backend — see "
-                "estorch_tpu/envs/host_pool.py"
+                "agent must be a JaxAgent wrapping a JaxEnv (device path), a "
+                "PooledAgent naming a native envpool env (pooled path), or a "
+                "reference-style agent exposing rollout(policy) (host path)"
             )
         self.env = self.agent.env
-        self.module = _instantiate(policy, dict(policy_kwargs or {}))
+        self.module = _instantiate(policy, dict(policy_kwargs or {}), "policy")
 
         # --- init policy variables from a real observation shape
         init_key, state_key, vbn_key = jax.random.split(jax.random.PRNGKey(seed), 3)
         _, obs0 = self.env.reset(jax.random.PRNGKey(0))
+        self._obs0 = obs0
         variables = self.module.init(init_key, obs0)
         params = variables["params"]
         self._frozen = {k: v for k, v in variables.items() if k != "params"}
@@ -138,12 +183,145 @@ class ES:
             self.optimizer, self.config, self.mesh,
         )
         self.state = self.engine.init_state(flat, state_key)
+        self._post_engine_init()
 
+    def _post_engine_init(self):
         self.best_reward = -np.inf
         self._best_flat: np.ndarray | None = None
+        self._best_policy_host = None
         self.history: list[dict] = []
         self.generation = 0
         self.compile_time_s: float | None = None
+
+    # --------------------------------------------------------- pooled backend
+
+    def _init_pooled(
+        self, policy, policy_kwargs, optimizer, optimizer_kwargs,
+        table_size, eval_chunk, grad_chunk, weight_decay, mesh, device, vbn_batch,
+    ):
+        from ..envs.native_pool import NativeEnvPool
+        from ..parallel.pooled import PooledEngine
+
+        probe = NativeEnvPool(self.agent.env_name, n_envs=1, n_threads=1)
+        obs_dim = probe.obs_dim
+        probe.close()
+
+        self.env = None
+        self.module = _instantiate(policy, policy_kwargs, "policy")
+        init_key, state_key, vbn_key = jax.random.split(jax.random.PRNGKey(self.seed), 3)
+        del vbn_key
+        obs0 = jnp.zeros((obs_dim,), jnp.float32)
+        self._obs0 = obs0
+        variables = self.module.init(init_key, obs0)
+        params = variables["params"]
+        self._frozen = {k: v for k, v in variables.items() if k != "params"}
+
+        if "vbn_stats" in variables:
+            ref_batch = self._pooled_reference_batch(vbn_batch)
+            self._frozen["vbn_stats"] = capture_reference_stats(
+                self.module, variables, ref_batch
+            )
+
+        frozen = self._frozen
+
+        def policy_apply(p, obs):
+            return self.module.apply({"params": p, **frozen}, obs)
+
+        self._policy_apply = policy_apply
+        flat, self._spec = make_param_spec(params)
+        self.table = make_noise_table(table_size, seed=self.seed)
+        self.optimizer = _as_optax(optimizer, optimizer_kwargs)
+        self.mesh = mesh if mesh is not None else population_mesh(
+            [device] if device is not None and not isinstance(device, (list, tuple)) else device
+        )
+        self.config = EngineConfig(
+            population_size=self.population_size,
+            sigma=self.sigma,
+            horizon=int(self.agent.horizon),
+            eval_chunk=eval_chunk,
+            grad_chunk=grad_chunk,
+            weight_decay=weight_decay,
+        )
+        self.engine = PooledEngine(
+            self.agent.env_name, policy_apply, self._spec, self.table,
+            self.optimizer, self.config, self.mesh,
+            n_threads=self.agent.n_threads, seed=self.seed,
+        )
+        self.state = self.engine.init_state(flat, state_key)
+
+    def _pooled_reference_batch(self, n: int):
+        """Random-action observations from the pool for VBN statistics."""
+        from ..envs.native_pool import NativeEnvPool
+
+        pool = NativeEnvPool(self.agent.env_name, n_envs=max(1, n // 4))
+        rng = np.random.default_rng(self.seed)
+        frames = [pool.reset()]
+        for _ in range(4):
+            if pool.discrete:
+                acts = rng.integers(0, 2, (pool.n_envs, 1)).astype(np.float32)
+            else:
+                acts = rng.uniform(-1, 1, (pool.n_envs, pool.act_dim)).astype(np.float32)
+            obs, _, _ = pool.step(acts)
+            frames.append(obs)
+        pool.close()
+        batch = np.concatenate(frames, axis=0)[:n]
+        return jnp.asarray(batch)
+
+    # ----------------------------------------------------------- host backend
+
+    def _init_host(self, optimizer, optimizer_kwargs, table_size, device):
+        """Reference-parity path: torch policy + host Agent.rollout workers."""
+        import copy
+
+        from ..host.engine import HostEngine
+
+        policy_arg, policy_kwargs = self._policy_arg, self._policy_kwargs
+        agent_arg, agent_kwargs = self._agent_arg, self._agent_kwargs
+
+        if isinstance(policy_arg, type):
+            def policy_factory():
+                return policy_arg(**policy_kwargs)
+        else:
+            if policy_kwargs:
+                raise ValueError(
+                    "policy_kwargs were given alongside a policy instance; "
+                    "pass the class, or the instance without kwargs"
+                )
+            def policy_factory():
+                return copy.deepcopy(policy_arg)
+
+        if isinstance(agent_arg, type):
+            def agent_factory():
+                return agent_arg(**agent_kwargs)
+        else:
+            # shared instance: workers would race on it — engine caps at the
+            # instances it gets; we pin n_proc to 1 in train() via this flag
+            def agent_factory():
+                return agent_arg
+        self._agent_is_shared_instance = not isinstance(agent_arg, type)
+
+        self.env = None
+        self.module = None
+        # torch module init draws from torch's global RNG; pin it so two ES
+        # constructions with the same seed get identical master policies
+        # (the device path gets this for free from jax.random keys)
+        import torch
+
+        torch.manual_seed(self.seed)
+        self.engine = HostEngine(
+            policy_factory=policy_factory,
+            agent_factory=agent_factory,
+            optimizer_ctor=optimizer,
+            optimizer_kwargs=optimizer_kwargs,
+            population_size=self.population_size,
+            sigma=self.sigma,
+            table_size=table_size,
+            seed=self.seed,
+            n_proc=1,
+            device="cpu" if device is None else str(device),
+            prototype_agent=self.agent,  # dispatch probe doubles as worker 0
+        )
+        self.state = self.engine.init_state()
 
     # ------------------------------------------------------------------ train
 
@@ -156,10 +334,12 @@ class ES:
     ):
         """Run ``n_steps`` generations (reference: ``es.train(n_steps, n_proc)``).
 
-        ``n_proc`` is accepted for API parity; device-path parallelism comes
-        from the mesh (SURVEY.md §2 'Parallelism strategies').
+        On the device path ``n_proc`` is accepted for API parity only (the
+        mesh already parallelizes — SURVEY.md §2 'Parallelism strategies');
+        on the host path it sizes the worker pool, exactly like the
+        reference's ``train(n_steps, n_proc)``.
         """
-        del n_proc
+        self._setup_n_proc(n_proc)
         if self.compile_time_s is None:
             # AOT-compile outside the timed loop so env_steps_per_sec (the
             # primary metric) never includes XLA trace+compile time
@@ -169,7 +349,8 @@ class ES:
             prev_state = self.state
             self.state, metrics = self.engine.generation_step(prev_state)
             fitness = np.asarray(metrics["fitness"])
-            jax.block_until_ready(self.state.params_flat)
+            if self.backend != "host":
+                jax.block_until_ready(self.state.params_flat)
             dt = time.perf_counter() - t0
 
             record = self._base_record(
@@ -178,6 +359,21 @@ class ES:
             )
             self._emit_record(record, log_fn, verbose)
         return self
+
+    def _setup_n_proc(self, n_proc: int) -> None:
+        if self.backend != "host":
+            return
+        if getattr(self, "_agent_is_shared_instance", False) and n_proc > 1:
+            import warnings
+
+            warnings.warn(
+                "agent was passed as a shared instance; host workers would "
+                "race on it — running with n_proc=1. Pass the agent CLASS "
+                "(with agent_kwargs) to parallelize.",
+                stacklevel=3,
+            )
+            n_proc = 1
+        self.engine.set_n_proc(n_proc)
 
     # ------------------------------------------- shared generation plumbing
 
@@ -228,12 +424,22 @@ class ES:
 
     @property
     def policy(self):
-        """Current center policy parameters as a pytree (reference: es.policy)."""
+        """Current center policy (reference: es.policy).
+
+        Device path: the flax params pytree.  Host path: the torch master
+        module loaded with the current center parameters — exactly the
+        reference's ``es.policy``.
+        """
+        if self.backend == "host":
+            self.engine._load(self.engine.master, self.state.params_flat)
+            return self.engine.master
         return self._spec.unravel(self.state.params_flat)
 
     @property
     def policy_variables(self):
         """Full flax variables for ``module.apply`` (params + frozen stats)."""
+        if self.backend == "host":
+            raise AttributeError("policy_variables is device-path only; use .policy")
         return {"params": self.policy, **self._frozen}
 
     @property
@@ -241,13 +447,26 @@ class ES:
         """Best-ever member's parameters (reference: es.best_policy)."""
         if self._best_flat is None:
             return self.policy
+        if self.backend == "host":
+            if self._best_policy_host is None:
+                self._best_policy_host = self.engine.policy_factory()
+            self.engine._load(self._best_policy_host, self._best_flat)
+            return self._best_policy_host
         return self._spec.unravel(jnp.asarray(self._best_flat))
 
     @property
     def best_policy_variables(self):
+        if self.backend == "host":
+            raise AttributeError("best_policy_variables is device-path only; use .best_policy")
         return {"params": self.best_policy, **self._frozen}
 
     def predict(self, obs, use_best: bool = False):
         """Policy forward pass with current (or best) parameters."""
+        if self.backend == "host":
+            import torch
+
+            policy = self.best_policy if use_best else self.policy
+            with torch.no_grad():
+                return policy(torch.as_tensor(np.asarray(obs), dtype=torch.float32))
         p = self.best_policy if use_best else self.policy
         return self._policy_apply(p, obs)

@@ -48,22 +48,15 @@ class NS_ES(ES):
         super().__init__(policy, agent, optimizer, **kwargs)
         self.k = k
         self.meta_population_size = int(meta_population_size)
-        self.archive = NoveltyArchive(k=k, bc_dim=int(self.env.bc_dim))
+        bc_dim = getattr(self.engine, "bc_dim", None) or None
+        self.archive = NoveltyArchive(k=k, bc_dim=bc_dim)
 
         # meta-population: M independent centers sharing one engine/noise table.
-        # state[0] reuses the base-class init; the rest re-init the module with
-        # folded keys so the centers start distinct.
-        init_key = jax.random.PRNGKey(self.seed)
-        _, obs0 = self.env.reset(jax.random.PRNGKey(0))
+        # state[0] reuses the base-class init; the rest start from fresh
+        # policy initializations so the centers are distinct.
         self.meta_states = [self.state]
         for m in range(1, self.meta_population_size):
-            vs = self.module.init(jax.random.fold_in(init_key, 1000 + m), obs0)
-            flat = self._spec.flatten(vs["params"])
-            self.meta_states.append(
-                self.engine.init_state(
-                    flat, jax.random.fold_in(jax.random.PRNGKey(self.seed), 2000 + m)
-                )
-            )
+            self.meta_states.append(self._new_center_state(m))
         # center BC per meta-individual (seeds the archive, reference
         # behavior: the initial centers' BCs are the first archive entries)
         self._center_bc = []
@@ -73,6 +66,27 @@ class NS_ES(ES):
             self._center_bc.append(bc)
             self.archive.add(bc)
         self._rng = np.random.default_rng(self.seed)
+
+    def _new_center_state(self, m: int):
+        """Fresh meta-individual center: re-initialized policy + own RNG stream."""
+        if self.backend == "host":
+            fresh = self.engine.policy_factory()
+            import torch
+
+            with torch.no_grad():
+                flat = (
+                    torch.nn.utils.parameters_to_vector(fresh.parameters())
+                    .cpu()
+                    .numpy()
+                )
+            return self.engine.init_state(flat, key=self.seed + 7919 * m)
+        vs = self.module.init(
+            jax.random.fold_in(jax.random.PRNGKey(self.seed), 1000 + m), self._obs0
+        )
+        flat = self._spec.flatten(vs["params"])
+        return self.engine.init_state(
+            flat, jax.random.fold_in(jax.random.PRNGKey(self.seed), 2000 + m)
+        )
 
     # ---- variant-specific weighting -------------------------------------
 
@@ -102,7 +116,7 @@ class NS_ES(ES):
         log_fn: Callable[[dict], None] | None = None,
         verbose: bool = True,
     ):
-        del n_proc
+        self._setup_n_proc(n_proc)
         if self.compile_time_s is None:
             # AOT-compile the split-path programs outside the timed loop,
             # same invariant as ES.train for the primary metric
@@ -116,8 +130,10 @@ class NS_ES(ES):
             fitness = np.asarray(ev.fitness)
             novelty = self.archive.novelty(np.asarray(ev.bc))
             weights = self._combine_weights(fitness, novelty)
+            if self.backend == "device":
+                weights = jax.numpy.asarray(weights)
 
-            new_st, gnorm = self.engine.apply_weights(st, jax.numpy.asarray(weights))
+            new_st, gnorm = self.engine.apply_weights(st, weights)
             self.meta_states[m] = new_st
             if m == 0:
                 self.state = new_st  # keep base-class accessors on meta[0]
@@ -127,7 +143,8 @@ class NS_ES(ES):
             cbc = np.asarray(cres.bc)
             self.archive.add(cbc)
             self._center_bc[m] = cbc
-            jax.block_until_ready(new_st.params_flat)
+            if self.backend != "host":
+                jax.block_until_ready(new_st.params_flat)
             dt = time.perf_counter() - t0
 
             record = self._base_record(

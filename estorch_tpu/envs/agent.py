@@ -18,6 +18,20 @@ import jax.numpy as jnp
 
 
 @dataclasses.dataclass
+class PooledAgent:
+    """Pooled-backend agent: names a C++ envpool env (envs/native_pool.py).
+
+    The population's envs step in native threads while the device runs one
+    batched policy forward per env step (parallel/pooled.py) — the execution
+    model for host-only envs (reference's Gym/Atari configs).
+    """
+
+    env_name: str
+    horizon: int = 500
+    n_threads: int = 0
+
+
+@dataclasses.dataclass
 class JaxAgent:
     """Device-native agent: wraps a pure-JAX env for the compiled path.
 

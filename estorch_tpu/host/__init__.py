@@ -1,0 +1,3 @@
+from .engine import HostEngine, HostEvalResult, HostRolloutResult, HostState
+
+__all__ = ["HostEngine", "HostEvalResult", "HostRolloutResult", "HostState"]

@@ -1,0 +1,318 @@
+"""HostEngine — the reference-parity execution backend.
+
+The reference's entire runtime is this path: per-member Python loop calling
+a user-supplied ``Agent.rollout(policy)`` (policy = a ``torch.nn.Module``),
+fitness gathered, master applies a torch-optimizer step (SURVEY.md §3.2-3.3).
+estorch_tpu keeps that contract alive so reference users' Agents, torch
+policies, and torch optimizers run unchanged:
+
+    es = ES(TorchPolicy, GymAgent, torch.optim.Adam, ...)
+    es.train(n_steps, n_proc=8)
+
+Differences from the reference runtime (deliberate upgrades):
+- ``n_proc`` maps to a thread pool with per-worker scratch policy + agent
+  instances instead of ``torch.distributed`` processes — no MPI, no gloo,
+  no parameter broadcast; gym/mujoco/torch release the GIL in their C cores.
+- noise comes from the same shared-noise-table design as the device path
+  (offsets per antithetic pair, regenerated — never stored per member), so
+  memory is O(table), not O(population×dim).
+- the update is the identical folded mirrored-pair estimator
+  (ops/gradient.py math, NumPy edition).
+
+This backend exists for PARITY and portability; the TPU engine
+(parallel/engine.py) is the performance path.  Both implement the same
+engine interface, so ES / NS_ES / NSR_ES / NSRA_ES run on either.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, NamedTuple
+
+import numpy as np
+
+from ..ops.ranks import centered_rank_np
+
+
+class HostState(NamedTuple):
+    """Host twin of parallel.engine.ESState (numpy-backed)."""
+
+    params_flat: np.ndarray
+    opt_state: Any  # opaque: the torch optimizer mutates in place; None otherwise
+    key: int
+    generation: int
+
+
+class HostEvalResult(NamedTuple):
+    fitness: np.ndarray
+    bc: np.ndarray
+    steps: int
+
+
+class HostRolloutResult(NamedTuple):
+    total_reward: float
+    bc: np.ndarray
+    steps: int
+
+
+class HostEngine:
+    """Same interface as ESEngine, executed by host workers.
+
+    ``policy_factory()`` must return a fresh policy instance; ``agent_factory()``
+    a fresh agent whose ``rollout(policy)`` returns ``reward`` or
+    ``(reward, bc)`` — the reference's duck-typed contract (SURVEY.md
+    Appendix A).
+    """
+
+    def __init__(
+        self,
+        policy_factory: Callable[[], Any],
+        agent_factory: Callable[[], Any],
+        optimizer_ctor,  # torch.optim class
+        optimizer_kwargs: dict,
+        population_size: int,
+        sigma: float,
+        table_size: int,
+        seed: int,
+        n_proc: int = 1,
+        device: str = "cpu",
+        prototype_agent: Any | None = None,
+    ):
+        import torch
+
+        self.torch = torch
+        if population_size % 2 != 0:
+            raise ValueError(
+                f"population_size must be even (mirrored sampling), got {population_size}"
+            )
+        self.population_size = population_size
+        self.n_pairs = population_size // 2
+        self.sigma = float(sigma)
+        self.seed = int(seed)
+        self.device = device
+        self.policy_factory = policy_factory
+        self.agent_factory = agent_factory
+
+        self.master = policy_factory().to(device)
+        self.dim = int(
+            sum(p.numel() for p in self.master.parameters())
+        )
+        if self.dim > table_size:
+            raise ValueError(
+                f"parameter dim {self.dim} exceeds noise table size {table_size}"
+            )
+        # float32 standard-normal table; same role as ops/noise.py, host edition
+        self.table = (
+            np.random.default_rng(seed).standard_normal(table_size, dtype=np.float32)
+        )
+        self.table_size = table_size
+        self._optimizer_ctor = optimizer_ctor
+        self._optimizer_kwargs = dict(optimizer_kwargs)
+        self.optimizer = optimizer_ctor(self.master.parameters(), **optimizer_kwargs)
+
+        self._prototype_agent = prototype_agent
+        self._workers: list[tuple[Any, Any]] = []  # (scratch policy, agent)
+        self._pool: ThreadPoolExecutor | None = None
+        self.set_n_proc(n_proc)
+
+    # ---------------------------------------------------------------- setup
+
+    def _new_scratch_policy(self):
+        p = self.policy_factory().to(self.device)
+        # sync buffers too (e.g. TorchVirtualBatchNorm frozen stats):
+        # parameter loads later only overwrite parameters
+        p.load_state_dict(self.master.state_dict())
+        return p
+
+    def set_n_proc(self, n_proc: int) -> None:
+        """Grow the worker set (scratch policy + agent per worker) and keep a
+        persistent thread pool — no per-generation thread spawn/join."""
+        n_proc = max(1, int(n_proc))
+        while len(self._workers) < n_proc:
+            agent = (
+                self._prototype_agent
+                if not self._workers and self._prototype_agent is not None
+                else self.agent_factory()
+            )
+            self._workers.append((self._new_scratch_policy(), agent))
+        if self._pool is None or n_proc != getattr(self, "n_proc", None):
+            if self._pool is not None:
+                self._pool.shutdown(wait=False)
+            self._pool = ThreadPoolExecutor(max_workers=n_proc)
+        self.n_proc = n_proc
+
+    def close(self) -> None:
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def _flat(self) -> np.ndarray:
+        import torch
+
+        with torch.no_grad():
+            vec = torch.nn.utils.parameters_to_vector(self.master.parameters())
+        return vec.detach().cpu().numpy().astype(np.float32)
+
+    def _load(self, policy, flat: np.ndarray) -> None:
+        import torch
+
+        with torch.no_grad():
+            # .clone() is load-bearing: vector_to_parameters RE-POINTS each
+            # param.data into views of the vector, and torch.from_numpy shares
+            # memory with `flat` — without the clone, optimizer.step() would
+            # silently mutate the caller's (immutable-by-contract) state array
+            torch.nn.utils.vector_to_parameters(
+                torch.from_numpy(np.ascontiguousarray(flat)).clone(),
+                policy.parameters(),
+            )
+
+    def init_state(self, params_flat=None, key: int | None = None) -> HostState:
+        flat = self._flat() if params_flat is None else np.asarray(params_flat, np.float32)
+        return HostState(
+            params_flat=flat,
+            opt_state=None,
+            key=self.seed if key is None else int(key),
+            generation=0,
+        )
+
+    def compile(self, state: HostState) -> float:
+        return 0.0  # nothing to compile on the host path
+
+    compile_split = compile
+
+    # ------------------------------------------------------------ noise math
+
+    def _pair_offsets(self, state: HostState) -> np.ndarray:
+        """Per-generation antithetic-pair offsets; deterministic in (key, gen),
+        mirroring the device engine's fold_in derivation."""
+        rng = np.random.default_rng(
+            np.random.SeedSequence(entropy=state.key, spawn_key=(state.generation,))
+        )
+        return rng.integers(
+            0, self.table_size - self.dim + 1, size=self.n_pairs, dtype=np.int64
+        )
+
+    def _eps(self, offset: int) -> np.ndarray:
+        return self.table[offset : offset + self.dim]
+
+    def member_theta(self, state: HostState, member_index: int) -> np.ndarray:
+        offs = self._pair_offsets(state)
+        sign = 1.0 if member_index % 2 == 0 else -1.0
+        return state.params_flat + self.sigma * sign * self._eps(
+            int(offs[member_index // 2])
+        )
+
+    # alias matching the device engine's name
+    def member_params(self, state: HostState, member_index: int) -> np.ndarray:
+        return self.member_theta(state, member_index)
+
+    # ------------------------------------------------------------- rollouts
+
+    @staticmethod
+    def _call_rollout(agent, policy) -> HostRolloutResult:
+        out = agent.rollout(policy)
+        if isinstance(out, tuple):
+            reward, bc = out[0], np.asarray(out[1], dtype=np.float32).reshape(-1)
+        else:
+            reward, bc = out, np.zeros(0, dtype=np.float32)
+        steps = int(getattr(agent, "last_episode_steps", 0))
+        return HostRolloutResult(float(reward), bc, steps)
+
+    def evaluate(self, state: HostState) -> HostEvalResult:
+        offs = self._pair_offsets(state)
+        results: list[HostRolloutResult | None] = [None] * self.population_size
+
+        def run_slice(w: int):
+            policy, agent = self._workers[w]
+            for i in range(w, self.population_size, self.n_proc):
+                sign = 1.0 if i % 2 == 0 else -1.0
+                theta = state.params_flat + self.sigma * sign * self._eps(int(offs[i // 2]))
+                self._load(policy, theta)
+                results[i] = self._call_rollout(agent, policy)
+
+        if self.n_proc == 1:
+            run_slice(0)
+        else:
+            list(self._pool.map(run_slice, range(self.n_proc)))
+
+        fitness = np.array([r.total_reward for r in results], dtype=np.float32)
+        bc_dim = max((r.bc.shape[0] for r in results), default=0)
+        bc = np.zeros((self.population_size, bc_dim), dtype=np.float32)
+        for i, r in enumerate(results):
+            if r.bc.shape[0]:
+                bc[i] = r.bc
+        steps = int(sum(r.steps for r in results))
+        return HostEvalResult(fitness=fitness, bc=bc, steps=steps)
+
+    def evaluate_center(self, state: HostState) -> HostRolloutResult:
+        policy, agent = self._workers[0]
+        self._load(policy, state.params_flat)
+        return self._call_rollout(agent, policy)
+
+    # -------------------------------------------------------------- updates
+
+    def apply_weights(self, state: HostState, weights) -> tuple[HostState, float]:
+        """Folded mirrored-pair estimator + torch optimizer step (the
+        reference's param.grad → optimizer.step() flow, SURVEY.md §3.2).
+
+        Optimizer moments travel WITH the state (``opt_state`` holds the torch
+        optimizer state_dict), so independent centers — the novelty family's
+        meta-population — never blend Adam statistics through the shared
+        master optimizer.
+        """
+        import copy
+
+        import torch
+
+        w = np.asarray(weights, dtype=np.float32)
+        offs = self._pair_offsets(state)
+        pair_w = w[0::2] - w[1::2]  # fold_mirrored_weights, numpy edition
+        grad_ascent = np.zeros(self.dim, dtype=np.float32)
+        for k, o in enumerate(offs):
+            grad_ascent += pair_w[k] * self._eps(int(o))
+        grad_ascent /= self.population_size * self.sigma
+
+        self._load(self.master, state.params_flat)
+        if state.opt_state is not None:
+            self.optimizer.load_state_dict(state.opt_state)
+        else:
+            # fresh center: reset any moments left by another state
+            self.optimizer = self._optimizer_ctor(
+                self.master.parameters(), **self._optimizer_kwargs
+            )
+        self.optimizer.zero_grad()
+        # torch optimizers minimize: descend on -ascent
+        g = torch.from_numpy(-grad_ascent)
+        i = 0
+        for p in self.master.parameters():
+            n = p.numel()
+            p.grad = g[i : i + n].view_as(p).clone()
+            i += n
+        self.optimizer.step()
+
+        new_state = HostState(
+            params_flat=self._flat(),
+            opt_state=copy.deepcopy(self.optimizer.state_dict()),
+            key=state.key,
+            generation=state.generation + 1,
+        )
+        return new_state, float(np.linalg.norm(grad_ascent))
+
+    def generation_step(self, state: HostState):
+        ev = self.evaluate(state)
+        weights = centered_rank_np(ev.fitness)
+        new_state, gnorm = self.apply_weights(state, weights)
+        metrics = {
+            "fitness": ev.fitness,
+            "bc": ev.bc,
+            "steps": ev.steps,
+            "grad_norm": gnorm,
+        }
+        return new_state, metrics

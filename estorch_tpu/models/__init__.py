@@ -1,4 +1,20 @@
 from .policies import MLPPolicy, NatureCNN
 from .vbn import VirtualBatchNorm, capture_reference_stats
 
-__all__ = ["MLPPolicy", "NatureCNN", "VirtualBatchNorm", "capture_reference_stats"]
+
+def __getattr__(name):
+    # torch import is deferred: device-path users never pay for it
+    if name == "TorchVirtualBatchNorm":
+        from .vbn_torch import TorchVirtualBatchNorm
+
+        return TorchVirtualBatchNorm
+    raise AttributeError(name)
+
+
+__all__ = [
+    "MLPPolicy",
+    "NatureCNN",
+    "VirtualBatchNorm",
+    "TorchVirtualBatchNorm",
+    "capture_reference_stats",
+]

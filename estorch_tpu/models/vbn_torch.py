@@ -1,0 +1,50 @@
+"""Torch VirtualBatchNorm for the host (reference-parity) backend.
+
+The reference ships ``estorch.VirtualBatchNorm`` as a ``torch.nn.Module``
+(SURVEY.md §2 item 6) so users drop it into their torch policies.  Host-path
+users here get the same module; device-path users get the flax twin
+(models/vbn.py).  Semantics (both): statistics are computed once from the
+first batch seen (the reference batch) and frozen; later calls normalize
+with those frozen statistics plus a learned affine.
+"""
+
+from __future__ import annotations
+
+import torch
+
+
+class TorchVirtualBatchNorm(torch.nn.Module):
+    """Freeze normalization stats on the first (reference) forward pass."""
+
+    def __init__(self, num_features: int, eps: float = 1e-5):
+        super().__init__()
+        self.num_features = num_features
+        self.eps = eps
+        self.scale = torch.nn.Parameter(torch.ones(num_features))
+        self.bias = torch.nn.Parameter(torch.zeros(num_features))
+        self.register_buffer("ref_mean", torch.zeros(num_features))
+        self.register_buffer("ref_var", torch.ones(num_features))
+        self.register_buffer("initialized", torch.tensor(False))
+
+    @torch.no_grad()
+    def set_reference(self, reference_batch: torch.Tensor) -> None:
+        """Explicitly freeze statistics from a reference batch."""
+        dims = tuple(range(reference_batch.dim() - 1))
+        self.ref_mean.copy_(reference_batch.mean(dim=dims))
+        self.ref_var.copy_(reference_batch.var(dim=dims, unbiased=False))
+        self.initialized.fill_(True)
+
+    def forward(self, x: torch.Tensor) -> torch.Tensor:
+        if not bool(self.initialized):
+            if x.dim() < 2 or x.shape[0] < 2:
+                # a single observation has zero variance — freezing from it
+                # would scale activations by rsqrt(eps). Require a real batch.
+                raise RuntimeError(
+                    "TorchVirtualBatchNorm statistics are not initialized; "
+                    "call set_reference(reference_batch) with a batch of "
+                    "observations before rollouts (or run one batched forward)"
+                )
+            # first *batched* call = reference pass (lazy init)
+            self.set_reference(x)
+        inv = torch.rsqrt(self.ref_var + self.eps)
+        return (x - self.ref_mean) * inv * self.scale + self.bias

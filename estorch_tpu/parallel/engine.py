@@ -37,7 +37,7 @@ import optax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..envs.rollout import make_rollout
-from ..ops.gradient import fold_mirrored_weights, rank_weighted_noise_sum
+from ..ops.gradient import es_gradient
 from ..ops.noise import NoiseTable, member_offsets, pair_signs, sample_pair_offsets
 from ..ops.params import ParamSpec
 from ..ops.ranks import centered_rank
@@ -111,6 +111,15 @@ class ESEngine:
         self.pairs_local = pairs_per_device(config.population_size, self.n_devices)
         self.members_local = 2 * self.pairs_local
         self.eval_chunk = _choose_eval_chunk(config.eval_chunk, self.members_local)
+
+        if env is None:
+            # update-only mode: the evaluation happens elsewhere (e.g. the
+            # pooled host-env path, parallel/pooled.py) and only the
+            # offset-derivation + psum-update programs are built
+            self.bc_dim = 0
+            self._rollout = None
+            self._build_update_programs()
+            return
         self.bc_dim = int(env.bc_dim)
 
         self._rollout = make_rollout(env, policy_apply, config.horizon)
@@ -136,15 +145,7 @@ class ESEngine:
                 check_vma=False,
             )
         )
-        self._apply_weights = jax.jit(
-            jax.shard_map(
-                self._apply_weights_body,
-                mesh=mesh,
-                in_specs=(P(), P()),
-                out_specs=(P(), P()),
-                check_vma=False,
-            )
-        )
+        self._build_update_programs()
 
         def center_eval(state: ESState):
             _, rkey = _gen_keys(state)
@@ -154,6 +155,26 @@ class ESEngine:
         # evaluates the unperturbed center policy (reference's `es.policy`):
         # used for best-snapshot logging and the novelty family's archive BCs
         self._center_eval = jax.jit(center_eval)
+
+    def _build_update_programs(self):
+        self._apply_weights = jax.jit(
+            jax.shard_map(
+                self._apply_weights_body,
+                mesh=self.mesh,
+                in_specs=(P(), P()),
+                out_specs=(P(), P()),
+                check_vma=False,
+            )
+        )
+
+    def all_pair_offsets(self, state: ESState) -> jax.Array:
+        """The full (population/2,) offset vector for this generation — the
+        same derivation every device performs inside the update program, so
+        external evaluators (pooled path) perturb with identical noise."""
+        okey, _ = _gen_keys(state)
+        return sample_pair_offsets(
+            okey, self.config.population_size // 2, self.table.size, self.spec.dim
+        )
 
     # ---- shard-local bodies (run once per device under shard_map) ----
 
@@ -221,12 +242,13 @@ class ESEngine:
         w_local = jax.lax.dynamic_slice(
             weights, (d * self.members_local,), (self.members_local,)
         )
-        pw = fold_mirrored_weights(w_local)
-        partial_sum = rank_weighted_noise_sum(
-            self.table, pair_offs, pw, dim=self.spec.dim, chunk=cfg.grad_chunk
+        # local folded partial of the estimator; scaling commutes with psum
+        grad_local = es_gradient(
+            self.table, pair_offs, w_local,
+            sigma=cfg.sigma, population_size=cfg.population_size,
+            dim=self.spec.dim, chunk=cfg.grad_chunk,
         )
-        total = jax.lax.psum(partial_sum, POP_AXIS)
-        grad_ascent = total / (cfg.population_size * cfg.sigma)
+        grad_ascent = jax.lax.psum(grad_local, POP_AXIS)
         if cfg.weight_decay > 0.0:
             grad_ascent = grad_ascent - cfg.weight_decay * state.params_flat
         updates, new_opt_state = self.optimizer.update(

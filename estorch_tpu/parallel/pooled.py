@@ -1,0 +1,182 @@
+"""PooledEngine — C++ host envs + device-batched policy inference.
+
+The execution model for envs that cannot run on-device (the reference's
+Gym/MuJoCo/Atari configs, SURVEY.md §7 'Path B'): N = population envs step
+in parallel C++ threads (envs/native_pool.py → native/envpool.cpp) while the
+accelerator runs ONE batched forward for the whole population per env step —
+(population, obs_dim) in, (population, act_dim) out.  Per-member perturbed
+parameters are materialized once per generation from the shared noise table;
+the update is the identical psum program as the device path (ESEngine in
+update-only mode), so offsets/weights stay bit-consistent between
+evaluation and update.
+
+vs the reference's design for the same configs: estorch steps ONE env per
+Python process and runs the policy forward per single observation
+(SURVEY.md §3.3) — here the policy forward is a population-wide batched
+matmul on the MXU and env stepping is native threads, with one
+host↔device round-trip per env step instead of per member-step.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..envs.native_pool import NativeEnvPool
+from ..ops.noise import member_offsets, pair_signs
+from ..ops.ranks import centered_rank_np
+from .engine import ESEngine, ESState
+
+
+class PooledEvalResult:
+    def __init__(self, fitness, bc, steps):
+        self.fitness = fitness
+        self.bc = bc
+        self.steps = steps
+
+
+class PooledEngine:
+    """Same engine interface as ESEngine/HostEngine, pooled evaluation."""
+
+    def __init__(
+        self,
+        env_name: str,
+        policy_apply,
+        spec,
+        table,
+        optimizer,
+        config,
+        mesh,
+        n_threads: int = 0,
+        seed: int = 0,
+    ):
+        self.env_name = env_name
+        self.spec = spec
+        self.config = config
+        # update-only device engine: shares offsets/psum/optax with the
+        # fully-on-device path
+        self.core = ESEngine(None, policy_apply, spec, table, optimizer, config, mesh)
+        self.pool = NativeEnvPool(
+            env_name, n_envs=config.population_size, n_threads=n_threads, seed=seed
+        )
+        self.center_pool = NativeEnvPool(env_name, n_envs=1, n_threads=1, seed=seed + 1)
+        self.bc_dim = self.pool.obs_dim  # BC = final observation
+        discrete = self.pool.discrete
+
+        def materialize(params_flat, pair_offs):
+            """(population, dim) perturbed parameter matrix from the table."""
+            offs = member_offsets(pair_offs)
+            signs = pair_signs(config.population_size)
+            def one(off, sign):
+                eps = self.core.table.slice(off, spec.dim)
+                return params_flat + config.sigma * sign * eps
+            return jax.vmap(one)(offs, signs)
+
+        self._materialize = jax.jit(materialize)
+
+        def batch_actions(thetas, obs):
+            """One env step's policy forward for the whole population."""
+            def one(theta, o):
+                out = policy_apply(spec.unravel(theta), o)
+                if discrete:
+                    return jnp.argmax(out, axis=-1).astype(jnp.float32)
+                return out.reshape(-1)
+            return jax.vmap(one)(thetas, obs)
+
+        self._batch_actions = jax.jit(batch_actions)
+
+        def center_action(params_flat, obs):
+            out = policy_apply(spec.unravel(params_flat), obs)
+            if discrete:
+                return jnp.argmax(out, axis=-1).astype(jnp.float32)
+            return out.reshape(-1)
+
+        self._center_action = jax.jit(center_action)
+
+    # ------------------------------------------------------------ interface
+
+    def init_state(self, params_flat, key) -> ESState:
+        return self.core.init_state(params_flat, key)
+
+    def compile(self, state: ESState) -> float:
+        import time as _time
+
+        t0 = _time.perf_counter()
+        pair_offs = self.core.all_pair_offsets(state)
+        thetas = self._materialize(state.params_flat, pair_offs)
+        obs = jnp.zeros((self.config.population_size, self.pool.obs_dim), jnp.float32)
+        self._batch_actions(thetas, obs).block_until_ready()
+        dummy_w = jnp.zeros((self.config.population_size,), jnp.float32)
+        self.core._apply_weights.lower(state, dummy_w).compile()
+        return _time.perf_counter() - t0
+
+    compile_split = compile
+
+    def member_params(self, state: ESState, member_index: int):
+        return self.core.member_params(state, member_index)
+
+    def evaluate(self, state: ESState) -> PooledEvalResult:
+        n = self.config.population_size
+        horizon = self.config.horizon
+        pair_offs = self.core.all_pair_offsets(state)
+        thetas = self._materialize(state.params_flat, pair_offs)
+
+        obs = self.pool.reset()
+        total = np.zeros(n, np.float32)
+        alive = np.ones(n, bool)
+        final_obs = obs.copy()
+        steps = 0
+        for _ in range(horizon):
+            actions = np.asarray(self._batch_actions(thetas, jnp.asarray(obs)))
+            next_obs, rew, done = self.pool.step(actions)
+            total += rew * alive
+            steps += int(alive.sum())
+            # record the observation at termination as the BC frame
+            just_died = alive & done
+            if just_died.any():
+                final_obs[just_died] = obs[just_died]
+            alive &= ~done
+            obs = next_obs
+            if not alive.any():
+                break
+        final_obs[alive] = obs[alive]  # survivors: last frame
+        return PooledEvalResult(fitness=total, bc=final_obs.copy(), steps=steps)
+
+    def evaluate_center(self, state: ESState):
+        from ..envs.rollout import RolloutResult
+
+        obs = self.center_pool.reset()[0]
+        total, steps = 0.0, 0
+        for _ in range(self.config.horizon):
+            a = np.asarray(self._center_action(state.params_flat, jnp.asarray(obs)))
+            nobs, rew, done = self.center_pool.step(a[None])
+            total += float(rew[0])
+            steps += 1
+            if bool(done[0]):
+                # the pool auto-resets on done, so nobs[0] is a FRESH reset
+                # state — keep the pre-terminal frame as the BC, matching
+                # evaluate()'s final_obs convention
+                break
+            obs = nobs[0]
+        return RolloutResult(
+            total_reward=jnp.float32(total),
+            bc=jnp.asarray(obs, jnp.float32),
+            steps=jnp.int32(steps),
+        )
+
+    def apply_weights(self, state: ESState, weights):
+        return self.core.apply_weights(state, jnp.asarray(weights))
+
+    def generation_step(self, state: ESState):
+        ev = self.evaluate(state)
+        weights = centered_rank_np(ev.fitness)
+        new_state, gnorm = self.apply_weights(state, weights)
+        metrics = {
+            "fitness": ev.fitness,
+            "bc": ev.bc,
+            "steps": ev.steps,
+            "grad_norm": gnorm,
+        }
+        return new_state, metrics

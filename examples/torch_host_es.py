@@ -1,0 +1,69 @@
+"""Reference-parity usage: torch policy + Gym agent + torch optimizer.
+
+This is the reference's README example shape (SURVEY.md Appendix A) running
+UNCHANGED on estorch_tpu's host backend: a ``torch.nn.Module`` policy, a
+duck-typed Agent whose ``rollout(policy)`` steps a gymnasium env in Python,
+``torch.optim.Adam``, and ``train(n_steps, n_proc)`` fanning rollouts over
+worker threads (the reference used MPI processes).
+
+Run: python examples/torch_host_es.py
+"""
+
+import gymnasium as gym
+import numpy as np
+import torch
+
+from estorch_tpu import ES
+
+
+class Policy(torch.nn.Module):
+    def __init__(self, n_input=4, n_hidden=32, n_output=2):
+        super().__init__()
+        self.net = torch.nn.Sequential(
+            torch.nn.Linear(n_input, n_hidden),
+            torch.nn.Tanh(),
+            torch.nn.Linear(n_hidden, n_output),
+        )
+
+    def forward(self, x):
+        return self.net(x)
+
+
+class Agent:
+    """The reference's rollout contract: episode return from a Gym env."""
+
+    def __init__(self):
+        self.env = gym.make("CartPole-v1")
+
+    def rollout(self, policy, render=False):
+        obs, _ = self.env.reset()
+        total, steps, done = 0.0, 0, False
+        with torch.no_grad():
+            while not done:
+                action = int(
+                    policy(torch.from_numpy(np.asarray(obs, np.float32))).argmax()
+                )
+                obs, reward, term, trunc, _ = self.env.step(action)
+                total += float(reward)
+                steps += 1
+                done = term or trunc
+        self.last_episode_steps = steps
+        return total
+
+
+def main():
+    es = ES(
+        policy=Policy,
+        agent=Agent,
+        optimizer=torch.optim.Adam,
+        population_size=64,
+        sigma=0.1,
+        optimizer_kwargs={"lr": 3e-2},
+    )
+    es.train(n_steps=10, n_proc=8)
+    print(f"\nbest reward: {es.best_reward}")
+    return es
+
+
+if __name__ == "__main__":
+    main()

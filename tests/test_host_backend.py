@@ -1,0 +1,227 @@
+"""Host (reference-parity) backend: a reference user's code runs unchanged.
+
+This is the reference's README usage shape (SURVEY.md Appendix A): a torch
+policy class, a duck-typed Agent with rollout(policy) -> reward (or
+(reward, bc)), a torch optimizer class — ES(...).train(n_steps, n_proc).
+"""
+
+import numpy as np
+import pytest
+import torch
+
+from estorch_tpu import ES, NS_ES, NSRA_ES
+
+
+class TorchMLP(torch.nn.Module):
+    def __init__(self, hidden=16):
+        super().__init__()
+        self.net = torch.nn.Sequential(
+            torch.nn.Linear(4, hidden),
+            torch.nn.Tanh(),
+            torch.nn.Linear(hidden, 2),
+        )
+
+    def forward(self, x):
+        return self.net(x)
+
+
+class QuadraticAgent:
+    """Deterministic fitness: -(||W - target||²) over the policy's params.
+
+    A rollout stand-in that needs no env: fast, exact, and lets tests check
+    actual optimization through the full host path.
+    """
+
+    target = 0.1
+
+    def rollout(self, policy):
+        with torch.no_grad():
+            vec = torch.nn.utils.parameters_to_vector(policy.parameters())
+            reward = -float(((vec - self.target) ** 2).sum())
+        self.last_episode_steps = 1
+        return reward
+
+
+class QuadraticBCAgent(QuadraticAgent):
+    """Novelty flavor: returns (reward, bc) like the reference's NS agents."""
+
+    def rollout(self, policy):
+        r = super().rollout(policy)
+        with torch.no_grad():
+            vec = torch.nn.utils.parameters_to_vector(policy.parameters())
+        return r, vec[:2].numpy()
+
+
+def _make(agent_cls=QuadraticAgent, cls=ES, pop=32, **extra):
+    return cls(
+        policy=TorchMLP,
+        agent=agent_cls,
+        optimizer=torch.optim.Adam,
+        population_size=pop,
+        sigma=0.05,
+        seed=0,
+        policy_kwargs={"hidden": 8},
+        optimizer_kwargs={"lr": 0.05},
+        table_size=1 << 16,
+        **extra,
+    )
+
+
+class GymStyleAgent(QuadraticAgent):
+    """Reference-idiomatic shape: holds a (fake) `.env` AND rollout() —
+    must dispatch to the host path, never the device path."""
+
+    def __init__(self):
+        class _FakeGymEnv:  # has reset/step like gym, but no JaxEnv markers
+            def reset(self):
+                return None
+
+            def step(self, a):
+                return None
+
+        self.env = _FakeGymEnv()
+
+
+class TestHostES:
+    def test_backend_detected(self):
+        es = _make()
+        assert es.backend == "host"
+
+    def test_agent_with_gym_env_attribute_routes_to_host(self):
+        """Regression: reference Agents usually hold self.env = gym.make(...);
+        the rollout() contract must win over the env attribute."""
+        es = _make(agent_cls=GymStyleAgent)
+        assert es.backend == "host"
+        es.train(1, verbose=False)
+        assert len(es.history) == 1
+
+    def test_optimizes_quadratic(self):
+        es = _make()
+        es.train(40, verbose=False)
+        first, last = es.history[0], es.history[-1]
+        assert last["reward_mean"] > first["reward_mean"]
+        # distance to target must have shrunk substantially
+        assert last["reward_max"] > 0.5 * first["reward_max"]
+
+    def test_n_proc_parallel_matches_serial(self):
+        """Same seed: n_proc=4 must produce identical results to n_proc=1
+        (deterministic fitness; layout is member-indexed, not worker-indexed)."""
+        a = _make()
+        a.train(3, n_proc=1, verbose=False)
+        b = _make()
+        b.train(3, n_proc=4, verbose=False)
+        np.testing.assert_allclose(
+            a.state.params_flat, b.state.params_flat, rtol=1e-6, atol=1e-7
+        )
+
+    def test_policy_is_torch_module(self):
+        es = _make()
+        es.train(1, verbose=False)
+        assert isinstance(es.policy, torch.nn.Module)
+        assert isinstance(es.best_policy, torch.nn.Module)
+        out = es.predict(np.zeros(4, dtype=np.float32))
+        assert tuple(out.shape) == (2,)
+
+    def test_best_policy_params_match_best_flat(self):
+        es = _make()
+        es.train(3, verbose=False)
+        vec = torch.nn.utils.parameters_to_vector(es.best_policy.parameters())
+        np.testing.assert_allclose(
+            vec.detach().numpy(), es._best_flat, rtol=1e-6, atol=1e-7
+        )
+
+    def test_shared_agent_instance_caps_n_proc(self):
+        es = _make(agent_cls=QuadraticAgent)
+        es._agent_arg = QuadraticAgent()  # simulate instance-passing
+        es._agent_is_shared_instance = True
+        with pytest.warns(UserWarning, match="n_proc=1"):
+            es.train(1, n_proc=4, verbose=False)
+
+    def test_determinism_same_seed(self):
+        a = _make()
+        a.train(3, verbose=False)
+        b = _make()
+        b.train(3, verbose=False)
+        np.testing.assert_array_equal(a.state.params_flat, b.state.params_flat)
+
+    def test_env_steps_from_agent_attribute(self):
+        es = _make()
+        es.train(1, verbose=False)
+        assert es.history[0]["env_steps"] == 32  # 1 step per member
+
+
+class TestHostNovelty:
+    def test_ns_es_on_host(self):
+        es = _make(agent_cls=QuadraticBCAgent, cls=NS_ES,
+                   meta_population_size=2, k=3)
+        es.train(3, verbose=False)
+        assert es.backend == "host"
+        assert len(es.archive) == 2 + 3
+        assert len(es.history) == 3
+
+    def test_nsra_es_on_host(self):
+        es = _make(agent_cls=QuadraticBCAgent, cls=NSRA_ES,
+                   meta_population_size=2, k=3, weight=0.7)
+        es.train(2, verbose=False)
+        assert "nsra_weight" in es.history[-1]
+
+    def test_meta_centers_distinct_on_host(self):
+        es = _make(agent_cls=QuadraticBCAgent, cls=NS_ES,
+                   meta_population_size=3, k=3)
+        p0 = es.meta_states[0].params_flat
+        p1 = es.meta_states[1].params_flat
+        assert not np.array_equal(p0, p1)
+
+
+class TestHostTorchVBN:
+    def test_vbn_freezes_on_first_batch(self):
+        from estorch_tpu.models import TorchVirtualBatchNorm
+
+        vbn = TorchVirtualBatchNorm(4)
+        ref = torch.randn(32, 4) * 5 + 2
+        out1 = vbn(ref)
+        # frozen: different input later, same stats
+        mean_after_ref = vbn.ref_mean.clone()
+        _ = vbn(torch.randn(8, 4) * 100)
+        torch.testing.assert_close(vbn.ref_mean, mean_after_ref)
+        # reference batch is normalized to ~zero mean / unit var
+        assert abs(float(out1.mean())) < 0.1
+        assert abs(float(out1.var()) - 1.0) < 0.2
+
+    def test_gradient_flows_through_affine_only_params(self):
+        from estorch_tpu.models import TorchVirtualBatchNorm
+
+        vbn = TorchVirtualBatchNorm(4)
+        params = list(vbn.parameters())
+        assert len(params) == 2  # scale, bias — stats are buffers
+
+    def test_uninitialized_single_obs_raises(self):
+        """Freezing stats from one observation (var=0) must be refused."""
+        from estorch_tpu.models import TorchVirtualBatchNorm
+
+        vbn = TorchVirtualBatchNorm(4)
+        with pytest.raises(RuntimeError, match="set_reference"):
+            vbn(torch.randn(4))
+
+
+class TestHostOptimizerIsolation:
+    def test_meta_centers_do_not_share_adam_moments(self):
+        """Interleaving updates of two states must not change either's result
+        (the reference's single-policy flow never hits this; the novelty
+        meta-population does)."""
+        es = _make()
+        eng = es.engine
+        sA = es.state
+        sB = eng.init_state(sA.params_flat + 0.3, key=123)
+        w = np.linspace(-0.5, 0.5, 32).astype(np.float32)
+
+        # sequence 1: A updated twice in a row
+        a1, _ = eng.apply_weights(sA, w)
+        a2, _ = eng.apply_weights(a1, w)
+
+        # sequence 2: B's update interleaved between A's two updates
+        a1b, _ = eng.apply_weights(sA, w)
+        _ = eng.apply_weights(sB, w)
+        a2b, _ = eng.apply_weights(a1b, w)
+
+        np.testing.assert_array_equal(a2.params_flat, a2b.params_flat)
