@@ -14,7 +14,7 @@ classes are re-exported here as they land:
 
 __version__ = "0.1.0"
 
-from . import envs, models, ops, parallel  # noqa: F401
+from . import envs, models, ops, parallel, utils  # noqa: F401
 from .algo import ES, NS_ES, NSR_ES, NSRA_ES, NoveltyArchive
 from .envs.agent import JaxAgent, PooledAgent
 from .models import MLPPolicy, NatureCNN, VirtualBatchNorm
@@ -34,5 +34,6 @@ __all__ = [
     "models",
     "ops",
     "parallel",
+    "utils",
     "__version__",
 ]

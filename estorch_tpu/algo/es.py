@@ -117,7 +117,8 @@ class ES:
         if hasattr(self.agent, "rollout"):
             self.backend = "host"
             self._init_host(
-                optimizer, dict(optimizer_kwargs or {}), table_size, device
+                optimizer, dict(optimizer_kwargs or {}), table_size, device,
+                weight_decay,
             )
             self._post_engine_init()
             return
@@ -140,86 +141,45 @@ class ES:
                 "reference-style agent exposing rollout(policy) (host path)"
             )
         self.env = self.agent.env
-        self.module = _instantiate(policy, dict(policy_kwargs or {}), "policy")
-
-        # --- init policy variables from a real observation shape
-        init_key, state_key, vbn_key = jax.random.split(jax.random.PRNGKey(seed), 3)
         _, obs0 = self.env.reset(jax.random.PRNGKey(0))
-        self._obs0 = obs0
-        variables = self.module.init(init_key, obs0)
-        params = variables["params"]
-        self._frozen = {k: v for k, v in variables.items() if k != "params"}
 
-        # --- VirtualBatchNorm: freeze reference-batch statistics once
-        if "vbn_stats" in variables:
-            ref_batch = collect_reference_batch(self.env, vbn_key, n_steps=vbn_batch)
-            self._frozen["vbn_stats"] = capture_reference_stats(
-                self.module, variables, ref_batch
-            )
+        def vbn_ref(vbn_key):
+            return collect_reference_batch(self.env, vbn_key, n_steps=vbn_batch)
 
-        frozen = self._frozen
-
-        def policy_apply(p, obs):
-            return self.module.apply({"params": p, **frozen}, obs)
-
-        self._policy_apply = policy_apply
-
-        flat, self._spec = make_param_spec(params)
-        self.table = make_noise_table(table_size, seed=seed)
-        self.optimizer = _as_optax(optimizer, dict(optimizer_kwargs or {}))
-        self.mesh = mesh if mesh is not None else population_mesh(
-            [device] if device is not None and not isinstance(device, (list, tuple)) else device
-        )
-        self.config = EngineConfig(
-            population_size=population_size,
-            sigma=sigma,
-            horizon=self.agent.rollout_horizon,
-            eval_chunk=eval_chunk,
-            grad_chunk=grad_chunk,
-            weight_decay=weight_decay,
+        flat, state_key = self._init_flax_common(
+            policy, dict(policy_kwargs or {}), optimizer,
+            dict(optimizer_kwargs or {}), obs0, self.agent.rollout_horizon,
+            vbn_ref, table_size, eval_chunk, grad_chunk, weight_decay,
+            mesh, device,
         )
         self.engine = ESEngine(
-            self.env, policy_apply, self._spec, self.table,
+            self.env, self._policy_apply, self._spec, self.table,
             self.optimizer, self.config, self.mesh,
         )
         self.state = self.engine.init_state(flat, state_key)
         self._post_engine_init()
 
-    def _post_engine_init(self):
-        self.best_reward = -np.inf
-        self._best_flat: np.ndarray | None = None
-        self._best_policy_host = None
-        self.history: list[dict] = []
-        self.generation = 0
-        self.compile_time_s: float | None = None
-
-    # --------------------------------------------------------- pooled backend
-
-    def _init_pooled(
-        self, policy, policy_kwargs, optimizer, optimizer_kwargs,
-        table_size, eval_chunk, grad_chunk, weight_decay, mesh, device, vbn_batch,
+    def _init_flax_common(
+        self, policy, policy_kwargs, optimizer, optimizer_kwargs, obs0,
+        horizon, vbn_ref_fn, table_size, eval_chunk, grad_chunk,
+        weight_decay, mesh, device,
     ):
-        from ..envs.native_pool import NativeEnvPool
-        from ..parallel.pooled import PooledEngine
-
-        probe = NativeEnvPool(self.agent.env_name, n_envs=1, n_threads=1)
-        obs_dim = probe.obs_dim
-        probe.close()
-
-        self.env = None
+        """Shared flax-path construction (device + pooled backends): module
+        init from a real observation, frozen-collection split, VBN reference
+        capture, param spec, noise table, optax, mesh, EngineConfig."""
         self.module = _instantiate(policy, policy_kwargs, "policy")
-        init_key, state_key, vbn_key = jax.random.split(jax.random.PRNGKey(self.seed), 3)
-        del vbn_key
-        obs0 = jnp.zeros((obs_dim,), jnp.float32)
+        init_key, state_key, vbn_key = jax.random.split(
+            jax.random.PRNGKey(self.seed), 3
+        )
         self._obs0 = obs0
         variables = self.module.init(init_key, obs0)
         params = variables["params"]
         self._frozen = {k: v for k, v in variables.items() if k != "params"}
 
+        # VirtualBatchNorm: freeze reference-batch statistics once
         if "vbn_stats" in variables:
-            ref_batch = self._pooled_reference_batch(vbn_batch)
             self._frozen["vbn_stats"] = capture_reference_stats(
-                self.module, variables, ref_batch
+                self.module, variables, vbn_ref_fn(vbn_key)
             )
 
         frozen = self._frozen
@@ -237,13 +197,45 @@ class ES:
         self.config = EngineConfig(
             population_size=self.population_size,
             sigma=self.sigma,
-            horizon=int(self.agent.horizon),
+            horizon=int(horizon),
             eval_chunk=eval_chunk,
             grad_chunk=grad_chunk,
             weight_decay=weight_decay,
         )
+        return flat, state_key
+
+    def _post_engine_init(self):
+        self.best_reward = -np.inf
+        self._best_flat: np.ndarray | None = None
+        self._best_policy_host = None
+        self.history: list[dict] = []
+        self.generation = 0
+        self.compile_time_s: float | None = None
+
+    # --------------------------------------------------------- pooled backend
+
+    def _init_pooled(
+        self, policy, policy_kwargs, optimizer, optimizer_kwargs,
+        table_size, eval_chunk, grad_chunk, weight_decay, mesh, device, vbn_batch,
+    ):
+        from ..envs.native_pool import env_spec
+        from ..parallel.pooled import PooledEngine
+
+        obs_dim = env_spec(self.agent.env_name)["obs_dim"]
+        self.env = None
+        obs0 = jnp.zeros((obs_dim,), jnp.float32)
+
+        def vbn_ref(vbn_key):
+            del vbn_key  # pool RNG is numpy-seeded
+            return self._pooled_reference_batch(vbn_batch)
+
+        flat, state_key = self._init_flax_common(
+            policy, policy_kwargs, optimizer, optimizer_kwargs, obs0,
+            self.agent.horizon, vbn_ref, table_size, eval_chunk, grad_chunk,
+            weight_decay, mesh, device,
+        )
         self.engine = PooledEngine(
-            self.agent.env_name, policy_apply, self._spec, self.table,
+            self.agent.env_name, self._policy_apply, self._spec, self.table,
             self.optimizer, self.config, self.mesh,
             n_threads=self.agent.n_threads, seed=self.seed,
         )
@@ -269,7 +261,8 @@ class ES:
 
     # ----------------------------------------------------------- host backend
 
-    def _init_host(self, optimizer, optimizer_kwargs, table_size, device):
+    def _init_host(self, optimizer, optimizer_kwargs, table_size, device,
+                   weight_decay=0.0):
         """Reference-parity path: torch policy + host Agent.rollout workers."""
         import copy
 
@@ -320,6 +313,7 @@ class ES:
             n_proc=1,
             device="cpu" if device is None else str(device),
             prototype_agent=self.agent,  # dispatch probe doubles as worker 0
+            weight_decay=weight_decay,
         )
         self.state = self.engine.init_state()
 
@@ -379,22 +373,29 @@ class ES:
 
     def _track_best(self, prev_state, fitness: np.ndarray) -> tuple[float, bool]:
         """Best-member snapshot (reference: es.best_policy/best_reward).
-        Returns (generation max, whether a new best was set)."""
-        gen_best = float(fitness.max())
-        improved = gen_best > self.best_reward
+        Returns (generation max, whether a new best was set).
+
+        NaN-aware: failed members (host fault tolerance marks them NaN) must
+        not disable best tracking or poison the metrics.
+        """
+        finite_any = np.isfinite(fitness).any()
+        gen_best = float(np.nanmax(fitness)) if finite_any else float("nan")
+        improved = finite_any and gen_best > self.best_reward
         if improved:
             self.best_reward = gen_best
-            idx = int(fitness.argmax())
+            idx = int(np.nanargmax(fitness))
             self._best_flat = np.asarray(self.engine.member_params(prev_state, idx))
         return gen_best, improved
 
     def _base_record(self, prev_state, fitness, steps, grad_norm, dt) -> dict:
         gen_best, improved = self._track_best(prev_state, fitness)
+        finite_any = np.isfinite(fitness).any()
         return {
             "generation": self.generation,
             "reward_max": gen_best,
-            "reward_mean": float(fitness.mean()),
-            "reward_min": float(fitness.min()),
+            "reward_mean": float(np.nanmean(fitness)) if finite_any else float("nan"),
+            "reward_min": float(np.nanmin(fitness)) if finite_any else float("nan"),
+            "n_failed": int(np.size(fitness) - np.isfinite(fitness).sum()),
             "best_reward": self.best_reward,
             "improved_best": improved,
             "env_steps": steps,

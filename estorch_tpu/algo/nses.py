@@ -94,6 +94,23 @@ class NS_ES(ES):
         """NS-ES: novelty ranks only (reference NS_ES gradient)."""
         return centered_rank_np(novelty)
 
+    def _weights_with_failures(self, fitness: np.ndarray, novelty: np.ndarray) -> np.ndarray:
+        """Variant weights with failed (NaN-fitness) members dropped.
+
+        np.argsort sorts NaN LAST — without this guard a failed member would
+        receive the TOP centered rank and dominate the update.  Valid members
+        are ranked among themselves; failures are zero-weighted and survivors
+        renormalized (utils/fault.py straggler-drop scheme).
+        """
+        from ..utils.fault import mask_and_renormalize, valid_mask
+
+        valid = valid_mask(fitness)
+        if valid.all():
+            return self._combine_weights(fitness, novelty)
+        w = np.zeros(fitness.shape[0], dtype=np.float32)
+        w[valid] = self._combine_weights(fitness[valid], novelty[valid])
+        return mask_and_renormalize(w, valid)
+
     # ---- training loop ---------------------------------------------------
 
     def _select_meta_index(self) -> int:
@@ -129,7 +146,7 @@ class NS_ES(ES):
             ev = self.engine.evaluate(st)
             fitness = np.asarray(ev.fitness)
             novelty = self.archive.novelty(np.asarray(ev.bc))
-            weights = self._combine_weights(fitness, novelty)
+            weights = self._weights_with_failures(fitness, novelty)
             if self.backend == "device":
                 weights = jax.numpy.asarray(weights)
 

@@ -26,17 +26,40 @@ _ACT_DIMS = {0: 1, 1: 1}
 _DISCRETE = {0: True, 1: False}
 
 
+def env_spec(env_name: str) -> dict:
+    """Static facts about a pool env — no pool construction needed."""
+    if env_name not in ENV_IDS:
+        raise ValueError(f"unknown env {env_name!r}; available: {sorted(ENV_IDS)}")
+    eid = ENV_IDS[env_name]
+    return {
+        "env_id": eid,
+        "obs_dim": _OBS_DIMS[eid],
+        "act_dim": _ACT_DIMS[eid],
+        "discrete": _DISCRETE[eid],
+    }
+
+
+def _stale(lib_path: str) -> bool:
+    src = os.path.join(_NATIVE_DIR, "envpool.cpp")
+    try:
+        return os.path.getmtime(lib_path) < os.path.getmtime(src)
+    except OSError:
+        return True
+
+
 def _load_library() -> Optional[ctypes.CDLL]:
-    if not os.path.exists(_LIB_PATH):
+    if not os.path.exists(_LIB_PATH) or _stale(_LIB_PATH):
         try:
             subprocess.run(
-                ["make", "-C", os.path.abspath(_NATIVE_DIR)],
+                ["make", "-C", os.path.abspath(_NATIVE_DIR), "-B"],
                 check=True,
                 capture_output=True,
                 timeout=120,
             )
         except (subprocess.SubprocessError, FileNotFoundError):
-            return None
+            if not os.path.exists(_LIB_PATH):
+                return None
+            # stale-but-present: fall through and load it anyway
     try:
         lib = ctypes.CDLL(_LIB_PATH)
     except OSError:

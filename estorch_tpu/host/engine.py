@@ -78,6 +78,7 @@ class HostEngine:
         n_proc: int = 1,
         device: str = "cpu",
         prototype_agent: Any | None = None,
+        weight_decay: float = 0.0,
     ):
         import torch
 
@@ -89,6 +90,7 @@ class HostEngine:
         self.population_size = population_size
         self.n_pairs = population_size // 2
         self.sigma = float(sigma)
+        self.weight_decay = float(weight_decay)
         self.seed = int(seed)
         self.device = device
         self.policy_factory = policy_factory
@@ -235,7 +237,16 @@ class HostEngine:
                 sign = 1.0 if i % 2 == 0 else -1.0
                 theta = state.params_flat + self.sigma * sign * self._eps(int(offs[i // 2]))
                 self._load(policy, theta)
-                results[i] = self._call_rollout(agent, policy)
+                try:
+                    results[i] = self._call_rollout(agent, policy)
+                except Exception:  # noqa: BLE001 — a dead member must not
+                    # kill the generation (reference behavior: one worker
+                    # exception hangs the whole MPI gather, SURVEY.md §5);
+                    # NaN fitness marks the member for straggler-drop
+                    # renormalization in utils/fault.py
+                    results[i] = HostRolloutResult(
+                        float("nan"), np.zeros(0, dtype=np.float32), 0
+                    )
 
         if self.n_proc == 1:
             run_slice(0)
@@ -278,6 +289,9 @@ class HostEngine:
         for k, o in enumerate(offs):
             grad_ascent += pair_w[k] * self._eps(int(o))
         grad_ascent /= self.population_size * self.sigma
+        if self.weight_decay > 0.0:
+            # same L2 pull as the device engine's _update_from_weights
+            grad_ascent = grad_ascent - self.weight_decay * state.params_flat
 
         self._load(self.master, state.params_flat)
         if state.opt_state is not None:
@@ -306,8 +320,10 @@ class HostEngine:
         return new_state, float(np.linalg.norm(grad_ascent))
 
     def generation_step(self, state: HostState):
+        from ..utils.fault import rank_weights_with_failures
+
         ev = self.evaluate(state)
-        weights = centered_rank_np(ev.fitness)
+        weights = rank_weights_with_failures(ev.fitness)
         new_state, gnorm = self.apply_weights(state, weights)
         metrics = {
             "fitness": ev.fitness,

@@ -1,0 +1,19 @@
+from .checkpoint import PeriodicCheckpointer, restore_checkpoint, save_checkpoint
+from .fault import mask_and_renormalize, rank_weights_with_failures, valid_mask
+from .metrics import JsonlWriter, MultiWriter, TensorBoardWriter
+from .profiler import annotate, timed_generations, trace
+
+__all__ = [
+    "PeriodicCheckpointer",
+    "restore_checkpoint",
+    "save_checkpoint",
+    "mask_and_renormalize",
+    "rank_weights_with_failures",
+    "valid_mask",
+    "JsonlWriter",
+    "MultiWriter",
+    "TensorBoardWriter",
+    "annotate",
+    "timed_generations",
+    "trace",
+]

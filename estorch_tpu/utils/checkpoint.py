@@ -1,0 +1,237 @@
+"""Checkpoint / resume — exact-state persistence via Orbax.
+
+The reference has NOTHING here: users ``torch.save`` the policy state_dict
+by hand and lose optimizer moments, RNG position, the novelty archive, and
+the NSRA weight (SURVEY.md §5 'Checkpoint / resume').  estorch_tpu
+checkpoints the FULL algorithm state, so resume is bit-exact: the noise
+stream is derived from ``(key, generation)``, hence restoring those two plus
+params/optimizer reproduces the run as if never interrupted.
+
+Layout of a checkpoint directory:
+- ``state/``    — Orbax tree of all numeric state (params, optax state, rng
+                  key, generation counters, best snapshot, archive BCs,
+                  meta-population centers)
+- ``meta.json`` — strings/flags (backend, algo, config echo, NSRA scalars)
+- ``host_opt.pt`` — host backend only: torch optimizer state_dicts
+                  (torch-native serialization, one per center)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+import numpy as np
+
+
+def _np(x):
+    return np.asarray(x)
+
+
+def _pack_state(es, st) -> dict:
+    """Numeric-only view of one engine state (device ESState or HostState)."""
+    d = {
+        "params_flat": _np(st.params_flat),
+        "generation": int(st.generation),
+    }
+    if es.backend == "host":
+        d["key"] = int(st.key)
+    else:
+        d["key"] = _np(st.key)
+        d["opt_state"] = _to_numpy_tree(st.opt_state)
+    return d
+
+
+def _to_numpy_tree(tree: Any) -> Any:
+    import jax
+
+    return jax.tree_util.tree_map(_np, tree)
+
+
+def _all_states(es) -> list:
+    return list(es.meta_states) if hasattr(es, "meta_states") else [es.state]
+
+
+def _state_tree(es) -> dict:
+    """The numeric state tree (Orbax-safe: arrays/ints/floats only)."""
+    tree = {
+        "generation": int(es.generation),
+        "best_reward": float(es.best_reward) if np.isfinite(es.best_reward) else -1e30,
+        "has_best": int(es._best_flat is not None),
+        "best_flat": (
+            _np(es._best_flat)
+            if es._best_flat is not None
+            else np.zeros(0, np.float32)
+        ),
+        "states": [_pack_state(es, s) for s in _all_states(es)],
+    }
+    if hasattr(es, "archive"):
+        tree["archive_bcs"] = es.archive.bcs
+        tree["center_bc"] = [_np(b) for b in es._center_bc]
+    return tree
+
+
+def _meta_dict(es) -> dict:
+    meta = {
+        "format_version": 1,
+        "backend": es.backend,
+        "algo": type(es).__name__,
+        "population_size": es.population_size,
+        "sigma": es.sigma,
+        "seed": es.seed,
+        "generation": int(es.generation),
+        "history_len": len(es.history),
+    }
+    if hasattr(es, "archive"):
+        meta["archive_k"] = es.archive.k
+        meta["archive_bc_dim"] = es.archive.bc_dim
+    if hasattr(es, "weight"):  # NSRA
+        meta["nsra_weight"] = float(es.weight)
+        meta["nsra_stagnation"] = int(es._stagnation)
+    if hasattr(es, "_rng"):
+        # meta-selection RNG position — without it a resumed novelty run
+        # picks different meta-individuals than the uninterrupted run
+        meta["meta_rng_state"] = es._rng.bit_generator.state
+    return meta
+
+
+def save_checkpoint(es, path: str) -> None:
+    """Write a complete checkpoint of ``es`` to directory ``path``."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    os.makedirs(path, exist_ok=True)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(os.path.join(path, "state"), _state_tree(es), force=True)
+    ckptr.wait_until_finished()
+    with open(os.path.join(path, "meta.json"), "w") as f:
+        json.dump(_meta_dict(es), f, indent=2)
+    if es.backend == "host":
+        import torch
+
+        torch.save(
+            [s.opt_state for s in _all_states(es)],
+            os.path.join(path, "host_opt.pt"),
+        )
+
+
+def restore_checkpoint(es, path: str) -> None:
+    """Restore ``es`` in place from a checkpoint written by save_checkpoint.
+
+    ``es`` must be constructed with the same configuration (policy, agent,
+    optimizer, population, sigma, seed) — the standard JAX restore pattern:
+    rebuild the program, then load the state.
+    """
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    with open(os.path.join(path, "meta.json")) as f:
+        meta = json.load(f)
+    if meta["backend"] != es.backend:
+        raise ValueError(
+            f"checkpoint backend {meta['backend']!r} != this object's {es.backend!r}"
+        )
+    if meta["algo"] != type(es).__name__:
+        raise ValueError(
+            f"checkpoint algo {meta['algo']!r} != this object's {type(es).__name__!r}"
+        )
+
+    ckptr = ocp.StandardCheckpointer()
+    tree = ckptr.restore(os.path.join(path, "state"), _state_tree(es))
+
+    es.generation = int(tree["generation"])
+    br = float(tree["best_reward"])
+    es.best_reward = -np.inf if br <= -1e29 else br
+    es._best_flat = _np(tree["best_flat"]) if int(tree["has_best"]) else None
+
+    host_opts = None
+    if es.backend == "host":
+        import torch
+
+        host_opts = torch.load(
+            os.path.join(path, "host_opt.pt"), weights_only=False
+        )
+
+    states = [
+        _unpack_state(es, packed, None if host_opts is None else host_opts[i])
+        for i, packed in enumerate(tree["states"])
+    ]
+    if hasattr(es, "meta_states"):
+        es.meta_states = states
+    es.state = states[0]
+
+    if hasattr(es, "archive"):
+        from ..algo.archive import NoveltyArchive
+
+        ar = NoveltyArchive(k=int(meta["archive_k"]), bc_dim=meta["archive_bc_dim"])
+        for row in _np(tree["archive_bcs"]):
+            ar.add(row)
+        es.archive = ar
+        es._center_bc = [_np(b) for b in tree["center_bc"]]
+    if "nsra_weight" in meta and hasattr(es, "weight"):
+        es.weight = float(meta["nsra_weight"])
+        es._stagnation = int(meta["nsra_stagnation"])
+    if "meta_rng_state" in meta and hasattr(es, "_rng"):
+        es._rng = np.random.default_rng()
+        es._rng.bit_generator.state = meta["meta_rng_state"]
+
+
+def _unpack_state(es, packed: dict, host_opt=None):
+    if es.backend == "host":
+        from ..host.engine import HostState
+
+        return HostState(
+            params_flat=_np(packed["params_flat"]).astype(np.float32),
+            opt_state=host_opt,
+            key=int(packed["key"]),
+            generation=int(packed["generation"]),
+        )
+    import jax.numpy as jnp
+
+    from ..parallel.engine import ESState
+
+    return ESState(
+        params_flat=jnp.asarray(packed["params_flat"]),
+        opt_state=packed["opt_state"],
+        key=jnp.asarray(packed["key"]),
+        generation=jnp.int32(packed["generation"]),
+    )
+
+
+class PeriodicCheckpointer:
+    """Save every K generations; keeps the newest ``max_to_keep`` checkpoints.
+
+    Usage (composes with train's log_fn):
+        ck = PeriodicCheckpointer(es, "ckpts", every=10)
+        es.train(100, log_fn=ck.on_record)
+    """
+
+    def __init__(self, es, root: str, every: int = 10, max_to_keep: int = 3):
+        self.es = es
+        self.root = os.path.abspath(root)
+        self.every = int(every)
+        self.max_to_keep = int(max_to_keep)
+        os.makedirs(self.root, exist_ok=True)
+
+    def on_record(self, record: dict) -> None:
+        gen = record["generation"]
+        if (gen + 1) % self.every == 0:
+            self.save(gen)
+
+    def save(self, gen: int) -> str:
+        path = os.path.join(self.root, f"gen_{gen:08d}")
+        save_checkpoint(self.es, path)
+        self._gc()
+        return path
+
+    def latest(self) -> str | None:
+        cks = sorted(d for d in os.listdir(self.root) if d.startswith("gen_"))
+        return os.path.join(self.root, cks[-1]) if cks else None
+
+    def _gc(self) -> None:
+        import shutil
+
+        cks = sorted(d for d in os.listdir(self.root) if d.startswith("gen_"))
+        for stale in cks[: -self.max_to_keep]:
+            shutil.rmtree(os.path.join(self.root, stale), ignore_errors=True)

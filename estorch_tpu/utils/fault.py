@@ -1,0 +1,68 @@
+"""Fault tolerance: straggler/failure-tolerant ES updates.
+
+The reference hangs forever if one worker dies mid-gather (SURVEY.md §5
+'Failure detection').  ES is uniquely forgiving: the estimator is a mean
+over population members, so a failed host's slice can simply be DROPPED and
+the weights renormalized — an unbiased estimate from the survivors.  Two
+layers here:
+
+1. ``mask_and_renormalize(weights, valid)`` — zero failed members' weights
+   and rescale so the effective population matches the actual contributor
+   count.  Works for both backends (the psum update is linear in weights).
+2. Host-side failure capture: HostEngine marks members whose rollout raised
+   as invalid (NaN fitness) instead of crashing the generation;
+   ``valid_mask(fitness)`` converts that to the mask for (1).
+
+Recovery from full-process failure is the checkpoint path
+(utils/checkpoint.py): generations are stateless given (params, key,
+generation), so resume == reload + rerun.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def valid_mask(fitness: np.ndarray) -> np.ndarray:
+    """Members whose evaluation produced a usable fitness."""
+    return np.isfinite(np.asarray(fitness))
+
+
+def mask_and_renormalize(weights: np.ndarray, valid: np.ndarray) -> np.ndarray:
+    """Zero invalid members and rescale survivors by n/valid_count.
+
+    The ES update divides by the STATIC population size n inside the engine;
+    multiplying surviving weights by n/n_valid makes the estimate the mean
+    over actual contributors — the straggler-drop scheme of SURVEY.md §5.
+    Raises if fewer than 2 members survived (no rankable population).
+    """
+    weights = np.asarray(weights, dtype=np.float32)
+    valid = np.asarray(valid, dtype=bool)
+    n = weights.shape[0]
+    n_valid = int(valid.sum())
+    if n_valid < 2:
+        raise RuntimeError(
+            f"only {n_valid}/{n} population members produced valid fitness — "
+            "cannot form an update; check env/rollout health"
+        )
+    out = np.where(valid, weights, 0.0).astype(np.float32)
+    return out * (n / n_valid)
+
+
+def rank_weights_with_failures(fitness: np.ndarray) -> np.ndarray:
+    """Centered ranks over the VALID members only, failures zero-weighted.
+
+    Invalid members neither push nor pull the update; valid members are
+    ranked among themselves and renormalized.
+    """
+    from ..ops.ranks import centered_rank_np
+
+    fitness = np.asarray(fitness)
+    valid = valid_mask(fitness)
+    n = fitness.shape[0]
+    if valid.all():
+        return centered_rank_np(fitness)
+    ranks = np.zeros(n, dtype=np.float32)
+    sub = centered_rank_np(fitness[valid])
+    ranks[valid] = sub
+    return mask_and_renormalize(ranks, valid)

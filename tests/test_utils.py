@@ -1,0 +1,290 @@
+"""Aux subsystems: checkpoint/resume exactness, metrics writers, fault
+tolerance, profiler timing (SURVEY.md §5)."""
+
+import os
+
+import numpy as np
+import optax
+import pytest
+import torch
+
+from estorch_tpu import ES, NSRA_ES, JaxAgent, MLPPolicy
+from estorch_tpu.envs import CartPole
+from estorch_tpu.utils import (
+    JsonlWriter,
+    MultiWriter,
+    PeriodicCheckpointer,
+    mask_and_renormalize,
+    rank_weights_with_failures,
+    restore_checkpoint,
+    save_checkpoint,
+    timed_generations,
+    valid_mask,
+)
+
+
+def _device_es(**over):
+    kw = dict(
+        policy=MLPPolicy,
+        agent=JaxAgent,
+        optimizer=optax.adam,
+        population_size=16,
+        sigma=0.1,
+        seed=3,
+        policy_kwargs={"action_dim": 2, "hidden": (8,)},
+        agent_kwargs={"env": CartPole(), "horizon": 50},
+        optimizer_kwargs={"learning_rate": 1e-2},
+        table_size=1 << 16,
+    )
+    kw.update(over)
+    cls = kw.pop("cls", ES)
+    return cls(**kw)
+
+
+class TestCheckpointDevice:
+    def test_resume_is_exact(self, tmp_path):
+        """Train 4; checkpoint at 2; restore into a fresh object; resume 2
+        more — params must be IDENTICAL to the uninterrupted run."""
+        ref = _device_es()
+        ref.train(4, verbose=False)
+
+        a = _device_es()
+        a.train(2, verbose=False)
+        save_checkpoint(a, str(tmp_path / "ck"))
+
+        b = _device_es()
+        restore_checkpoint(b, str(tmp_path / "ck"))
+        assert b.generation == 2
+        b.train(2, verbose=False)
+
+        np.testing.assert_array_equal(
+            np.asarray(ref.state.params_flat), np.asarray(b.state.params_flat)
+        )
+        assert int(b.state.generation) == 4
+
+    def test_best_snapshot_restored(self, tmp_path):
+        a = _device_es()
+        a.train(3, verbose=False)
+        save_checkpoint(a, str(tmp_path / "ck"))
+        b = _device_es()
+        restore_checkpoint(b, str(tmp_path / "ck"))
+        assert b.best_reward == a.best_reward
+        np.testing.assert_array_equal(b._best_flat, a._best_flat)
+
+    def test_nsra_archive_and_weight_restored(self, tmp_path):
+        a = _device_es(cls=NSRA_ES, meta_population_size=2, k=3, weight=0.6)
+        a.train(3, verbose=False)
+        save_checkpoint(a, str(tmp_path / "ck"))
+
+        b = _device_es(cls=NSRA_ES, meta_population_size=2, k=3, weight=0.6)
+        restore_checkpoint(b, str(tmp_path / "ck"))
+        assert len(b.archive) == len(a.archive)
+        np.testing.assert_allclose(b.archive.bcs, a.archive.bcs)
+        assert b.weight == a.weight
+        assert b._stagnation == a._stagnation
+        for sa, sb in zip(a.meta_states, b.meta_states):
+            np.testing.assert_array_equal(
+                np.asarray(sa.params_flat), np.asarray(sb.params_flat)
+            )
+
+    def test_novelty_resume_is_exact(self, tmp_path):
+        """Regression: the meta-selection RNG position must be checkpointed —
+        without it the resumed run picks different meta-individuals."""
+        def mk():
+            return _device_es(cls=NSRA_ES, meta_population_size=2, k=3, weight=0.8)
+
+        ref = mk()
+        ref.train(5, verbose=False)
+
+        a = mk()
+        a.train(3, verbose=False)
+        save_checkpoint(a, str(tmp_path / "ck"))
+        b = mk()
+        restore_checkpoint(b, str(tmp_path / "ck"))
+        b.train(2, verbose=False)
+
+        np.testing.assert_array_equal(
+            np.asarray(ref.state.params_flat), np.asarray(b.state.params_flat)
+        )
+        assert [r["meta_index"] for r in ref.history[3:]] == [
+            r["meta_index"] for r in b.history
+        ]
+
+    def test_backend_mismatch_rejected(self, tmp_path):
+        a = _device_es()
+        a.train(1, verbose=False)
+        save_checkpoint(a, str(tmp_path / "ck"))
+
+        class P(torch.nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.l = torch.nn.Linear(4, 2)
+
+            def forward(self, x):
+                return self.l(x)
+
+        class A:
+            def rollout(self, policy):
+                return 0.0
+
+        host = ES(P, A, torch.optim.Adam, population_size=16,
+                  optimizer_kwargs={"lr": 1e-2}, table_size=1 << 14)
+        with pytest.raises(Exception):
+            restore_checkpoint(host, str(tmp_path / "ck"))
+
+
+class TestCheckpointHost:
+    def _host_es(self):
+        class P(torch.nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.l = torch.nn.Linear(4, 2)
+
+            def forward(self, x):
+                return self.l(x)
+
+        class A:
+            def rollout(self, policy):
+                with torch.no_grad():
+                    v = torch.nn.utils.parameters_to_vector(policy.parameters())
+                    return -float(((v - 0.1) ** 2).sum())
+
+        return ES(P, A, torch.optim.Adam, population_size=16, sigma=0.05,
+                  seed=1, optimizer_kwargs={"lr": 0.05}, table_size=1 << 14)
+
+    def test_host_resume_is_exact(self, tmp_path):
+        ref = self._host_es()
+        ref.train(4, verbose=False)
+
+        a = self._host_es()
+        a.train(2, verbose=False)
+        save_checkpoint(a, str(tmp_path / "ck"))
+        b = self._host_es()
+        restore_checkpoint(b, str(tmp_path / "ck"))
+        b.train(2, verbose=False)
+        np.testing.assert_allclose(
+            ref.state.params_flat, b.state.params_flat, rtol=1e-6, atol=1e-7
+        )
+
+
+class TestPeriodicCheckpointer:
+    def test_every_k_and_gc(self, tmp_path):
+        es = _device_es()
+        ck = PeriodicCheckpointer(es, str(tmp_path / "cks"), every=2, max_to_keep=2)
+        es.train(6, log_fn=ck.on_record)
+        kept = sorted(os.listdir(tmp_path / "cks"))
+        assert len(kept) == 2  # gens 1,3,5 saved; oldest GC'd
+        assert ck.latest().endswith(kept[-1])
+
+
+class TestMetricsWriters:
+    def test_jsonl_roundtrip(self, tmp_path):
+        path = str(tmp_path / "log.jsonl")
+        w = JsonlWriter(path)
+        es = _device_es()
+        es.train(3, log_fn=w)
+        w.close()
+        recs = JsonlWriter.read(path)
+        assert len(recs) == 3
+        assert recs[0]["generation"] == 0
+        assert "env_steps_per_sec" in recs[-1]
+
+    def test_multi_writer_fans_out(self, tmp_path):
+        seen = []
+        w = MultiWriter([seen.append, JsonlWriter(str(tmp_path / "l.jsonl"))])
+        w({"generation": 0, "reward_max": 1.0, "reward_mean": 0.5,
+           "env_steps_per_sec": 100.0})
+        assert len(seen) == 1
+        w.close()
+
+
+class TestFaultTolerance:
+    def test_valid_mask(self):
+        f = np.array([1.0, np.nan, 3.0, np.inf])
+        np.testing.assert_array_equal(valid_mask(f), [True, False, True, False])
+
+    def test_mask_and_renormalize_unbiased_scale(self):
+        w = np.array([0.5, -0.5, 0.25, -0.25], np.float32)
+        valid = np.array([True, True, True, False])
+        out = mask_and_renormalize(w, valid)
+        assert out[3] == 0.0
+        np.testing.assert_allclose(out[:3], w[:3] * (4 / 3), rtol=1e-6)
+
+    def test_too_few_survivors_raises(self):
+        with pytest.raises(RuntimeError, match="valid fitness"):
+            mask_and_renormalize(np.ones(4, np.float32), np.array([True] + [False] * 3))
+
+    def test_rank_weights_with_failures(self):
+        f = np.array([3.0, np.nan, 1.0, 2.0], np.float32)
+        w = rank_weights_with_failures(f)
+        assert w[1] == 0.0
+        # valid members ranked among themselves, renormalized by 4/3
+        from estorch_tpu.ops import centered_rank_np
+
+        expected = np.zeros(4, np.float32)
+        expected[[0, 2, 3]] = centered_rank_np(f[[0, 2, 3]]) * (4 / 3)
+        np.testing.assert_allclose(w, expected, rtol=1e-6)
+
+    def test_host_engine_survives_worker_exception(self):
+        class P(torch.nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.l = torch.nn.Linear(2, 1)
+
+            def forward(self, x):
+                return self.l(x)
+
+        class FlakyAgent:
+            calls = 0
+
+            def rollout(self, policy):
+                FlakyAgent.calls += 1
+                if FlakyAgent.calls % 5 == 0:
+                    raise RuntimeError("env crashed")
+                with torch.no_grad():
+                    v = torch.nn.utils.parameters_to_vector(policy.parameters())
+                    return -float((v**2).sum())
+
+        es = ES(P, FlakyAgent, torch.optim.Adam, population_size=16,
+                optimizer_kwargs={"lr": 1e-2}, table_size=1 << 12)
+        es.train(2, verbose=False)  # must not raise
+        assert len(es.history) == 2
+        # failed members are NaN-masked: stats stay finite, failures counted,
+        # and best tracking still works
+        rec = es.history[-1]
+        assert np.isfinite(rec["reward_mean"])
+        assert np.isfinite(rec["reward_max"])
+        assert rec["n_failed"] > 0
+        assert np.isfinite(es.best_reward)
+        assert es._best_flat is not None
+
+    def test_novelty_weights_drop_failed_members(self):
+        """A NaN-fitness member must get zero weight, not the top rank."""
+        from estorch_tpu import NS_ES, JaxAgent, MLPPolicy
+        from estorch_tpu.envs import CartPole
+        import optax
+
+        es = NS_ES(
+            MLPPolicy, JaxAgent, optax.adam, population_size=16, sigma=0.1,
+            seed=0, meta_population_size=2, k=3,
+            policy_kwargs={"action_dim": 2, "hidden": (8,)},
+            agent_kwargs={"env": CartPole(), "horizon": 20},
+            optimizer_kwargs={"learning_rate": 1e-2}, table_size=1 << 14,
+        )
+        fitness = np.array([1.0, np.nan, 3.0, 2.0] * 4, np.float32)
+        novelty = np.linspace(0, 1, 16).astype(np.float32)
+        w = es._weights_with_failures(fitness, novelty)
+        failed = np.isnan(fitness)
+        assert np.all(w[failed] == 0.0)
+        assert np.isfinite(w).all()
+        assert abs(float(w.sum())) < 1e-4  # renormalized centered ranks still ~sum 0
+
+
+class TestProfiler:
+    def test_timed_generations(self):
+        es = _device_es()
+        stats = timed_generations(es, n=2, warmup=1)
+        assert stats["generations"] == 2
+        assert stats["env_steps"] > 0
+        assert stats["env_steps_per_sec"] > 0
+        assert stats["compile_time_s"] is not None
