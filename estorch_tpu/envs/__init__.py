@@ -1,3 +1,4 @@
+from .acrobot import Acrobot
 from .base import EnvSpec, JaxEnv
 from .cartpole import CartPole
 from .mountain_car import MountainCarContinuous
@@ -5,6 +6,7 @@ from .pendulum import Pendulum
 from .rollout import RolloutResult, make_population_rollout, make_rollout, select_action
 
 __all__ = [
+    "Acrobot",
     "EnvSpec",
     "JaxEnv",
     "CartPole",

@@ -1,0 +1,105 @@
+"""Pure-JAX Acrobot-v1 (classic control), faithful to the Gym dynamics.
+
+The 'book' variant of the underactuated double pendulum (Sutton & Barto)
+with RK4 integration, matching gymnasium's Acrobot-v1 step-for-step
+(parity-tested in tests/test_envs.py).  Discrete torques {-1, 0, +1}.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _wrap(x, lo, hi):
+    return lo + (x - lo) % (hi - lo)
+
+
+@dataclasses.dataclass(frozen=True)
+class Acrobot:
+    dt: float = 0.2
+    link_length_1: float = 1.0
+    link_mass_1: float = 1.0
+    link_mass_2: float = 1.0
+    link_com_1: float = 0.5
+    link_com_2: float = 0.5
+    link_moi: float = 1.0
+    max_vel_1: float = 4 * jnp.pi
+    max_vel_2: float = 9 * jnp.pi
+    g: float = 9.8
+
+    obs_dim: int = 6
+    action_dim: int = 3
+    discrete: bool = True
+    default_horizon: int = 500
+    bc_dim: int = 2
+
+    def _obs(self, s):
+        t1, t2, dt1, dt2 = s[0], s[1], s[2], s[3]
+        return jnp.stack([jnp.cos(t1), jnp.sin(t1), jnp.cos(t2), jnp.sin(t2), dt1, dt2])
+
+    def reset(self, key: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        s = jax.random.uniform(key, (4,), minval=-0.1, maxval=0.1)
+        return s, self._obs(s)
+
+    def _dsdt(self, s, torque):
+        m1, m2 = self.link_mass_1, self.link_mass_2
+        l1 = self.link_length_1
+        lc1, lc2 = self.link_com_1, self.link_com_2
+        I1 = I2 = self.link_moi
+        g = self.g
+        t1, t2, dt1, dt2 = s[0], s[1], s[2], s[3]
+
+        d1 = (
+            m1 * lc1**2
+            + m2 * (l1**2 + lc2**2 + 2 * l1 * lc2 * jnp.cos(t2))
+            + I1
+            + I2
+        )
+        d2 = m2 * (lc2**2 + l1 * lc2 * jnp.cos(t2)) + I2
+        phi2 = m2 * lc2 * g * jnp.cos(t1 + t2 - jnp.pi / 2.0)
+        phi1 = (
+            -m2 * l1 * lc2 * dt2**2 * jnp.sin(t2)
+            - 2 * m2 * l1 * lc2 * dt2 * dt1 * jnp.sin(t2)
+            + (m1 * lc1 + m2 * l1) * g * jnp.cos(t1 - jnp.pi / 2.0)
+            + phi2
+        )
+        # the 'book' equations (gymnasium default)
+        ddt2 = (
+            torque + d2 / d1 * phi1 - m2 * l1 * lc2 * dt1**2 * jnp.sin(t2) - phi2
+        ) / (m2 * lc2**2 + I2 - d2**2 / d1)
+        ddt1 = -(d2 * ddt2 + phi1) / d1
+        return jnp.stack([dt1, dt2, ddt1, ddt2])
+
+    def step(self, state, action):
+        torque = (action - 1).astype(jnp.float32)  # {0,1,2} -> {-1,0,+1}
+
+        # RK4 over one dt with constant torque (gymnasium's rk4)
+        s = state
+        h = self.dt
+        k1 = self._dsdt(s, torque)
+        k2 = self._dsdt(s + h / 2.0 * k1, torque)
+        k3 = self._dsdt(s + h / 2.0 * k2, torque)
+        k4 = self._dsdt(s + h * k3, torque)
+        ns = s + h / 6.0 * (k1 + 2 * k2 + 2 * k3 + k4)
+
+        t1 = _wrap(ns[0], -jnp.pi, jnp.pi)
+        t2 = _wrap(ns[1], -jnp.pi, jnp.pi)
+        dt1 = jnp.clip(ns[2], -self.max_vel_1, self.max_vel_1)
+        dt2 = jnp.clip(ns[3], -self.max_vel_2, self.max_vel_2)
+        new_state = jnp.stack([t1, t2, dt1, dt2])
+
+        done = -jnp.cos(t1) - jnp.cos(t2 + t1) > 1.0
+        reward = jnp.where(done, 0.0, -1.0)
+        return new_state, self._obs(new_state), reward, done
+
+    def behavior(self, state, obs) -> jax.Array:
+        """BC = final tip position (the swing-up frontier), in the same
+        downward-vertical angle convention as the terminal height check."""
+        t1, t2 = state[0], state[1]
+        x = jnp.sin(t1) + jnp.sin(t1 + t2)
+        y = -jnp.cos(t1) - jnp.cos(t1 + t2)
+        return jnp.stack([x, y])

@@ -144,6 +144,18 @@ class HostEngine:
             self._pool = ThreadPoolExecutor(max_workers=n_proc)
         self.n_proc = n_proc
 
+    def freeze_vbn(self, reference_batch) -> None:
+        """Freeze TorchVirtualBatchNorm stats in master from a reference
+        batch and propagate the buffers to every existing scratch policy
+        (future workers inherit via _new_scratch_policy's state_dict copy)."""
+        import torch
+
+        with torch.no_grad():
+            self.master(torch.as_tensor(np.asarray(reference_batch),
+                                        dtype=torch.float32))
+        for policy, _ in self._workers:
+            policy.load_state_dict(self.master.state_dict())
+
     def close(self) -> None:
         if self._pool is not None:
             self._pool.shutdown(wait=False)

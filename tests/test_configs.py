@@ -1,0 +1,45 @@
+"""BASELINE config harness smoke tests (scaled down for CI speed)."""
+
+import numpy as np
+import pytest
+
+from estorch_tpu.configs import CONFIGS, cartpole_smoke, halfcheetah_vbn
+
+
+class TestConfigs:
+    def test_all_baseline_configs_present(self):
+        assert set(CONFIGS) == {
+            "cartpole_smoke",
+            "halfcheetah_vbn",
+            "humanoid_mirrored",
+            "humanoid_nsres",
+            "atari_frostbite",
+        }
+
+    def test_cartpole_smoke_runs_device_path(self):
+        es = cartpole_smoke(population_size=32, table_size=1 << 16)
+        es.train(2, verbose=False)
+        assert es.backend == "device"
+        assert len(es.history) == 2
+
+    def test_halfcheetah_vbn_runs_host_path(self):
+        es = halfcheetah_vbn(population_size=16)
+        es.train(1, verbose=False)
+        assert es.backend == "host"
+        assert np.isfinite(es.history[0]["reward_mean"])
+        # VBN layers must be frozen (initialized) in master AND workers
+        for policy, _ in es.engine._workers:
+            for m in policy.modules():
+                if type(m).__name__ == "TorchVirtualBatchNorm":
+                    assert bool(m.initialized)
+
+    def test_atari_gated_with_clear_error(self):
+        with pytest.raises(ImportError, match="ale_py"):
+            CONFIGS["atari_frostbite"]()
+
+    def test_cli_main(self, capsys):
+        from estorch_tpu.configs import main
+
+        main(["cartpole_smoke", "--generations", "1", "--population", "16"])
+        out = capsys.readouterr().out
+        assert "best reward" in out

@@ -7,6 +7,7 @@ import numpy as np
 import pytest
 
 from estorch_tpu.envs import (
+    Acrobot,
     CartPole,
     MountainCarContinuous,
     Pendulum,
@@ -101,6 +102,38 @@ class TestMountainCarParity:
             np.testing.assert_allclose(np.asarray(obs), gobs, rtol=1e-4, atol=1e-5,
                                        err_msg=f"diverged at step {i}")
             np.testing.assert_allclose(float(rew), grew, rtol=1e-4, atol=1e-5)
+
+
+class TestAcrobotParity:
+    def test_step_for_step_vs_gymnasium(self):
+        start = np.array([0.05, -0.08, 0.02, 0.06], dtype=np.float64)
+        actions = [0, 2, 1, 2, 2, 0, 1, 2, 0, 2]
+
+        def set_state(u):
+            u.state = start.copy()
+
+        gym_traj = _drive_gym(
+            "Acrobot-v1", set_state, actions,
+            lambda u, o: np.asarray(o, dtype=np.float64),
+        )
+
+        env = Acrobot()
+        state = jnp.array(start, dtype=jnp.float32)
+        for i, ((gobs, grew, gterm), a) in enumerate(zip(gym_traj, actions)):
+            state, obs, rew, done = env.step(state, jnp.int32(a))
+            np.testing.assert_allclose(np.asarray(obs), gobs, rtol=1e-3, atol=2e-4,
+                                       err_msg=f"diverged at step {i}")
+            assert float(rew) == grew
+            assert bool(done) == gterm
+
+    def test_swingup_termination(self):
+        """A state with both links up must read as terminal after a step."""
+        env = Acrobot()
+        # theta1 = pi (first link up), theta2 = 0 -> height = 2 > 1
+        s = jnp.array([jnp.pi, 0.0, 0.0, 0.0])
+        _, _, rew, done = env.step(s, jnp.int32(1))
+        assert bool(done)
+        assert float(rew) == 0.0
 
 
 class TestRolloutScan:
