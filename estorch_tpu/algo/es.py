@@ -99,10 +99,16 @@ class ES:
         weight_decay: float = 0.0,
         mesh=None,
         vbn_batch: int = 128,
+        compute_dtype: str = "float32",
     ):
         self.population_size = population_size
         self.sigma = sigma
         self.seed = seed
+        if compute_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"compute_dtype must be float32 or bfloat16, got {compute_dtype!r}"
+            )
+        self._compute_dtype = compute_dtype
 
         self._policy_arg = policy
         self._policy_kwargs = dict(policy_kwargs or {})
@@ -115,6 +121,11 @@ class ES:
         # the host marker, so it is checked first; `env` only routes to the
         # device path when it is a JaxEnv (pure reset/step + static dims).
         if hasattr(self.agent, "rollout"):
+            if compute_dtype != "float32":
+                raise ValueError(
+                    "compute_dtype is a device/pooled-path option; the host "
+                    "backend runs torch policies in their native dtype"
+                )
             self.backend = "host"
             self._init_host(
                 optimizer, dict(optimizer_kwargs or {}), table_size, device,
@@ -201,6 +212,7 @@ class ES:
             eval_chunk=eval_chunk,
             grad_chunk=grad_chunk,
             weight_decay=weight_decay,
+            compute_dtype=self._compute_dtype,
         )
         return flat, state_key
 

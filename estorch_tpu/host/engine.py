@@ -145,11 +145,18 @@ class HostEngine:
         self.n_proc = n_proc
 
     def freeze_vbn(self, reference_batch) -> None:
-        """Freeze TorchVirtualBatchNorm stats in master from a reference
+        """(Re-)freeze TorchVirtualBatchNorm stats in master from a reference
         batch and propagate the buffers to every existing scratch policy
         (future workers inherit via _new_scratch_policy's state_dict copy)."""
         import torch
 
+        from ..models.vbn_torch import TorchVirtualBatchNorm
+
+        # clear any previously-frozen stats so this batch actually takes
+        # (forward only lazy-initializes on the FIRST batched pass)
+        for m in self.master.modules():
+            if isinstance(m, TorchVirtualBatchNorm):
+                m.initialized.fill_(False)
         with torch.no_grad():
             self.master(torch.as_tensor(np.asarray(reference_batch),
                                         dtype=torch.float32))

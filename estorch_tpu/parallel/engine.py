@@ -54,6 +54,9 @@ class EngineConfig:
     eval_chunk: int = 0  # members per rollout chunk; 0 → whole local shard
     grad_chunk: int = 256  # pairs per gradient-reduction chunk
     weight_decay: float = 0.0  # L2 pull toward 0, applied with the update
+    compute_dtype: str = "float32"  # "bfloat16" runs the POLICY forward in
+    # bf16 (MXU-native, half the HBM traffic for the per-member weights);
+    # params, noise table, env dynamics, and the update stay float32
 
 
 class ESState(NamedTuple):
@@ -101,6 +104,20 @@ class ESEngine:
         mesh: Mesh,
     ):
         self.env = env
+        if config.compute_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"compute_dtype must be float32 or bfloat16, got {config.compute_dtype!r}"
+            )
+        if config.compute_dtype == "bfloat16":
+            base_apply = policy_apply
+
+            def policy_apply(p, obs):  # noqa: F811 — deliberate wrap
+                p16 = jax.tree_util.tree_map(
+                    lambda x: x.astype(jnp.bfloat16), p
+                )
+                out = base_apply(p16, obs.astype(jnp.bfloat16))
+                return out.astype(jnp.float32)
+
         self.policy_apply = policy_apply
         self.spec = spec
         self.table = table

@@ -56,8 +56,10 @@ class PooledEngine:
         self.spec = spec
         self.config = config
         # update-only device engine: shares offsets/psum/optax with the
-        # fully-on-device path
+        # fully-on-device path; its ctor also applies the compute_dtype wrap,
+        # which we reuse below instead of wrapping a second time
         self.core = ESEngine(None, policy_apply, spec, table, optimizer, config, mesh)
+        policy_apply = self.core.policy_apply
         self.pool = NativeEnvPool(
             env_name, n_envs=config.population_size, n_threads=n_threads, seed=seed
         )

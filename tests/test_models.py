@@ -1,0 +1,90 @@
+"""Policy model tests: NatureCNN, MLP heads, bf16 compute path."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from estorch_tpu import ES, JaxAgent, MLPPolicy, NatureCNN
+from estorch_tpu.envs import CartPole
+from estorch_tpu.ops import count_params
+
+
+class TestNatureCNN:
+    def test_shapes_single_and_batched(self):
+        cnn = NatureCNN(action_dim=18, use_vbn=False)
+        obs = jnp.zeros((84, 84, 4), jnp.uint8)
+        vs = cnn.init(jax.random.PRNGKey(0), obs)
+        out = cnn.apply(vs, obs)
+        assert out.shape == (18,)
+        batch = jnp.zeros((7, 84, 84, 4), jnp.uint8)
+        out_b = cnn.apply(vs, batch)
+        assert out_b.shape == (7, 18)
+
+    def test_param_count_matches_nature_dqn(self):
+        """Conv trunk + 512 dense ≈ the canonical ~1.69M params for 18 actions."""
+        cnn = NatureCNN(action_dim=18, use_vbn=False)
+        vs = cnn.init(jax.random.PRNGKey(0), jnp.zeros((84, 84, 4)))
+        n = count_params(vs["params"])
+        assert 1_600_000 < n < 1_800_000, n
+
+    def test_vbn_collection_separated(self):
+        cnn = NatureCNN(action_dim=4, use_vbn=True)
+        vs = cnn.init(jax.random.PRNGKey(0), jnp.zeros((84, 84, 4)))
+        assert "vbn_stats" in vs
+        # stats never live in params (ES must not perturb them)
+        flat_names = [
+            "/".join(str(p) for p in path)
+            for path, _ in jax.tree_util.tree_leaves_with_path(vs["params"])
+        ]
+        assert not any("mean" in n or "var" in n for n in flat_names)
+
+    def test_uint8_normalization(self):
+        """255-valued input must normalize to ~1.0 before the convs."""
+        cnn = NatureCNN(action_dim=2, use_vbn=False)
+        full = jnp.full((84, 84, 4), 255, jnp.uint8)
+        vs = cnn.init(jax.random.PRNGKey(0), full)
+        out_full = cnn.apply(vs, full)
+        out_zero = cnn.apply(vs, jnp.zeros((84, 84, 4), jnp.uint8))
+        assert not np.allclose(np.asarray(out_full), np.asarray(out_zero))
+
+
+class TestBf16ComputePath:
+    def _es(self, dtype):
+        return ES(
+            MLPPolicy, JaxAgent, optax.adam,
+            population_size=32, sigma=0.1, seed=0,
+            policy_kwargs={"action_dim": 2, "hidden": (16,)},
+            agent_kwargs={"env": CartPole(), "horizon": 100},
+            optimizer_kwargs={"learning_rate": 3e-2},
+            table_size=1 << 16, compute_dtype=dtype,
+        )
+
+    def test_bf16_learns_cartpole(self):
+        es = self._es("bfloat16")
+        es.train(8, verbose=False)
+        first = es.history[0]["reward_mean"]
+        last = es.history[-1]["reward_mean"]
+        assert last > first + 10, (first, last)
+
+    def test_params_stay_float32(self):
+        es = self._es("bfloat16")
+        es.train(1, verbose=False)
+        assert es.state.params_flat.dtype == jnp.float32
+        assert es.table.data.dtype == jnp.float32
+
+    def test_bf16_close_to_f32_first_generation(self):
+        """Same seed: bf16 fitness should agree with f32 for most members in
+        generation 0 (CartPole actions are argmax — only near-ties flip)."""
+        a = self._es("float32")
+        b = self._es("bfloat16")
+        ra = a.engine.evaluate(a.state)
+        rb = b.engine.evaluate(b.state)
+        agree = np.mean(np.asarray(ra.fitness) == np.asarray(rb.fitness))
+        assert agree > 0.5, agree
+
+    def test_invalid_dtype_rejected(self):
+        import pytest
+
+        with pytest.raises(ValueError, match="compute_dtype"):
+            self._es("float16")
