@@ -1,0 +1,76 @@
+"""Expose pure-JAX envs through the gymnasium interface.
+
+Closes the loop with the reference's ecosystem: a device-native env
+(envs/cartpole.py etc.) can be driven by ANY gym-consuming code — the
+reference's own Agent.rollout pattern, third-party eval scripts, video
+recorders — without a second env implementation.  Also the easy way to
+eyeball-check a policy trained on the device path inside host tooling.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+import gymnasium as gym
+
+
+class GymFromJax(gym.Env):
+    """gymnasium.Env over a JaxEnv — composes with standard gym wrappers."""
+
+    metadata: dict = {"render_modes": []}
+    render_mode = None
+
+    def __init__(self, env: Any, seed: int = 0, max_steps: int | None = None):
+        super().__init__()
+        self._env = env
+        self._key = jax.random.PRNGKey(seed)
+        self._state = None
+        self._steps = 0
+        self._max_steps = int(max_steps or env.default_horizon)
+        self._step_jit = jax.jit(env.step)
+        self._reset_jit = jax.jit(env.reset)
+
+        if env.discrete:
+            self.action_space = gym.spaces.Discrete(env.action_dim)
+        else:
+            # honor the env's real bounds where declared (action_bound);
+            # unbounded Box otherwise
+            bound = float(getattr(env, "action_bound", np.inf))
+            self.action_space = gym.spaces.Box(
+                low=-bound, high=bound, shape=(env.action_dim,), dtype=np.float32
+            )
+        self.observation_space = gym.spaces.Box(
+            low=-np.inf, high=np.inf, shape=(env.obs_dim,), dtype=np.float32
+        )
+
+    def reset(self, *, seed: int | None = None, options=None):
+        if seed is not None:
+            self._key = jax.random.PRNGKey(seed)
+        self._key, sub = jax.random.split(self._key)
+        self._state, obs = self._reset_jit(sub)
+        self._steps = 0
+        return np.asarray(obs, np.float32), {}
+
+    def step(self, action):
+        if self._state is None:
+            raise RuntimeError("Cannot call env.step() before calling env.reset()")
+        a = jnp.asarray(action)
+        self._state, obs, reward, done = self._step_jit(self._state, a)
+        self._steps += 1
+        truncated = self._steps >= self._max_steps
+        return (
+            np.asarray(obs, np.float32),
+            float(reward),
+            bool(done),
+            bool(truncated),
+            {},
+        )
+
+    def close(self) -> None:
+        pass

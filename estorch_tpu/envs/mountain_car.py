@@ -27,6 +27,7 @@ class MountainCarContinuous:
     discrete: bool = False
     default_horizon: int = 999
     bc_dim: int = 1
+    action_bound: float = 1.0  # force clipped to ±1
 
     def reset(self, key: jax.Array) -> Tuple[jax.Array, jax.Array]:
         pos = jax.random.uniform(key, (), minval=-0.6, maxval=-0.4)

@@ -31,6 +31,7 @@ class Pendulum:
     discrete: bool = False
     default_horizon: int = 200
     bc_dim: int = 2
+    action_bound: float = 2.0  # |torque| ≤ max_torque
 
     def _obs(self, state):
         th, thdot = state[0], state[1]

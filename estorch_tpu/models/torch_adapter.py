@@ -1,0 +1,98 @@
+"""Torch ↔ flax parameter adapters (SURVEY.md §7 design-delta 6).
+
+Lets a reference user move a trained torch MLP onto the device path (or a
+device-trained MLPPolicy back into torch for deployment) without retraining:
+
+    params = torch_mlp_to_flax(torch_policy, MLPPolicy(action_dim=2, hidden=(32, 32)))
+    flax_mlp_to_torch(params, torch_policy)
+
+Covers the Sequential-of-Linear MLP shape both sides use (the reference's
+example policies and our MLPPolicy).  Linear weights transpose between
+conventions: torch stores (out, in), flax Dense stores (in, out).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def _torch_linears(policy):
+    import torch
+
+    linears = [m for m in policy.modules() if isinstance(m, torch.nn.Linear)]
+    for i, lin in enumerate(linears):
+        if lin.bias is None:
+            raise ValueError(
+                f"Linear layer {i} has bias=False; the adapter maps to flax "
+                "Dense layers which always carry a bias — add biases (they "
+                "can be zero) or adapt the layer manually"
+            )
+    return linears
+
+
+def _flax_dense_names(params: Any) -> list[str]:
+    """MLPPolicy layer names in forward order: dense_0..dense_{n-1}, head."""
+    names = sorted(
+        (n for n in params if n.startswith("dense_")),
+        key=lambda n: int(n.split("_")[1]),
+    )
+    if "head" in params:
+        names.append("head")
+    return names
+
+
+def torch_mlp_to_flax(torch_policy, flax_module, example_obs=None) -> Any:
+    """Flax ``params`` for ``flax_module`` carrying ``torch_policy``'s weights.
+
+    ``flax_module`` must be an MLPPolicy-shaped module whose Dense layers
+    correspond 1:1 (in forward order) to the torch policy's Linear layers.
+    """
+    import jax
+
+    if example_obs is None:
+        first = _torch_linears(torch_policy)[0]
+        example_obs = jnp.zeros((first.in_features,), jnp.float32)
+    variables = flax_module.init(jax.random.PRNGKey(0), example_obs)
+    params = jax.tree_util.tree_map(np.asarray, variables["params"])
+
+    linears = _torch_linears(torch_policy)
+    names = _flax_dense_names(params)
+    if len(linears) != len(names):
+        raise ValueError(
+            f"layer count mismatch: torch has {len(linears)} Linear layers, "
+            f"flax module has {len(names)} Dense layers ({names})"
+        )
+    for lin, name in zip(linears, names):
+        w = lin.weight.detach().cpu().numpy().T  # (out,in) -> (in,out)
+        b = lin.bias.detach().cpu().numpy()
+        if params[name]["kernel"].shape != w.shape:
+            raise ValueError(
+                f"shape mismatch at {name}: flax {params[name]['kernel'].shape} "
+                f"vs torch {w.shape}"
+            )
+        params[name]["kernel"] = w
+        params[name]["bias"] = b
+    return jax.tree_util.tree_map(jnp.asarray, params)
+
+
+def flax_mlp_to_torch(params: Any, torch_policy) -> None:
+    """Load MLPPolicy ``params`` into a torch policy in place (inverse map)."""
+    import torch
+
+    linears = _torch_linears(torch_policy)
+    names = _flax_dense_names(params)
+    if len(linears) != len(names):
+        raise ValueError(
+            f"layer count mismatch: torch has {len(linears)}, flax has {len(names)}"
+        )
+    with torch.no_grad():
+        for lin, name in zip(linears, names):
+            # copies: jax-backed numpy views are read-only and/or
+            # non-contiguous after .T, which torch.from_numpy rejects/warns on
+            w = np.array(np.asarray(params[name]["kernel"]).T)
+            b = np.array(np.asarray(params[name]["bias"]))
+            lin.weight.copy_(torch.from_numpy(w))
+            lin.bias.copy_(torch.from_numpy(b))

@@ -1,0 +1,116 @@
+"""Torch↔flax parameter adapters and the JaxEnv→gymnasium adapter."""
+
+import numpy as np
+import torch
+
+import jax
+import jax.numpy as jnp
+
+from estorch_tpu import MLPPolicy
+from estorch_tpu.envs import CartPole
+from estorch_tpu.envs.gym_adapter import GymFromJax
+from estorch_tpu.models.torch_adapter import flax_mlp_to_torch, torch_mlp_to_flax
+
+
+def _torch_mlp():
+    return torch.nn.Sequential(
+        torch.nn.Linear(4, 16), torch.nn.Tanh(),
+        torch.nn.Linear(16, 16), torch.nn.Tanh(),
+        torch.nn.Linear(16, 2),
+    )
+
+
+class TestTorchFlaxAdapter:
+    def test_roundtrip_preserves_outputs(self):
+        tp = _torch_mlp()
+        fm = MLPPolicy(action_dim=2, hidden=(16, 16))
+        params = torch_mlp_to_flax(tp, fm)
+
+        obs = np.random.RandomState(0).randn(4).astype(np.float32)
+        with torch.no_grad():
+            torch_out = tp(torch.from_numpy(obs)).numpy()
+        flax_out = np.asarray(fm.apply({"params": params}, jnp.asarray(obs)))
+        np.testing.assert_allclose(flax_out, torch_out, rtol=1e-5, atol=1e-6)
+
+        # inverse: mutate flax params, load back, outputs must follow
+        params2 = jax.tree_util.tree_map(lambda x: x * 1.5, params)
+        flax_mlp_to_torch(params2, tp)
+        with torch.no_grad():
+            torch_out2 = tp(torch.from_numpy(obs)).numpy()
+        flax_out2 = np.asarray(fm.apply({"params": params2}, jnp.asarray(obs)))
+        np.testing.assert_allclose(flax_out2, torch_out2, rtol=1e-5, atol=1e-6)
+
+    def test_layer_mismatch_rejected(self):
+        tp = torch.nn.Sequential(torch.nn.Linear(4, 8), torch.nn.Linear(8, 2))
+        fm = MLPPolicy(action_dim=2, hidden=(16, 16))  # 3 dense layers
+        import pytest
+
+        with pytest.raises(ValueError, match="layer count"):
+            torch_mlp_to_flax(tp, fm)
+
+
+class TestGymAdapter:
+    def test_reference_style_rollout_over_jax_env(self):
+        """The reference's while-not-done loop drives the device env."""
+        genv = GymFromJax(CartPole(), seed=0)
+        obs, _ = genv.reset(seed=3)
+        assert obs.shape == (4,)
+        total, steps = 0.0, 0
+        done = False
+        while not done and steps < 100:
+            obs, r, term, trunc, _ = genv.step(genv.action_space.sample())
+            total += r
+            steps += 1
+            done = term or trunc
+        assert steps > 0
+        assert total == steps  # CartPole: +1 per step
+
+    def test_truncation_at_max_steps(self):
+        genv = GymFromJax(CartPole(), seed=0, max_steps=5)
+        genv.reset(seed=1)
+        for i in range(5):
+            _, _, term, trunc, _ = genv.step(1)
+            if term:
+                break
+        assert term or trunc
+
+    def test_spaces_match_env(self):
+        genv = GymFromJax(CartPole())
+        assert genv.action_space.n == 2
+        assert genv.observation_space.shape == (4,)
+
+    def test_continuous_bounds_honored(self):
+        from estorch_tpu.envs import Pendulum
+
+        genv = GymFromJax(Pendulum())
+        assert float(genv.action_space.high[0]) == 2.0
+        assert float(genv.action_space.low[0]) == -2.0
+
+    def test_is_gymnasium_env_and_wrappable(self):
+        import gymnasium as gym
+
+        genv = GymFromJax(CartPole(), max_steps=10)
+        assert isinstance(genv, gym.Env)
+        wrapped = gym.wrappers.RecordEpisodeStatistics(genv)
+        obs, _ = wrapped.reset(seed=0)
+        for _ in range(10):
+            obs, r, term, trunc, info = wrapped.step(wrapped.action_space.sample())
+            if term or trunc:
+                break
+        assert term or trunc
+
+    def test_step_before_reset_raises(self):
+        import pytest
+
+        genv = GymFromJax(CartPole())
+        with pytest.raises(RuntimeError, match="reset"):
+            genv.step(0)
+
+    def test_bias_free_linear_rejected(self):
+        import pytest
+        import torch as t
+
+        tp = t.nn.Sequential(t.nn.Linear(4, 8, bias=False), t.nn.Linear(8, 2))
+        fm = MLPPolicy(action_dim=2, hidden=(8,))
+        with pytest.raises(ValueError, match="bias=False"):
+            torch_mlp_to_flax(tp, fm)
