@@ -17,13 +17,32 @@ core count for a per-core figure if comparing to the 720-core runs).
 """
 
 import json
+import subprocess
+import sys
 import time
 
 import numpy as np
 
 
-def measure_tpu(population=4096, horizon=200, gens=5) -> float:
-    import jax
+def _tpu_alive(timeout_s: int = 90) -> bool:
+    """Probe device init in a SUBPROCESS — the axon tunnel can wedge in a way
+    that hangs jax.devices() forever, which must not take bench down."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s,
+            capture_output=True,
+        )
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def measure_tpu(population=4096, horizon=200, gens=5, force_cpu=False) -> tuple[float, str]:
+    if force_cpu:
+        from estorch_tpu.utils import force_cpu_backend
+
+        force_cpu_backend(8)
     import optax
 
     from estorch_tpu import ES, JaxAgent, MLPPolicy
@@ -47,7 +66,8 @@ def measure_tpu(population=4096, horizon=200, gens=5) -> float:
     dt = time.perf_counter() - t0
     steps = sum(r["env_steps"] for r in es.history[-gens:])
     n_chips = es.mesh.devices.size
-    return steps / dt / n_chips
+    platform = es.mesh.devices.flat[0].platform
+    return steps / dt / n_chips, platform
 
 
 def measure_reference_style_baseline(budget_s=6.0) -> float:
@@ -77,15 +97,18 @@ def measure_reference_style_baseline(budget_s=6.0) -> float:
 
 
 def main():
-    tpu_rate = measure_tpu()
+    force_cpu = not _tpu_alive()
+    rate, platform = measure_tpu(force_cpu=force_cpu)
     base_rate = measure_reference_style_baseline()
+    unit = f"env-steps/s/chip (Pendulum MLP64x64 pop4096 h200, {platform}"
+    unit += ", TPU-TUNNEL-DOWN cpu fallback)" if force_cpu else ")"
     print(
         json.dumps(
             {
                 "metric": "env_steps_per_sec_per_chip",
-                "value": round(tpu_rate, 1),
-                "unit": "env-steps/s/chip (Pendulum MLP64x64 pop4096 h200)",
-                "vs_baseline": round(tpu_rate / base_rate, 2),
+                "value": round(rate, 1),
+                "unit": unit,
+                "vs_baseline": round(rate / base_rate, 2),
             }
         )
     )

@@ -32,7 +32,9 @@ class GymFromJax(gym.Env):
         self._key = jax.random.PRNGKey(seed)
         self._state = None
         self._steps = 0
-        self._max_steps = int(max_steps or env.default_horizon)
+        self._max_steps = (
+            int(env.default_horizon) if max_steps is None else int(max_steps)
+        )
         self._step_jit = jax.jit(env.step)
         self._reset_jit = jax.jit(env.reset)
 

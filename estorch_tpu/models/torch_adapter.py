@@ -52,13 +52,12 @@ def torch_mlp_to_flax(torch_policy, flax_module, example_obs=None) -> Any:
     """
     import jax
 
+    linears = _torch_linears(torch_policy)
     if example_obs is None:
-        first = _torch_linears(torch_policy)[0]
-        example_obs = jnp.zeros((first.in_features,), jnp.float32)
+        example_obs = jnp.zeros((linears[0].in_features,), jnp.float32)
     variables = flax_module.init(jax.random.PRNGKey(0), example_obs)
     params = jax.tree_util.tree_map(np.asarray, variables["params"])
 
-    linears = _torch_linears(torch_policy)
     names = _flax_dense_names(params)
     if len(linears) != len(names):
         raise ValueError(
@@ -94,5 +93,12 @@ def flax_mlp_to_torch(params: Any, torch_policy) -> None:
             # non-contiguous after .T, which torch.from_numpy rejects/warns on
             w = np.array(np.asarray(params[name]["kernel"]).T)
             b = np.array(np.asarray(params[name]["bias"]))
+            if tuple(lin.weight.shape) != w.shape:
+                # explicit check: Tensor.copy_ BROADCASTS, so a size-1
+                # mismatch would silently duplicate rows instead of erroring
+                raise ValueError(
+                    f"shape mismatch at {name}: torch {tuple(lin.weight.shape)} "
+                    f"vs flax (transposed) {w.shape}"
+                )
             lin.weight.copy_(torch.from_numpy(w))
             lin.bias.copy_(torch.from_numpy(b))

@@ -1,0 +1,23 @@
+"""Backend selection helpers for this image's quirky device setup.
+
+The axon sitecustomize pins ``JAX_PLATFORMS=axon`` and its get_backend
+override ignores the env var, so the only reliable way to run on CPU (for
+virtual-device sharding tests, dry runs, or tunnel-outage fallbacks) is an
+in-process config update BEFORE first device use.
+"""
+
+from __future__ import annotations
+
+
+def force_cpu_backend(n_devices: int = 8) -> bool:
+    """Best-effort switch to the CPU backend with ``n_devices`` virtual
+    devices.  Returns True if the config took; False if the backend was
+    already initialized (caller proceeds with whatever is live)."""
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", max(int(n_devices), 1))
+        return True
+    except Exception:
+        return False

@@ -48,6 +48,30 @@ class TestTorchFlaxAdapter:
         with pytest.raises(ValueError, match="layer count"):
             torch_mlp_to_flax(tp, fm)
 
+    def test_bias_free_linear_rejected(self):
+        import pytest
+
+        tp = torch.nn.Sequential(
+            torch.nn.Linear(4, 8, bias=False), torch.nn.Linear(8, 2)
+        )
+        fm = MLPPolicy(action_dim=2, hidden=(8,))
+        with pytest.raises(ValueError, match="bias=False"):
+            torch_mlp_to_flax(tp, fm)
+
+    def test_inverse_shape_mismatch_rejected(self):
+        """copy_ broadcasts — the adapter must catch size-1 mismatches."""
+        import pytest
+
+        fm = MLPPolicy(action_dim=1, hidden=(8,))
+        params = torch_mlp_to_flax(
+            torch.nn.Sequential(torch.nn.Linear(4, 8), torch.nn.Linear(8, 1)), fm
+        )
+        wrong_head = torch.nn.Sequential(
+            torch.nn.Linear(4, 8), torch.nn.Linear(8, 2)
+        )
+        with pytest.raises(ValueError, match="shape mismatch"):
+            flax_mlp_to_torch(params, wrong_head)
+
 
 class TestGymAdapter:
     def test_reference_style_rollout_over_jax_env(self):
@@ -106,11 +130,8 @@ class TestGymAdapter:
         with pytest.raises(RuntimeError, match="reset"):
             genv.step(0)
 
-    def test_bias_free_linear_rejected(self):
-        import pytest
-        import torch as t
-
-        tp = t.nn.Sequential(t.nn.Linear(4, 8, bias=False), t.nn.Linear(8, 2))
-        fm = MLPPolicy(action_dim=2, hidden=(8,))
-        with pytest.raises(ValueError, match="bias=False"):
-            torch_mlp_to_flax(tp, fm)
+    def test_max_steps_zero_honored(self):
+        genv = GymFromJax(CartPole(), max_steps=0)
+        genv.reset(seed=0)
+        _, _, term, trunc, _ = genv.step(1)
+        assert trunc  # horizon 0 → truncated immediately, not defaulted to 500
