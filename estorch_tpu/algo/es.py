@@ -233,9 +233,9 @@ class ES:
         from ..envs.native_pool import env_spec
         from ..parallel.pooled import PooledEngine
 
-        obs_dim = env_spec(self.agent.env_name)["obs_dim"]
+        spec_info = env_spec(self.agent.env_name)
         self.env = None
-        obs0 = jnp.zeros((obs_dim,), jnp.float32)
+        obs0 = jnp.zeros(spec_info["obs_shape"], jnp.float32)
 
         def vbn_ref(vbn_key):
             del vbn_key  # pool RNG is numpy-seeded
@@ -254,7 +254,8 @@ class ES:
         self.state = self.engine.init_state(flat, state_key)
 
     def _pooled_reference_batch(self, n: int):
-        """Random-action observations from the pool for VBN statistics."""
+        """Random-action observations from the pool for VBN statistics,
+        reshaped to the policy-facing observation shape (pixels etc.)."""
         from ..envs.native_pool import NativeEnvPool
 
         pool = NativeEnvPool(self.agent.env_name, n_envs=max(1, n // 4))
@@ -262,14 +263,17 @@ class ES:
         frames = [pool.reset()]
         for _ in range(4):
             if pool.discrete:
-                acts = rng.integers(0, 2, (pool.n_envs, 1)).astype(np.float32)
+                acts = rng.integers(0, pool.n_actions, (pool.n_envs, 1)).astype(
+                    np.float32
+                )
             else:
                 acts = rng.uniform(-1, 1, (pool.n_envs, pool.act_dim)).astype(np.float32)
             obs, _, _ = pool.step(acts)
             frames.append(obs)
+        obs_shape = pool.obs_shape
         pool.close()
         batch = np.concatenate(frames, axis=0)[:n]
-        return jnp.asarray(batch)
+        return jnp.asarray(batch.reshape((-1,) + tuple(obs_shape)))
 
     # ----------------------------------------------------------- host backend
 

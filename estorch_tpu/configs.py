@@ -11,7 +11,10 @@ this environment can execute it on:
 3. humanoid_mirrored— Humanoid (gymnasium MuJoCo), MLP, mirrored ES, pop 10k
                       (host path, same note)
 4. humanoid_nsres   — NSR-ES on Humanoid with BC = final (x, y) torso position
-5. atari_frostbite  — Frostbite Nature-CNN pop 5k — GATED: ale_py is not in
+5. pong84_conv      — the conv-rollout stress path: NatureCNN population on
+                      the bundled 84×84 C++ pixel pong (pooled execution);
+                      stands in for the Atari config without ALE
+6. atari_frostbite  — Frostbite Nature-CNN pop 5k — GATED: ale_py is not in
                       this image; raises with a clear message.
 
 Use:  python -m estorch_tpu.configs <name> [--generations N] [--n-proc K]
@@ -160,6 +163,29 @@ def humanoid_nsres(**over):
     return NSR_ES(**kw)
 
 
+def pong84_conv(**over):
+    """Conv-rollout stress without ALE: NatureCNN on the bundled C++ pixel
+    pong (84×84), pooled execution — the same machinery BASELINE config 5
+    exercises, with the env swapped for the in-tree stand-in."""
+    import optax
+
+    from . import ES, NatureCNN, PooledAgent
+
+    kw = dict(
+        policy=NatureCNN,
+        agent=PooledAgent,
+        optimizer=optax.adam,
+        population_size=256,
+        sigma=0.02,
+        policy_kwargs={"action_dim": 3, "use_vbn": True},
+        agent_kwargs={"env_name": "pong84", "horizon": 500},
+        optimizer_kwargs={"learning_rate": 1e-2},
+        table_size=1 << 23,
+    )
+    kw.update(over)
+    return ES(**kw)
+
+
 def atari_frostbite(**over):
     """BASELINE config 5 — Frostbite Nature-CNN pop 5k. Gated: needs ALE."""
     try:
@@ -191,6 +217,7 @@ CONFIGS: dict[str, Callable] = {
     "halfcheetah_vbn": halfcheetah_vbn,
     "humanoid_mirrored": humanoid_mirrored,
     "humanoid_nsres": humanoid_nsres,
+    "pong84_conv": pong84_conv,
     "atari_frostbite": atari_frostbite,
 }
 

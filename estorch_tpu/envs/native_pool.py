@@ -20,10 +20,14 @@ import numpy as np
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "native")
 _LIB_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "libenvpool.so"))
 
-ENV_IDS = {"cartpole": 0, "pendulum": 1}
-_OBS_DIMS = {0: 4, 1: 3}
-_ACT_DIMS = {0: 1, 1: 1}
-_DISCRETE = {0: True, 1: False}
+ENV_IDS = {"cartpole": 0, "pendulum": 1, "pong84": 2}
+# policy-facing observation shape; differs from the flat buffer for pixels
+_OBS_SHAPES = {0: (4,), 1: (3,), 2: (84, 84, 1)}
+_OBS_DIMS = {k: int(np.prod(v)) for k, v in _OBS_SHAPES.items()}
+_ACT_DIMS = {0: 1, 1: 1, 2: 1}
+_DISCRETE = {0: True, 1: False, 2: True}
+_N_ACTIONS = {0: 2, 1: 0, 2: 3}  # discrete action count (0 = continuous)
+_NUMPY_FALLBACK_IDS = (0, 1)  # envs _NumpyPool actually implements
 
 
 def env_spec(env_name: str) -> dict:
@@ -34,8 +38,10 @@ def env_spec(env_name: str) -> dict:
     return {
         "env_id": eid,
         "obs_dim": _OBS_DIMS[eid],
+        "obs_shape": _OBS_SHAPES[eid],
         "act_dim": _ACT_DIMS[eid],
         "discrete": _DISCRETE[eid],
+        "n_actions": _N_ACTIONS[eid],
     }
 
 
@@ -107,8 +113,10 @@ class NativeEnvPool:
         self.env_id = ENV_IDS[env]
         self.n_envs = int(n_envs)
         self.obs_dim = _OBS_DIMS[self.env_id]
+        self.obs_shape = _OBS_SHAPES[self.env_id]
         self.act_dim = _ACT_DIMS[self.env_id]
         self.discrete = _DISCRETE[self.env_id]
+        self.n_actions = _N_ACTIONS[self.env_id]
         n_threads = n_threads or min(os.cpu_count() or 1, 16)
 
         self._lib = _get_lib()
@@ -118,6 +126,13 @@ class NativeEnvPool:
                 self.env_id, self.n_envs, int(n_threads), int(seed)
             )
         if self._handle is None:
+            if self.env_id not in _NUMPY_FALLBACK_IDS:
+                raise RuntimeError(
+                    f"{env!r} requires the C++ envpool (the NumPy fallback "
+                    f"implements only "
+                    f"{[k for k, v in ENV_IDS.items() if v in _NUMPY_FALLBACK_IDS]}); "
+                    "ensure g++/make are available so estorch_tpu/native builds"
+                )
             self._fallback = _NumpyPool(self.env_id, self.n_envs, seed)
         else:
             self._fallback = None

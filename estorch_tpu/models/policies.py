@@ -64,7 +64,10 @@ class NatureCNN(nn.Module):
         squeeze = x.ndim == 3
         if squeeze:  # single observation -> add batch axis for convs
             x = x[None]
-        x = x.astype(jnp.float32) / 255.0
+        if jnp.issubdtype(x.dtype, jnp.integer):
+            x = x.astype(jnp.float32) / 255.0  # raw Atari bytes
+        else:
+            x = x.astype(jnp.float32)  # already-normalized pixels (pong84)
         for i, (feat, kern, stride) in enumerate(
             [(32, 8, 4), (64, 4, 2), (64, 3, 1)]
         ):

@@ -112,6 +112,97 @@ struct PendulumEnv {
   }
 };
 
+// Pong84 (env id 2): a minimal pixel pong rendered to 84x84x1 — the
+// conv-rollout stress stand-in for the Atari config (BASELINE config 5) in
+// an image without ALE.  The agent drives the LEFT paddle with 3 actions
+// (stay/up/down); the right paddle is a simple ball tracker.  Reward +1
+// when the opponent misses, -1 when the agent misses; episode ends on the
+// first point.  Observation: normalized float32 pixels in [0, 1] (ball and
+// paddles drawn white on black), flattened row-major 84*84.
+struct Pong84Env {
+  static constexpr int kSize = 84;
+  static constexpr int kObsDim = kSize * kSize;
+  static constexpr int kActDim = 1;  // discrete {0,1,2} passed as float
+  static constexpr float kPaddleSpeed = 2.0f;
+  static constexpr float kOppSpeed = 1.2f;   // beatable tracker
+  static constexpr int kPaddleHalf = 6;      // paddle half-height in px
+  static constexpr float kBallSpeed = 1.6f;
+
+  float ball_x, ball_y, vel_x, vel_y;  // pixel coordinates
+  float left_y, right_y;               // paddle centers
+
+  void reset(std::mt19937& rng) {
+    std::uniform_real_distribution<float> dy(20.0f, 64.0f);
+    std::uniform_real_distribution<float> dv(-0.8f, 0.8f);
+    ball_x = kSize / 2.0f;
+    ball_y = dy(rng);
+    vel_x = (rng() & 1) ? kBallSpeed : -kBallSpeed;
+    vel_y = dv(rng);
+    left_y = kSize / 2.0f;
+    right_y = kSize / 2.0f;
+  }
+
+  bool step(const float* action, float* reward, std::mt19937& rng) {
+    const int a = static_cast<int>(action[0] + 0.5f);
+    if (a == 1) left_y -= kPaddleSpeed;
+    else if (a == 2) left_y += kPaddleSpeed;
+    left_y = left_y < kPaddleHalf ? kPaddleHalf
+             : (left_y > kSize - kPaddleHalf ? kSize - kPaddleHalf : left_y);
+
+    // opponent tracks the ball with capped speed
+    const float dy = ball_y - right_y;
+    right_y += dy > kOppSpeed ? kOppSpeed : (dy < -kOppSpeed ? -kOppSpeed : dy);
+    right_y = right_y < kPaddleHalf ? kPaddleHalf
+              : (right_y > kSize - kPaddleHalf ? kSize - kPaddleHalf : right_y);
+
+    ball_x += vel_x;
+    ball_y += vel_y;
+    if (ball_y < 1.0f) { ball_y = 1.0f; vel_y = -vel_y; }
+    if (ball_y > kSize - 1.0f) { ball_y = kSize - 1.0f; vel_y = -vel_y; }
+
+    *reward = 0.0f;
+    // left paddle plane at x=3, right at x=80
+    if (ball_x <= 3.0f) {
+      if (std::fabs(ball_y - left_y) <= kPaddleHalf + 1.0f) {
+        vel_x = -vel_x;
+        ball_x = 3.0f;
+        std::uniform_real_distribution<float> spin(-0.5f, 0.5f);
+        vel_y += spin(rng);
+      } else {
+        *reward = -1.0f;
+        return true;
+      }
+    }
+    if (ball_x >= kSize - 4.0f) {
+      if (std::fabs(ball_y - right_y) <= kPaddleHalf + 1.0f) {
+        vel_x = -vel_x;
+        ball_x = kSize - 4.0f;
+      } else {
+        *reward = 1.0f;
+        return true;
+      }
+    }
+    return false;
+  }
+
+  void observe(float* obs) const {
+    std::memset(obs, 0, sizeof(float) * kObsDim);
+    auto draw = [obs](int x, int y) {
+      if (x >= 0 && x < kSize && y >= 0 && y < kSize) obs[y * kSize + x] = 1.0f;
+    };
+    const int by = static_cast<int>(ball_y);
+    const int bx = static_cast<int>(ball_x);
+    for (int dy = -1; dy <= 1; dy++)
+      for (int dx = -1; dx <= 1; dx++) draw(bx + dx, by + dy);
+    for (int dy = -kPaddleHalf; dy <= kPaddleHalf; dy++) {
+      draw(2, static_cast<int>(left_y) + dy);
+      draw(3, static_cast<int>(left_y) + dy);
+      draw(kSize - 4, static_cast<int>(right_y) + dy);
+      draw(kSize - 3, static_cast<int>(right_y) + dy);
+    }
+  }
+};
+
 // ------------------------------------------------------------ thread pool
 
 // One pool = N envs of one type + a persistent worker team.  Workers park on
@@ -124,7 +215,8 @@ class Pool {
       : env_id_(env_id), n_envs_(n_envs),
         n_threads_(n_threads < 1 ? 1 : (n_threads > n_envs ? n_envs : n_threads)) {
     if (env_id_ == 0) cartpoles_.resize(n_envs_);
-    else pendulums_.resize(n_envs_);
+    else if (env_id_ == 1) pendulums_.resize(n_envs_);
+    else pongs_.resize(n_envs_);
     rngs_.reserve(n_envs_);
     for (int i = 0; i < n_envs_; i++) {
       rngs_.emplace_back(static_cast<uint32_t>(seed + 0x9E3779B9u * (i + 1)));
@@ -144,8 +236,16 @@ class Pool {
     for (auto& w : workers_) w.join();
   }
 
-  int obs_dim() const { return env_id_ == 0 ? CartPoleEnv::kObsDim : PendulumEnv::kObsDim; }
-  int act_dim() const { return env_id_ == 0 ? CartPoleEnv::kActDim : PendulumEnv::kActDim; }
+  int obs_dim() const {
+    if (env_id_ == 0) return CartPoleEnv::kObsDim;
+    if (env_id_ == 1) return PendulumEnv::kObsDim;
+    return Pong84Env::kObsDim;
+  }
+  int act_dim() const {
+    if (env_id_ == 0) return CartPoleEnv::kActDim;
+    if (env_id_ == 1) return PendulumEnv::kActDim;
+    return Pong84Env::kActDim;
+  }
 
   void reset(float* obs_out) {
     run_job(Job{JobKind::kReset, nullptr, obs_out, nullptr, nullptr});
@@ -204,7 +304,8 @@ class Pool {
     for (int i = begin; i < end; i++) {
       if (job.kind == JobKind::kReset) {
         if (env_id_ == 0) { cartpoles_[i].reset(rngs_[i]); cartpoles_[i].observe(job.obs + i * od); }
-        else { pendulums_[i].reset(rngs_[i]); pendulums_[i].observe(job.obs + i * od); }
+        else if (env_id_ == 1) { pendulums_[i].reset(rngs_[i]); pendulums_[i].observe(job.obs + i * od); }
+        else { pongs_[i].reset(rngs_[i]); pongs_[i].observe(job.obs + i * od); }
       } else {
         float r = 0.0f;
         bool d;
@@ -213,10 +314,14 @@ class Pool {
           // auto-reset so downstream batching never sees a dead env
           if (d) cartpoles_[i].reset(rngs_[i]);
           cartpoles_[i].observe(job.obs + i * od);
-        } else {
+        } else if (env_id_ == 1) {
           d = pendulums_[i].step(job.actions + i * ad, &r);
           if (d) pendulums_[i].reset(rngs_[i]);
           pendulums_[i].observe(job.obs + i * od);
+        } else {
+          d = pongs_[i].step(job.actions + i * ad, &r, rngs_[i]);
+          if (d) pongs_[i].reset(rngs_[i]);
+          pongs_[i].observe(job.obs + i * od);
         }
         job.rew[i] = r;
         job.done[i] = d ? 1 : 0;
@@ -227,6 +332,7 @@ class Pool {
   const int env_id_, n_envs_, n_threads_;
   std::vector<CartPoleEnv> cartpoles_;
   std::vector<PendulumEnv> pendulums_;
+  std::vector<Pong84Env> pongs_;
   std::vector<std::mt19937> rngs_;
   std::vector<std::thread> workers_;
 
@@ -243,7 +349,7 @@ class Pool {
 extern "C" {
 
 void* envpool_create(int env_id, int n_envs, int n_threads, uint64_t seed) {
-  if (env_id < 0 || env_id > 1 || n_envs <= 0) return nullptr;
+  if (env_id < 0 || env_id > 2 || n_envs <= 0) return nullptr;
   return new Pool(env_id, n_envs, n_threads, seed);
 }
 

@@ -115,7 +115,12 @@ class ESEngine:
                 p16 = jax.tree_util.tree_map(
                     lambda x: x.astype(jnp.bfloat16), p
                 )
-                out = base_apply(p16, obs.astype(jnp.bfloat16))
+                # cast only FLOATING observations: integer obs (raw pixel
+                # bytes) must reach the policy unchanged so its own
+                # normalization (e.g. NatureCNN's /255) still fires
+                if jnp.issubdtype(obs.dtype, jnp.floating):
+                    obs = obs.astype(jnp.bfloat16)
+                out = base_apply(p16, obs)
                 return out.astype(jnp.float32)
 
         self.policy_apply = policy_apply

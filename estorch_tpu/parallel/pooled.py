@@ -66,6 +66,7 @@ class PooledEngine:
         self.center_pool = NativeEnvPool(env_name, n_envs=1, n_threads=1, seed=seed + 1)
         self.bc_dim = self.pool.obs_dim  # BC = final observation
         discrete = self.pool.discrete
+        obs_shape = self.pool.obs_shape  # policy-facing shape (pixels etc.)
 
         def materialize(params_flat, pair_offs):
             """(population, dim) perturbed parameter matrix from the table."""
@@ -81,7 +82,7 @@ class PooledEngine:
         def batch_actions(thetas, obs):
             """One env step's policy forward for the whole population."""
             def one(theta, o):
-                out = policy_apply(spec.unravel(theta), o)
+                out = policy_apply(spec.unravel(theta), o.reshape(obs_shape))
                 if discrete:
                     return jnp.argmax(out, axis=-1).astype(jnp.float32)
                 return out.reshape(-1)
@@ -90,7 +91,7 @@ class PooledEngine:
         self._batch_actions = jax.jit(batch_actions)
 
         def center_action(params_flat, obs):
-            out = policy_apply(spec.unravel(params_flat), obs)
+            out = policy_apply(spec.unravel(params_flat), obs.reshape(obs_shape))
             if discrete:
                 return jnp.argmax(out, axis=-1).astype(jnp.float32)
             return out.reshape(-1)

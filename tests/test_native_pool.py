@@ -166,3 +166,49 @@ class TestPooledBackend:
         )
         es.train(1, verbose=False)
         assert "vbn_stats" in es._frozen
+
+
+class TestPong84ConvPath:
+    """The Atari-config machinery (conv policy + pooled pixel env) end to
+    end, using the bundled pong84 C++ env in place of ALE (BASELINE config 5
+    stand-in)."""
+
+    def test_pong84_env_semantics(self, native_available):
+        pool = NativeEnvPool("pong84", 4, n_threads=2, seed=0)
+        obs = pool.reset()
+        assert obs.shape == (4, 84 * 84)
+        assert pool.obs_shape == (84, 84, 1)
+        assert pool.discrete and pool.n_actions == 3
+        # pixels are binary {0, 1}
+        assert set(np.unique(obs)).issubset({0.0, 1.0})
+        # a still agent eventually concedes points (negative rewards)
+        total = np.zeros(4)
+        for _ in range(400):
+            _, r, _ = pool.step(np.zeros((4, 1), np.float32))
+            total += r
+        assert np.all(total <= 0) and np.any(total < 0)
+        pool.close()
+
+    def test_naturecnn_es_on_pong84(self, native_available):
+        """Full conv rollout: NatureCNN population through the pooled path."""
+        from estorch_tpu import NatureCNN
+        from estorch_tpu.parallel import single_device_mesh
+
+        es = ES(
+            policy=NatureCNN,
+            agent=PooledAgent,
+            optimizer=optax.adam,
+            population_size=4,
+            sigma=0.05,
+            seed=0,
+            policy_kwargs={"action_dim": 3, "use_vbn": False},
+            agent_kwargs={"env_name": "pong84", "horizon": 40},
+            optimizer_kwargs={"learning_rate": 1e-2},
+            table_size=1 << 21,  # NatureCNN ~1.7M params needs a larger table
+            mesh=single_device_mesh(),  # pop 4 need not divide the 8-dev mesh
+        )
+        es.train(2, verbose=False)
+        assert es.backend == "pooled"
+        assert len(es.history) == 2
+        assert es.history[-1]["env_steps"] > 0
+        assert np.isfinite(es.history[-1]["reward_mean"])
