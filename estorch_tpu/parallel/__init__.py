@@ -1,7 +1,17 @@
 from .engine import EngineConfig, ESEngine, ESState, EvalResult
 from .mesh import POP_AXIS, pairs_per_device, population_mesh, single_device_mesh
+from .multihost import (
+    global_population_mesh,
+    initialize as initialize_distributed,
+    leader_only,
+    process_info,
+)
 
 __all__ = [
+    "global_population_mesh",
+    "initialize_distributed",
+    "leader_only",
+    "process_info",
     "EngineConfig",
     "ESEngine",
     "ESState",

@@ -1,0 +1,105 @@
+"""Multi-host / multi-slice launch helpers.
+
+The reference scales with ``mpirun``-style process groups and
+``torch.distributed`` CPU collectives (SURVEY.md §2 item 7).  The TPU-native
+equivalent is JAX's multi-process runtime: one Python process per host, each
+seeing its local chips, with XLA collectives spanning all of them — ICI
+inside a slice, DCN across slices — through the SAME ``lax.psum`` the
+single-host engine already emits.  Nothing in the generation program changes
+with scale; only the mesh does.
+
+Launch recipe (one command per host):
+
+    # host 0 .. N-1, e.g. under SLURM/GKE each process runs:
+    import estorch_tpu.parallel.multihost as mh
+    mh.initialize()                    # env-driven (TPU pods auto-discover)
+    es = ES(..., mesh=mh.global_population_mesh())
+    es.train(...)                      # identical code to single host
+
+Design notes for the broadcast-free update in multi-process SPMD:
+
+- every process constructs the identical ESState (same seed), and every
+  jitted program input is fully replicated (P()), so processes stay
+  bit-synchronized without any parameter broadcast — the same property the
+  single-host engine has across devices;
+- the population axis spans ALL global devices; each host's chips roll out
+  their shard and the psum's DCN leg only carries O(dim) floats per
+  generation plus the O(population) fitness all_gather;
+- host-side novelty state (archive, meta-selection RNG) is derived from
+  device-gathered, fully-replicated arrays plus the checkpointed RNG — all
+  hosts compute identical archives without communication.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from .mesh import population_mesh
+
+
+def initialize(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> bool:
+    """Bring up the JAX multi-process runtime.  Returns True if distributed
+    init actually happened, False for a single-process fallback.
+
+    On Cloud TPU pods / managed clusters ``jax.distributed.initialize()``
+    auto-discovers everything from the environment — so we ALWAYS attempt
+    it.  Off-cluster, the argless attempt raises; when no arguments were
+    given we treat that as a single-process run (the degenerate case the
+    rest of the library handles identically).  Explicit arguments are never
+    swallowed: failures with them re-raise.  Must be called before any
+    device use (no jax API that touches backends runs before the attempt).
+    """
+    explicit = any(a is not None for a in (coordinator_address, num_processes, process_id))
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        return True
+    except Exception:
+        if explicit:
+            raise
+        return False  # not a cluster: single-process run
+
+
+def global_population_mesh():
+    """1-D population mesh over ALL devices of ALL processes.
+
+    ``jax.devices()`` in a multi-process runtime returns the global device
+    list; the mesh (and hence the psum) spans every chip in the job.
+    """
+    return population_mesh(jax.devices())
+
+
+def process_info() -> dict:
+    """Who am I in the job — for logging/checkpoint-leader election."""
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+        "is_leader": jax.process_index() == 0,
+    }
+
+
+def leader_only(fn):
+    """Decorator: run ``fn`` only on process 0 (checkpoint writes, logging).
+
+    All processes compute identical state, so side effects need exactly one
+    writer; everyone else gets ``None``.
+    """
+
+    import functools
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        if jax.process_index() == 0:
+            return fn(*args, **kwargs)
+        return None
+
+    return wrapped

@@ -1,0 +1,83 @@
+"""Fixed-seed golden values per algorithm (SURVEY.md §4 'Convergence/
+regression'): refactors must not silently change the math.
+
+Captured on the 8-virtual-device CPU backend at the settings below.  A
+legitimate algorithm change (e.g. a deliberate estimator fix) should update
+these values IN THE SAME COMMIT with a note; an unexpected diff here means
+the refactor changed numerics.
+"""
+
+import numpy as np
+import optax
+import pytest
+
+from estorch_tpu import ES, NS_ES, NSR_ES, NSRA_ES, JaxAgent, MLPPolicy
+from estorch_tpu.envs import CartPole
+
+GOLDENS = {
+    "ES": {"reward_means": [43.0, 40.375, 43.5625], "params_sum": -5.57803},
+    "NS_ES": {
+        "reward_means": [35.125, 36.875, 34.1875],
+        "meta_sums": [-5.61163, -1.94561],
+        "archive_sum": -0.00939,
+        "meta_indices": [1, 1, 1],
+    },
+    "NSR_ES": {
+        "reward_means": [35.125, 37.125, 40.4375],
+        "meta_sums": [-5.61163, -2.01648],
+        "archive_sum": 0.29665,
+        "meta_indices": [1, 1, 1],
+    },
+    "NSRA_ES": {
+        "reward_means": [35.125, 37.1875, 40.4375],
+        "meta_sums": [-5.61163, -1.96853],
+        "archive_sum": 0.30099,
+        "meta_indices": [1, 1, 1],
+    },
+}
+
+CLASSES = {"ES": ES, "NS_ES": NS_ES, "NSR_ES": NSR_ES, "NSRA_ES": NSRA_ES}
+EXTRA = {
+    "ES": {},
+    "NS_ES": {"meta_population_size": 2, "k": 3},
+    "NSR_ES": {"meta_population_size": 2, "k": 3},
+    "NSRA_ES": {"meta_population_size": 2, "k": 3, "weight": 0.7},
+}
+
+
+def _run(name):
+    es = CLASSES[name](
+        policy=MLPPolicy,
+        agent=JaxAgent,
+        optimizer=optax.adam,
+        population_size=16,
+        sigma=0.1,
+        seed=7,
+        policy_kwargs={"action_dim": 2, "hidden": (8,)},
+        agent_kwargs={"env": CartPole(), "horizon": 50},
+        optimizer_kwargs={"learning_rate": 1e-2},
+        table_size=1 << 15,
+        **EXTRA[name],
+    )
+    es.train(3, verbose=False)
+    return es
+
+
+@pytest.mark.parametrize("name", sorted(GOLDENS))
+def test_golden(name):
+    es = _run(name)
+    g = GOLDENS[name]
+    got_means = [round(r["reward_mean"], 4) for r in es.history]
+    assert got_means == g["reward_means"], f"{name} reward trajectory changed"
+    if name == "ES":
+        got = round(float(np.asarray(es.state.params_flat).sum()), 5)
+        np.testing.assert_allclose(got, g["params_sum"], atol=2e-4)
+    else:
+        got_sums = [
+            round(float(np.asarray(s.params_flat).sum()), 5) for s in es.meta_states
+        ]
+        np.testing.assert_allclose(got_sums, g["meta_sums"], atol=2e-4)
+        np.testing.assert_allclose(
+            round(float(es.archive.bcs.sum()), 5), g["archive_sum"], atol=2e-4
+        )
+        assert [r["meta_index"] for r in es.history] == g["meta_indices"]
