@@ -100,6 +100,8 @@ class ES:
         mesh=None,
         vbn_batch: int = 128,
         compute_dtype: str = "float32",
+        sigma_decay: float = 1.0,
+        sigma_min: float = 0.0,
     ):
         self.population_size = population_size
         self.sigma = sigma
@@ -109,6 +111,8 @@ class ES:
                 f"compute_dtype must be float32 or bfloat16, got {compute_dtype!r}"
             )
         self._compute_dtype = compute_dtype
+        self._sigma_decay = float(sigma_decay)
+        self._sigma_min = float(sigma_min)
 
         self._policy_arg = policy
         self._policy_kwargs = dict(policy_kwargs or {})
@@ -125,6 +129,11 @@ class ES:
                 raise ValueError(
                     "compute_dtype is a device/pooled-path option; the host "
                     "backend runs torch policies in their native dtype"
+                )
+            if sigma_decay != 1.0:
+                raise ValueError(
+                    "sigma_decay is a device/pooled-path option; it is not "
+                    "implemented on the host backend (pass sigma_decay=1.0)"
                 )
             self.backend = "host"
             self._init_host(
@@ -213,6 +222,8 @@ class ES:
             grad_chunk=grad_chunk,
             weight_decay=weight_decay,
             compute_dtype=self._compute_dtype,
+            sigma_decay=self._sigma_decay,
+            sigma_min=self._sigma_min,
         )
         return flat, state_key
 

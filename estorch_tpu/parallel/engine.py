@@ -57,6 +57,8 @@ class EngineConfig:
     compute_dtype: str = "float32"  # "bfloat16" runs the POLICY forward in
     # bf16 (MXU-native, half the HBM traffic for the per-member weights);
     # params, noise table, env dynamics, and the update stay float32
+    sigma_decay: float = 1.0  # per-generation multiplicative σ annealing
+    sigma_min: float = 0.0  # σ floor when annealing
 
 
 class ESState(NamedTuple):
@@ -66,6 +68,7 @@ class ESState(NamedTuple):
     opt_state: Any
     key: jax.Array  # PRNG key, folded with generation for per-gen streams
     generation: jax.Array  # () int32
+    sigma: jax.Array  # () float32 — current perturbation scale (annealable)
 
 
 class EvalResult(NamedTuple):
@@ -232,7 +235,7 @@ class ESEngine:
 
             def member_eval(off, sign, key):
                 eps = self.table.slice(off, dim)
-                theta = state.params_flat + cfg.sigma * sign * eps
+                theta = state.params_flat + state.sigma * sign * eps
                 res = self._rollout(self.spec.unravel(theta), key)
                 return res.total_reward, res.bc, res.steps
 
@@ -267,7 +270,7 @@ class ESEngine:
         # local folded partial of the estimator; scaling commutes with psum
         grad_local = es_gradient(
             self.table, pair_offs, w_local,
-            sigma=cfg.sigma, population_size=cfg.population_size,
+            sigma=state.sigma, population_size=cfg.population_size,
             dim=self.spec.dim, chunk=cfg.grad_chunk,
         )
         grad_ascent = jax.lax.psum(grad_local, POP_AXIS)
@@ -277,11 +280,15 @@ class ESEngine:
             -grad_ascent, state.opt_state, state.params_flat
         )
         new_params = optax.apply_updates(state.params_flat, updates)
+        new_sigma = state.sigma
+        if cfg.sigma_decay != 1.0:
+            new_sigma = jnp.maximum(state.sigma * cfg.sigma_decay, cfg.sigma_min)
         new_state = ESState(
             params_flat=new_params,
             opt_state=new_opt_state,
             key=state.key,
             generation=state.generation + 1,
+            sigma=new_sigma,
         )
         return new_state, jnp.linalg.norm(grad_ascent)
 
@@ -320,6 +327,7 @@ class ESEngine:
             opt_state=self.optimizer.init(params_flat),
             key=key,
             generation=jnp.int32(0),
+            sigma=jnp.float32(self.config.sigma),
         )
 
     def compile(self, state: ESState) -> float:
@@ -373,4 +381,4 @@ class ESEngine:
         pair = member_index // 2
         sign = 1.0 if member_index % 2 == 0 else -1.0
         eps = self.table.slice(all_pair_offsets[pair], self.spec.dim)
-        return state.params_flat + self.config.sigma * sign * eps
+        return state.params_flat + state.sigma * sign * eps

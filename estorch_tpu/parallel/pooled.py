@@ -68,13 +68,13 @@ class PooledEngine:
         discrete = self.pool.discrete
         obs_shape = self.pool.obs_shape  # policy-facing shape (pixels etc.)
 
-        def materialize(params_flat, pair_offs):
+        def materialize(params_flat, sigma, pair_offs):
             """(population, dim) perturbed parameter matrix from the table."""
             offs = member_offsets(pair_offs)
             signs = pair_signs(config.population_size)
             def one(off, sign):
                 eps = self.core.table.slice(off, spec.dim)
-                return params_flat + config.sigma * sign * eps
+                return params_flat + sigma * sign * eps
             return jax.vmap(one)(offs, signs)
 
         self._materialize = jax.jit(materialize)
@@ -108,7 +108,7 @@ class PooledEngine:
 
         t0 = _time.perf_counter()
         pair_offs = self.core.all_pair_offsets(state)
-        thetas = self._materialize(state.params_flat, pair_offs)
+        thetas = self._materialize(state.params_flat, state.sigma, pair_offs)
         obs = jnp.zeros((self.config.population_size, self.pool.obs_dim), jnp.float32)
         self._batch_actions(thetas, obs).block_until_ready()
         dummy_w = jnp.zeros((self.config.population_size,), jnp.float32)
@@ -124,7 +124,7 @@ class PooledEngine:
         n = self.config.population_size
         horizon = self.config.horizon
         pair_offs = self.core.all_pair_offsets(state)
-        thetas = self._materialize(state.params_flat, pair_offs)
+        thetas = self._materialize(state.params_flat, state.sigma, pair_offs)
 
         obs = self.pool.reset()
         total = np.zeros(n, np.float32)

@@ -40,6 +40,7 @@ def _pack_state(es, st) -> dict:
     else:
         d["key"] = _np(st.key)
         d["opt_state"] = _to_numpy_tree(st.opt_state)
+        d["sigma"] = float(st.sigma)
     return d
 
 
@@ -72,9 +73,12 @@ def _state_tree(es) -> dict:
     return tree
 
 
+CHECKPOINT_FORMAT_VERSION = 2  # v2: device states carry annealable sigma
+
+
 def _meta_dict(es) -> dict:
     meta = {
-        "format_version": 1,
+        "format_version": CHECKPOINT_FORMAT_VERSION,
         "backend": es.backend,
         "algo": type(es).__name__,
         "population_size": es.population_size,
@@ -128,6 +132,13 @@ def restore_checkpoint(es, path: str) -> None:
     path = os.path.abspath(path)
     with open(os.path.join(path, "meta.json")) as f:
         meta = json.load(f)
+    version = meta.get("format_version", 0)
+    if version != CHECKPOINT_FORMAT_VERSION:
+        raise ValueError(
+            f"checkpoint format v{version} != supported "
+            f"v{CHECKPOINT_FORMAT_VERSION} (v1 device states lack the "
+            "annealable sigma field); re-save from the run that wrote it"
+        )
     if meta["backend"] != es.backend:
         raise ValueError(
             f"checkpoint backend {meta['backend']!r} != this object's {es.backend!r}"
@@ -196,6 +207,7 @@ def _unpack_state(es, packed: dict, host_opt=None):
         opt_state=packed["opt_state"],
         key=jnp.asarray(packed["key"]),
         generation=jnp.int32(packed["generation"]),
+        sigma=jnp.float32(packed["sigma"]),
     )
 
 

@@ -150,6 +150,44 @@ class TestMeshValidation:
         assert float(res.total_reward) == float(ev.fitness[5])
 
 
+class TestSigmaAnnealing:
+    def test_sigma_decays_with_floor(self, setup):
+        cfg = EngineConfig(
+            population_size=32, sigma=0.1, horizon=20, eval_chunk=8,
+            sigma_decay=0.5, sigma_min=0.02,
+        )
+        e = ESEngine(setup["env"], setup["apply"], setup["spec"], setup["table"],
+                     setup["opt"], cfg, population_mesh())
+        s = e.init_state(setup["flat"], jax.random.PRNGKey(0))
+        sigmas = [float(s.sigma)]
+        for _ in range(4):
+            s, _ = e.generation_step(s)
+            sigmas.append(float(np.asarray(s.sigma)))
+        np.testing.assert_allclose(sigmas, [0.1, 0.05, 0.025, 0.02, 0.02], rtol=1e-6)
+
+    def test_member_reconstruction_uses_state_sigma(self, setup):
+        cfg = EngineConfig(
+            population_size=32, sigma=0.1, horizon=20, eval_chunk=8,
+            sigma_decay=0.5,
+        )
+        e = ESEngine(setup["env"], setup["apply"], setup["spec"], setup["table"],
+                     setup["opt"], cfg, single_device_mesh())
+        s = e.init_state(setup["flat"], jax.random.PRNGKey(0))
+        s, _ = e.generation_step(s)  # sigma now 0.05
+        theta = np.asarray(e.member_params(s, 0))
+        # exact reconstruction with the DECAYED state sigma
+        offs = e.all_pair_offsets(s)
+        eps = np.asarray(setup["table"].slice(offs[0], setup["spec"].dim))
+        expected = np.asarray(s.params_flat) + float(np.asarray(s.sigma)) * eps
+        np.testing.assert_allclose(theta, expected, rtol=1e-6, atol=1e-7)
+
+    def test_default_no_decay_keeps_sigma(self, setup):
+        e = _engine(setup, population_mesh())
+        s = e.init_state(setup["flat"], jax.random.PRNGKey(0))
+        s, _ = e.generation_step(s)
+        assert float(np.asarray(s.sigma)) == pytest.approx(setup["cfg"].sigma)
+
+
 class TestLearning:
     def test_cartpole_learns(self, setup):
         """Fitness must rise substantially within a few generations (smoke =

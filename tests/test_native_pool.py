@@ -146,7 +146,9 @@ class TestPooledBackend:
         member_params(i) equals the i-th row of the materialized thetas."""
         es = self._make()
         pair_offs = es.engine.core.all_pair_offsets(es.state)
-        thetas = es.engine._materialize(es.state.params_flat, pair_offs)
+        thetas = es.engine._materialize(
+            es.state.params_flat, es.state.sigma, pair_offs
+        )
         for i in (0, 1, 7):
             np.testing.assert_allclose(
                 np.asarray(es.engine.member_params(es.state, i)),
