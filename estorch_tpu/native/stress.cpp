@@ -1,0 +1,63 @@
+// stress.cpp — sanitizer stress driver for the envpool thread team.
+//
+// SURVEY.md §5 'Race detection': the reference has no native code to
+// sanitize; estorch_tpu's one native component is this pool, so its worker
+// synchronization (epoch broadcast + completion counter, envpool.cpp) gets
+// a TSan/ASan job.  Build and run:
+//
+//   make -C estorch_tpu/native tsan && ./estorch_tpu/native/stress_tsan
+//   make -C estorch_tpu/native asan && ./estorch_tpu/native/stress_asan
+//
+// Exercises: many generations of reset/step across all three envs with
+// maximum thread counts, pool churn (create/destroy), and odd env/thread
+// ratios.  Exits 0 when clean; sanitizers abort on any race/leak.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <vector>
+
+extern "C" {
+void* envpool_create(int env_id, int n_envs, int n_threads, uint64_t seed);
+void envpool_destroy(void* h);
+int envpool_obs_dim(void* h);
+int envpool_act_dim(void* h);
+void envpool_reset(void* h, float* obs_out);
+void envpool_step(void* h, const float* actions, float* obs_out,
+                  float* rew_out, uint8_t* done_out);
+}
+
+static void hammer(int env_id, int n_envs, int n_threads, int steps) {
+  void* h = envpool_create(env_id, n_envs, n_threads, 42);
+  if (!h) {
+    std::fprintf(stderr, "create failed (%d, %d, %d)\n", env_id, n_envs, n_threads);
+    std::exit(1);
+  }
+  const int od = envpool_obs_dim(h);
+  const int ad = envpool_act_dim(h);
+  std::vector<float> obs(static_cast<size_t>(n_envs) * od);
+  std::vector<float> act(static_cast<size_t>(n_envs) * ad, 1.0f);
+  std::vector<float> rew(n_envs);
+  std::vector<uint8_t> done(n_envs);
+  envpool_reset(h, obs.data());
+  for (int t = 0; t < steps; t++) {
+    envpool_step(h, act.data(), obs.data(), rew.data(), done.data());
+  }
+  envpool_destroy(h);
+}
+
+int main() {
+  // thread/env ratios incl. n_threads > n_envs and prime counts
+  for (int env_id = 0; env_id <= 2; env_id++) {
+    const int steps = env_id == 2 ? 50 : 400;  // pixels are heavier
+    hammer(env_id, 64, 1, steps);
+    hammer(env_id, 64, 7, steps);
+    hammer(env_id, 64, 16, steps);
+    hammer(env_id, 3, 16, steps);   // more threads than envs
+    hammer(env_id, 1, 1, steps);
+  }
+  // rapid create/destroy churn (worker startup/shutdown races)
+  for (int i = 0; i < 20; i++) hammer(0, 8, 4, 5);
+  std::puts("stress: OK");
+  return 0;
+}

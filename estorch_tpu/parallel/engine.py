@@ -322,6 +322,10 @@ class ESEngine:
     # ---- public API ----
 
     def init_state(self, params_flat: jax.Array, key: jax.Array) -> ESState:
+        import chex
+
+        chex.assert_shape(params_flat, (self.spec.dim,))
+        chex.assert_tree_all_finite(params_flat)
         return ESState(
             params_flat=params_flat,
             opt_state=self.optimizer.init(params_flat),

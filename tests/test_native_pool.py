@@ -110,6 +110,52 @@ class TestPoolParity:
         b.close()
 
 
+class TestSanitizers:
+    """SURVEY §5 race detection: the pool's thread team under TSan/ASan."""
+
+    @staticmethod
+    def _sanitizer_supported(flag: str) -> bool:
+        """Probe the toolchain, NOT our code: skip only when the sanitizer
+        runtime itself is unavailable; a compile error in our sources must
+        FAIL the test, not skip it."""
+        import subprocess
+        import tempfile
+
+        with tempfile.NamedTemporaryFile("w", suffix=".cpp") as f:
+            f.write("int main(){return 0;}\n")
+            f.flush()
+            probe = subprocess.run(
+                ["g++", flag, "-o", "/dev/null", f.name],
+                capture_output=True, timeout=60,
+            )
+        return probe.returncode == 0
+
+    @pytest.mark.parametrize("target,binary,flag", [
+        ("tsan", "stress_tsan", "-fsanitize=thread"),
+        ("asan", "stress_asan", "-fsanitize=address"),
+    ])
+    def test_sanitizer_stress_clean(self, target, binary, flag):
+        import os
+        import subprocess
+
+        if not self._sanitizer_supported(flag):
+            pytest.skip(f"toolchain lacks {flag}")
+        native = os.path.join(os.path.dirname(__file__), "..", "estorch_tpu", "native")
+        build = subprocess.run(
+            ["make", "-C", native, target], capture_output=True, timeout=180
+        )
+        assert build.returncode == 0, (
+            f"{target} build failed:\n{build.stderr.decode(errors='replace')[-2000:]}"
+        )
+        run = subprocess.run(
+            [os.path.join(native, binary)], capture_output=True, timeout=600
+        )
+        assert run.returncode == 0, (
+            f"{target} stress failed:\n{run.stderr.decode(errors='replace')[-2000:]}"
+        )
+        assert b"stress: OK" in run.stdout
+
+
 class TestPooledBackend:
     def _make(self, cls=ES, **extra):
         kw = dict(

@@ -288,3 +288,14 @@ class TestProfiler:
         assert stats["env_steps"] > 0
         assert stats["env_steps_per_sec"] > 0
         assert stats["compile_time_s"] is not None
+
+    def test_trace_writes_profile(self, tmp_path):
+        from estorch_tpu.utils import annotate, trace
+
+        es = _device_es()
+        es.train(1, verbose=False)  # compile outside the trace
+        with trace(str(tmp_path / "prof")):
+            with annotate("generation"):
+                es.train(1, verbose=False)
+        written = list((tmp_path / "prof").rglob("*"))
+        assert any(p.is_file() for p in written), "no trace files emitted"
