@@ -48,6 +48,9 @@ def measure_tpu(population=4096, horizon=200, gens=5, force_cpu=False) -> tuple[
     from estorch_tpu import ES, JaxAgent, MLPPolicy
     from estorch_tpu.envs import Pendulum
 
+    import jax
+
+    on_tpu = not force_cpu and jax.devices()[0].platform == "tpu"
     es = ES(
         policy=MLPPolicy,
         agent=JaxAgent,
@@ -58,7 +61,9 @@ def measure_tpu(population=4096, horizon=200, gens=5, force_cpu=False) -> tuple[
                        "action_scale": 2.0},
         agent_kwargs={"env": Pendulum(), "horizon": horizon},
         optimizer_kwargs={"learning_rate": 1e-2},
-        eval_chunk=512,
+        eval_chunk=0,  # whole shard per vmap: +60% over chunked on CPU
+        # bf16 policy compute on real TPU (MXU-native); CPU bf16 is emulated
+        compute_dtype="bfloat16" if on_tpu else "float32",
     )
     es.train(1, verbose=False)  # warm-up generation (post-AOT sanity)
     t0 = time.perf_counter()

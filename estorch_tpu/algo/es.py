@@ -234,6 +234,7 @@ class ES:
         self.history: list[dict] = []
         self.generation = 0
         self.compile_time_s: float | None = None
+        self._eval_policy_fns: dict = {}  # n_episodes -> cached jitted rollout
 
     # --------------------------------------------------------- pooled backend
 
@@ -487,6 +488,52 @@ class ES:
         if self.backend == "host":
             raise AttributeError("best_policy_variables is device-path only; use .best_policy")
         return {"params": self.best_policy, **self._frozen}
+
+    def evaluate_policy(self, n_episodes: int = 10, use_best: bool = False, seed: int = 0):
+        """Mean/std episode return of the current (or best) policy.
+
+        The reference's users hand-roll this with ``agent.rollout(es.policy)``
+        loops; here it is one vmapped compiled program on the device path and
+        the engines' own center-evaluation on host/pooled paths (where
+        episode randomness comes from the env/pool RNG streams — ``seed``
+        controls the device path only).
+        """
+        use_best = use_best and self._best_flat is not None
+        if self.backend == "device":
+            flat = jnp.asarray(self._best_flat) if use_best else self.state.params_flat
+            fn = self._eval_policy_fns.get(n_episodes)
+            if fn is None:
+                from ..envs.rollout import make_rollout
+
+                single = make_rollout(self.env, self._policy_apply, self.config.horizon)
+                fn = jax.jit(jax.vmap(single, in_axes=(None, 0)))
+                self._eval_policy_fns[n_episodes] = fn
+            keys = jax.random.split(jax.random.PRNGKey(seed), n_episodes)
+            res = fn(self._spec.unravel(flat), keys)
+            rewards = np.asarray(res.total_reward)
+        else:
+            # both engines' evaluate_center reads only state.params_flat, so
+            # a params-swapped state evaluates the requested policy
+            flat = self._best_flat if use_best else self.state.params_flat
+            eval_state = self.state._replace(
+                params_flat=np.asarray(flat, np.float32)
+                if self.backend == "host"
+                else jnp.asarray(flat)
+            )
+            rewards = np.asarray(
+                [
+                    float(self.engine.evaluate_center(eval_state).total_reward)
+                    for _ in range(n_episodes)
+                ],
+                np.float32,
+            )
+        return {
+            "mean": float(rewards.mean()),
+            "std": float(rewards.std()),
+            "min": float(rewards.min()),
+            "max": float(rewards.max()),
+            "episodes": int(n_episodes),
+        }
 
     def predict(self, obs, use_best: bool = False):
         """Policy forward pass with current (or best) parameters."""

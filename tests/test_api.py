@@ -93,6 +93,16 @@ class TestESAPI:
         es.train(2, log_fn=seen.append)
         assert len(seen) == 2
 
+    def test_evaluate_policy(self):
+        es = _make_es()
+        es.train(3, verbose=False)
+        out = es.evaluate_policy(n_episodes=6)
+        assert out["episodes"] == 6
+        assert out["min"] <= out["mean"] <= out["max"]
+        assert out["std"] >= 0.0
+        out_best = es.evaluate_policy(n_episodes=4, use_best=True)
+        assert out_best["episodes"] == 4
+
 
 class TestVBN:
     def test_vbn_policy_trains_and_stats_frozen(self):

@@ -144,6 +144,18 @@ class TestHostES:
         b.train(3, verbose=False)
         np.testing.assert_array_equal(a.state.params_flat, b.state.params_flat)
 
+    def test_evaluate_policy_uses_best_params(self):
+        """use_best must evaluate _best_flat, not the center (deterministic
+        quadratic fitness makes the distinction exact)."""
+        es = _make()
+        es.train(5, verbose=False)
+        center = es.evaluate_policy(n_episodes=1)["mean"]
+        best = es.evaluate_policy(n_episodes=1, use_best=True)["mean"]
+        # with the quadratic agent, reward is a pure function of params:
+        # best-member reward must equal the recorded best_reward exactly
+        assert best == pytest.approx(es.best_reward, rel=1e-6)
+        assert center != best or es.best_reward == center
+
     def test_env_steps_from_agent_attribute(self):
         es = _make()
         es.train(1, verbose=False)
