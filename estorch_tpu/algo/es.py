@@ -234,7 +234,7 @@ class ES:
         self.history: list[dict] = []
         self.generation = 0
         self.compile_time_s: float | None = None
-        self._eval_policy_fns: dict = {}  # n_episodes -> cached jitted rollout
+        self._eval_policy_fn = None  # lazily-built jitted eval rollout
 
     # --------------------------------------------------------- pooled backend
 
@@ -262,6 +262,7 @@ class ES:
             self.agent.env_name, self._policy_apply, self._spec, self.table,
             self.optimizer, self.config, self.mesh,
             n_threads=self.agent.n_threads, seed=self.seed,
+            double_buffer=getattr(self.agent, "double_buffer", False),
         )
         self.state = self.engine.init_state(flat, state_key)
 
@@ -501,13 +502,13 @@ class ES:
         use_best = use_best and self._best_flat is not None
         if self.backend == "device":
             flat = jnp.asarray(self._best_flat) if use_best else self.state.params_flat
-            fn = self._eval_policy_fns.get(n_episodes)
+            fn = self._eval_policy_fn
             if fn is None:
                 from ..envs.rollout import make_rollout
 
                 single = make_rollout(self.env, self._policy_apply, self.config.horizon)
-                fn = jax.jit(jax.vmap(single, in_axes=(None, 0)))
-                self._eval_policy_fns[n_episodes] = fn
+                # one cached callable: jit re-specializes per n_episodes shape
+                fn = self._eval_policy_fn = jax.jit(jax.vmap(single, in_axes=(None, 0)))
             keys = jax.random.split(jax.random.PRNGKey(seed), n_episodes)
             res = fn(self._spec.unravel(flat), keys)
             rewards = np.asarray(res.total_reward)

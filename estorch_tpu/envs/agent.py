@@ -29,6 +29,8 @@ class PooledAgent:
     env_name: str
     horizon: int = 500
     n_threads: int = 0
+    double_buffer: bool = False  # overlap device forwards with env stepping
+    # (two half-population pools; see parallel/pooled.py)
 
 
 @dataclasses.dataclass

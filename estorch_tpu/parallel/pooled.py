@@ -51,6 +51,7 @@ class PooledEngine:
         mesh,
         n_threads: int = 0,
         seed: int = 0,
+        double_buffer: bool = False,
     ):
         self.env_name = env_name
         self.spec = spec
@@ -60,9 +61,20 @@ class PooledEngine:
         # which we reuse below instead of wrapping a second time
         self.core = ESEngine(None, policy_apply, spec, table, optimizer, config, mesh)
         policy_apply = self.core.policy_apply
-        self.pool = NativeEnvPool(
-            env_name, n_envs=config.population_size, n_threads=n_threads, seed=seed
-        )
+        self.double_buffer = bool(double_buffer)
+        if self.double_buffer:
+            half = config.population_size // 2
+            if half * 2 != config.population_size or half == 0:
+                raise ValueError(
+                    "double_buffer needs an even population of at least 2"
+                )
+            self.pool_a = NativeEnvPool(env_name, n_envs=half, n_threads=n_threads, seed=seed)
+            self.pool_b = NativeEnvPool(env_name, n_envs=half, n_threads=n_threads, seed=seed + 10_007)
+            self.pool = self.pool_a  # dims/metadata accessor
+        else:
+            self.pool = NativeEnvPool(
+                env_name, n_envs=config.population_size, n_threads=n_threads, seed=seed
+            )
         self.center_pool = NativeEnvPool(env_name, n_envs=1, n_threads=1, seed=seed + 1)
         self.bc_dim = self.pool.obs_dim  # BC = final observation
         discrete = self.pool.discrete
@@ -88,7 +100,8 @@ class PooledEngine:
                 return out.reshape(-1)
             return jax.vmap(one)(thetas, obs)
 
-        self._batch_actions = jax.jit(batch_actions)
+        self._batch_actions = jax.jit(batch_actions)  # re-specializes per
+        # batch shape, so the same callable serves full and half populations
 
         def center_action(params_flat, obs):
             out = policy_apply(spec.unravel(params_flat), obs.reshape(obs_shape))
@@ -109,8 +122,14 @@ class PooledEngine:
         t0 = _time.perf_counter()
         pair_offs = self.core.all_pair_offsets(state)
         thetas = self._materialize(state.params_flat, state.sigma, pair_offs)
-        obs = jnp.zeros((self.config.population_size, self.pool.obs_dim), jnp.float32)
-        self._batch_actions(thetas, obs).block_until_ready()
+        # warm the batch size the evaluator will actually use
+        warm_n = (
+            self.config.population_size // 2
+            if self.double_buffer
+            else self.config.population_size
+        )
+        obs = jnp.zeros((warm_n, self.pool.obs_dim), jnp.float32)
+        self._batch_actions(thetas[:warm_n], obs).block_until_ready()
         dummy_w = jnp.zeros((self.config.population_size,), jnp.float32)
         self.core._apply_weights.lower(state, dummy_w).compile()
         return _time.perf_counter() - t0
@@ -121,10 +140,15 @@ class PooledEngine:
         return self.core.member_params(state, member_index)
 
     def evaluate(self, state: ESState) -> PooledEvalResult:
-        n = self.config.population_size
-        horizon = self.config.horizon
         pair_offs = self.core.all_pair_offsets(state)
         thetas = self._materialize(state.params_flat, state.sigma, pair_offs)
+        if self.double_buffer:
+            return self._evaluate_double_buffered(thetas)
+        return self._evaluate_sync(thetas)
+
+    def _evaluate_sync(self, thetas) -> PooledEvalResult:
+        n = self.config.population_size
+        horizon = self.config.horizon
 
         obs = self.pool.reset()
         total = np.zeros(n, np.float32)
@@ -146,6 +170,61 @@ class PooledEngine:
                 break
         final_obs[alive] = obs[alive]  # survivors: last frame
         return PooledEvalResult(fitness=total, bc=final_obs.copy(), steps=steps)
+
+    def _evaluate_double_buffered(self, thetas) -> PooledEvalResult:
+        """Overlap device inference with native env stepping (SURVEY.md §7
+        hard-part 1).
+
+        The population splits into two halves with independent env pools.
+        jax dispatch is asynchronous, so while half A's actions are being
+        synced to the host and its envs stepped in C++ threads, half B's
+        batched forward is already executing on the device — per step the
+        device and the env team work concurrently instead of taking turns.
+        Results are identical to running each half through the sync path.
+        """
+        n = self.config.population_size
+        h = n // 2
+        horizon = self.config.horizon
+        halves = [
+            dict(pool=self.pool_a, thetas=thetas[:h], lo=0),
+            dict(pool=self.pool_b, thetas=thetas[h:], lo=h),
+        ]
+        total = np.zeros(n, np.float32)
+        alive = np.ones(n, bool)
+        steps = 0
+
+        for half in halves:
+            half["obs"] = half["pool"].reset()
+            half["fut"] = self._batch_actions(
+                half["thetas"], jnp.asarray(half["obs"])
+            )
+        final_obs = np.concatenate([halves[0]["obs"], halves[1]["obs"]], axis=0)
+
+        for _ in range(horizon):
+            if not alive.any():
+                break
+            for half in halves:
+                # syncing this half's actions lets the OTHER half's forward
+                # (dispatched at the end of its previous turn) run on-device
+                # while this half's envs step in C++ threads
+                actions = np.asarray(half["fut"])
+                sl = slice(half["lo"], half["lo"] + h)
+                next_obs, rew, done = half["pool"].step(actions)
+                total[sl] += rew * alive[sl]
+                steps += int(alive[sl].sum())
+                just_died = alive[sl] & done
+                if just_died.any():
+                    final_obs[sl][just_died] = half["obs"][just_died]
+                alive[sl] &= ~done
+                half["obs"] = next_obs
+                half["fut"] = self._batch_actions(
+                    half["thetas"], jnp.asarray(next_obs)
+                )
+
+        for half in halves:
+            sl = slice(half["lo"], half["lo"] + h)
+            final_obs[sl][alive[sl]] = half["obs"][alive[sl]]
+        return PooledEvalResult(fitness=total, bc=final_obs, steps=steps)
 
     def evaluate_center(self, state: ESState):
         from ..envs.rollout import RolloutResult
