@@ -188,6 +188,18 @@ class TestSigmaAnnealing:
         assert float(np.asarray(s.sigma)) == pytest.approx(setup["cfg"].sigma)
 
 
+class TestMinimumPopulation:
+    def test_population_of_two(self, setup):
+        """One antithetic pair — the smallest legal population — must run."""
+        cfg = EngineConfig(population_size=2, sigma=0.1, horizon=20)
+        e = ESEngine(setup["env"], setup["apply"], setup["spec"], setup["table"],
+                     setup["opt"], cfg, single_device_mesh())
+        s = e.init_state(setup["flat"], jax.random.PRNGKey(0))
+        s, m = e.generation_step(s)
+        assert np.asarray(m["fitness"]).shape == (2,)
+        assert int(s.generation) == 1
+
+
 class TestLearning:
     def test_cartpole_learns(self, setup):
         """Fitness must rise substantially within a few generations (smoke =
