@@ -24,18 +24,6 @@ import time
 import numpy as np
 
 
-def _tpu_alive(timeout_s: int = 90) -> bool:
-    """Probe device init in a SUBPROCESS — the axon tunnel can wedge in a way
-    that hangs jax.devices() forever, which must not take bench down."""
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=timeout_s,
-            capture_output=True,
-        )
-        return r.returncode == 0
-    except subprocess.TimeoutExpired:
-        return False
 
 
 def measure_tpu(population=4096, horizon=200, gens=5, force_cpu=False) -> tuple[float, str]:
@@ -103,8 +91,9 @@ def measure_reference_style_baseline(budget_s=6.0) -> float:
 
 def _measure_tpu_subprocess(timeout_s: int = 480):
     """Run the TPU measurement in a child with a hard timeout — the tunnel
-    can wedge MID-RUN (not just at init), and bench must still emit its
-    JSON line.  Returns (rate, platform) or None on any failure."""
+    can wedge at init OR mid-run, and bench must still emit its JSON line.
+    Returns (rate, platform) or None; failure diagnostics go to OUR stderr
+    (the JSON-line contract owns stdout only)."""
     try:
         r = subprocess.run(
             [sys.executable, __file__, "--stage-tpu"],
@@ -113,19 +102,26 @@ def _measure_tpu_subprocess(timeout_s: int = 480):
             text=True,
         )
     except subprocess.TimeoutExpired:
+        print(f"bench: TPU child timed out after {timeout_s}s (tunnel wedge?)",
+              file=sys.stderr)
         return None
     if r.returncode != 0:
+        print(f"bench: TPU child exited {r.returncode}; stderr tail:\n"
+              f"{r.stderr[-2000:]}", file=sys.stderr)
         return None
     try:
         last = [ln for ln in r.stdout.strip().splitlines() if ln.startswith("{")][-1]
         d = json.loads(last)
         return float(d["rate"]), str(d["platform"])
     except (IndexError, KeyError, ValueError):
+        print(f"bench: TPU child output unparseable; stdout tail:\n"
+              f"{r.stdout[-1000:]}\nstderr tail:\n{r.stderr[-1000:]}",
+              file=sys.stderr)
         return None
 
 
 def main():
-    result = _measure_tpu_subprocess() if _tpu_alive() else None
+    result = _measure_tpu_subprocess()
     if result is None:
         rate, platform = measure_tpu(force_cpu=True)
         fell_back = True
@@ -134,7 +130,7 @@ def main():
         fell_back = False
     base_rate = measure_reference_style_baseline()
     unit = f"env-steps/s/chip (Pendulum MLP64x64 pop4096 h200, {platform}"
-    unit += ", TPU-TUNNEL-DOWN cpu fallback)" if fell_back else ")"
+    unit += ", TPU-PATH-FAILED cpu fallback — see stderr)" if fell_back else ")"
     print(
         json.dumps(
             {
