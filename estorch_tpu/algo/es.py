@@ -102,6 +102,7 @@ class ES:
         compute_dtype: str = "float32",
         sigma_decay: float = 1.0,
         sigma_min: float = 0.0,
+        mirrored: bool = True,
     ):
         self.population_size = population_size
         self.sigma = sigma
@@ -113,6 +114,7 @@ class ES:
         self._compute_dtype = compute_dtype
         self._sigma_decay = float(sigma_decay)
         self._sigma_min = float(sigma_min)
+        self._mirrored = bool(mirrored)
 
         self._policy_arg = policy
         self._policy_kwargs = dict(policy_kwargs or {})
@@ -134,6 +136,11 @@ class ES:
                 raise ValueError(
                     "sigma_decay is a device/pooled-path option; it is not "
                     "implemented on the host backend (pass sigma_decay=1.0)"
+                )
+            if not mirrored:
+                raise ValueError(
+                    "mirrored=False is a device-path option; the host backend "
+                    "always uses antithetic pairs"
                 )
             self.backend = "host"
             self._init_host(
@@ -224,6 +231,7 @@ class ES:
             compute_dtype=self._compute_dtype,
             sigma_decay=self._sigma_decay,
             sigma_min=self._sigma_min,
+            mirrored=self._mirrored,
         )
         return flat, state_key
 

@@ -37,7 +37,7 @@ import optax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..envs.rollout import make_rollout
-from ..ops.gradient import es_gradient
+from ..ops.gradient import es_gradient, rank_weighted_noise_sum
 from ..ops.noise import NoiseTable, member_offsets, pair_signs, sample_pair_offsets
 from ..ops.params import ParamSpec
 from ..ops.ranks import centered_rank
@@ -52,13 +52,17 @@ class EngineConfig:
     sigma: float
     horizon: int
     eval_chunk: int = 0  # members per rollout chunk; 0 → whole local shard
-    grad_chunk: int = 256  # pairs per gradient-reduction chunk
+    grad_chunk: int = 256  # noise rows (pairs when mirrored, members when
+    # not) per gradient-reduction chunk
     weight_decay: float = 0.0  # L2 pull toward 0, applied with the update
     compute_dtype: str = "float32"  # "bfloat16" runs the POLICY forward in
     # bf16 (MXU-native, half the HBM traffic for the per-member weights);
     # params, noise table, env dynamics, and the update stay float32
     sigma_decay: float = 1.0  # per-generation multiplicative σ annealing
     sigma_min: float = 0.0  # σ floor when annealing
+    mirrored: bool = True  # antithetic pairs (variance reduction — kept on
+    # by default everywhere, incl. the bundled configs). Set False for the
+    # reference's plain per-member sampling (device path only).
 
 
 class ESState(NamedTuple):
@@ -133,8 +137,17 @@ class ESEngine:
         self.config = config
         self.mesh = mesh
         self.n_devices = mesh.devices.size
-        self.pairs_local = pairs_per_device(config.population_size, self.n_devices)
-        self.members_local = 2 * self.pairs_local
+        if config.mirrored:
+            self.pairs_local = pairs_per_device(config.population_size, self.n_devices)
+            self.members_local = 2 * self.pairs_local
+        else:
+            if config.population_size % self.n_devices != 0:
+                raise ValueError(
+                    f"population ({config.population_size}) must divide evenly "
+                    f"over {self.n_devices} devices"
+                )
+            self.pairs_local = None  # unmirrored: no pair structure
+            self.members_local = config.population_size // self.n_devices
         self.eval_chunk = _choose_eval_chunk(config.eval_chunk, self.members_local)
 
         if env is None:
@@ -204,30 +217,49 @@ class ESEngine:
     # ---- shard-local bodies (run once per device under shard_map) ----
 
     def _local_offsets_signs_keys(self, state: ESState):
-        """Derive this device's pair offsets, member signs, rollout keys."""
+        """This device's (reduction offsets, member offsets, signs, keys).
+
+        Mirrored: one table offset per antithetic pair; member offsets repeat
+        it, signs alternate, and pair members share a rollout key (common
+        random numbers).  Unmirrored (reference's plain ES): one independent
+        offset and key per member, all signs +1; the reduction offsets ARE
+        the member offsets.
+        """
         cfg = self.config
         okey, rkey = _gen_keys(state)
-        all_pair_offsets = sample_pair_offsets(
-            okey, cfg.population_size // 2, self.table.size, self.spec.dim
-        )
         d = jax.lax.axis_index(POP_AXIS)
-        pair_offs = jax.lax.dynamic_slice(
-            all_pair_offsets, (d * self.pairs_local,), (self.pairs_local,)
+        if cfg.mirrored:
+            all_pair_offsets = sample_pair_offsets(
+                okey, cfg.population_size // 2, self.table.size, self.spec.dim
+            )
+            pair_offs = jax.lax.dynamic_slice(
+                all_pair_offsets, (d * self.pairs_local,), (self.pairs_local,)
+            )
+            member_offs = member_offsets(pair_offs)
+            signs = pair_signs(self.members_local)
+            pair_keys = jax.random.split(rkey, cfg.population_size // 2)
+            local_pair_keys = jax.lax.dynamic_slice(
+                pair_keys, (d * self.pairs_local, 0), (self.pairs_local, pair_keys.shape[1])
+            )
+            member_keys = jnp.repeat(local_pair_keys, 2, axis=0)
+            return pair_offs, member_offs, signs, member_keys
+        all_offsets = sample_pair_offsets(
+            okey, cfg.population_size, self.table.size, self.spec.dim
         )
-        signs = pair_signs(self.members_local)
-        # mirrored members share a rollout key (common random numbers):
-        pair_keys = jax.random.split(rkey, cfg.population_size // 2)
-        local_pair_keys = jax.lax.dynamic_slice(
-            pair_keys, (d * self.pairs_local, 0), (self.pairs_local, pair_keys.shape[1])
+        member_offs = jax.lax.dynamic_slice(
+            all_offsets, (d * self.members_local,), (self.members_local,)
         )
-        member_keys = jnp.repeat(local_pair_keys, 2, axis=0)
-        return pair_offs, signs, member_keys
+        signs = jnp.ones((self.members_local,), jnp.float32)
+        keys = jax.random.split(rkey, cfg.population_size)
+        member_keys = jax.lax.dynamic_slice(
+            keys, (d * self.members_local, 0), (self.members_local, keys.shape[1])
+        )
+        return member_offs, member_offs, signs, member_keys
 
-    def _eval_local(self, state: ESState, pair_offs, signs, member_keys):
+    def _eval_local(self, state: ESState, member_offs, signs, member_keys):
         """Rollout this device's members in eval_chunk-sized compiled chunks."""
         cfg = self.config
         dim = self.spec.dim
-        member_offs = member_offsets(pair_offs)
         n_chunks = self.members_local // self.eval_chunk
 
         def chunk_body(_, xs):
@@ -260,19 +292,29 @@ class ESEngine:
         steps = jax.lax.psum(steps_local.sum(), POP_AXIS)
         return fitness, bc, steps
 
-    def _update_from_weights(self, state: ESState, weights, pair_offs):
-        """Optax step from per-member rank weights. Identical on all devices."""
+    def _update_from_weights(self, state: ESState, weights, reduction_offs):
+        """Optax step from per-member rank weights. Identical on all devices.
+
+        ``reduction_offs`` is per-PAIR (mirrored; folded estimator) or
+        per-MEMBER (unmirrored; direct weighted sum).
+        """
         cfg = self.config
         d = jax.lax.axis_index(POP_AXIS)
         w_local = jax.lax.dynamic_slice(
             weights, (d * self.members_local,), (self.members_local,)
         )
-        # local folded partial of the estimator; scaling commutes with psum
-        grad_local = es_gradient(
-            self.table, pair_offs, w_local,
-            sigma=state.sigma, population_size=cfg.population_size,
-            dim=self.spec.dim, chunk=cfg.grad_chunk,
-        )
+        if cfg.mirrored:
+            # local folded partial of the estimator; scaling commutes with psum
+            grad_local = es_gradient(
+                self.table, reduction_offs, w_local,
+                sigma=state.sigma, population_size=cfg.population_size,
+                dim=self.spec.dim, chunk=cfg.grad_chunk,
+            )
+        else:
+            grad_local = rank_weighted_noise_sum(
+                self.table, reduction_offs, w_local,
+                dim=self.spec.dim, chunk=cfg.grad_chunk,
+            ) / (cfg.population_size * state.sigma)
         grad_ascent = jax.lax.psum(grad_local, POP_AXIS)
         if cfg.weight_decay > 0.0:
             grad_ascent = grad_ascent - cfg.weight_decay * state.params_flat
@@ -295,11 +337,11 @@ class ESEngine:
     # ---- shard_map bodies ----
 
     def _generation_body(self, state: ESState):
-        pair_offs, signs, member_keys = self._local_offsets_signs_keys(state)
-        f_l, bc_l, st_l = self._eval_local(state, pair_offs, signs, member_keys)
+        red_offs, member_offs, signs, member_keys = self._local_offsets_signs_keys(state)
+        f_l, bc_l, st_l = self._eval_local(state, member_offs, signs, member_keys)
         fitness, bc, steps = self._gather_global(f_l, bc_l, st_l)
         weights = centered_rank(fitness)
-        new_state, gnorm = self._update_from_weights(state, weights, pair_offs)
+        new_state, gnorm = self._update_from_weights(state, weights, red_offs)
         metrics = {
             "fitness": fitness,
             "bc": bc,
@@ -309,14 +351,14 @@ class ESEngine:
         return new_state, metrics
 
     def _evaluate_body(self, state: ESState):
-        pair_offs, signs, member_keys = self._local_offsets_signs_keys(state)
-        f_l, bc_l, st_l = self._eval_local(state, pair_offs, signs, member_keys)
+        _, member_offs, signs, member_keys = self._local_offsets_signs_keys(state)
+        f_l, bc_l, st_l = self._eval_local(state, member_offs, signs, member_keys)
         fitness, bc, steps = self._gather_global(f_l, bc_l, st_l)
         return EvalResult(fitness=fitness, bc=bc, steps=steps)
 
     def _apply_weights_body(self, state: ESState, weights):
-        pair_offs, _, _ = self._local_offsets_signs_keys(state)
-        new_state, gnorm = self._update_from_weights(state, weights, pair_offs)
+        red_offs, _, _, _ = self._local_offsets_signs_keys(state)
+        new_state, gnorm = self._update_from_weights(state, weights, red_offs)
         return new_state, gnorm
 
     # ---- public API ----
@@ -379,10 +421,17 @@ class ESEngine:
         convenience — e.g. to snapshot the best member, reference's
         ``best_policy``)."""
         okey, _ = _gen_keys(state)
-        all_pair_offsets = sample_pair_offsets(
-            okey, self.config.population_size // 2, self.table.size, self.spec.dim
-        )
-        pair = member_index // 2
-        sign = 1.0 if member_index % 2 == 0 else -1.0
-        eps = self.table.slice(all_pair_offsets[pair], self.spec.dim)
+        if self.config.mirrored:
+            all_pair_offsets = sample_pair_offsets(
+                okey, self.config.population_size // 2, self.table.size, self.spec.dim
+            )
+            off = all_pair_offsets[member_index // 2]
+            sign = 1.0 if member_index % 2 == 0 else -1.0
+        else:
+            all_offsets = sample_pair_offsets(
+                okey, self.config.population_size, self.table.size, self.spec.dim
+            )
+            off = all_offsets[member_index]
+            sign = 1.0
+        eps = self.table.slice(off, self.spec.dim)
         return state.params_flat + state.sigma * sign * eps

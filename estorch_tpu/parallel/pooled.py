@@ -56,6 +56,11 @@ class PooledEngine:
         self.env_name = env_name
         self.spec = spec
         self.config = config
+        if not config.mirrored:
+            raise ValueError(
+                "the pooled path currently requires mirrored sampling "
+                "(its perturbation materialization is pair-structured)"
+            )
         # update-only device engine: shares offsets/psum/optax with the
         # fully-on-device path; its ctor also applies the compute_dtype wrap,
         # which we reuse below instead of wrapping a second time

@@ -188,6 +188,70 @@ class TestSigmaAnnealing:
         assert float(np.asarray(s.sigma)) == pytest.approx(setup["cfg"].sigma)
 
 
+class TestUnmirroredSampling:
+    """Reference's plain ES: independent noise per member, no antithetic
+    pairs (mirroring is the opt-in of BASELINE config 3)."""
+
+    def _engine(self, setup, mesh, pop=32):
+        cfg = EngineConfig(
+            population_size=pop, sigma=0.1, horizon=100, eval_chunk=8,
+            mirrored=False,
+        )
+        return ESEngine(setup["env"], setup["apply"], setup["spec"],
+                        setup["table"], setup["opt"], cfg, mesh)
+
+    def test_learns_cartpole(self, setup):
+        e = self._engine(setup, population_mesh())
+        s = e.init_state(setup["flat"], jax.random.PRNGKey(0))
+        first = None
+        for _ in range(10):
+            s, m = e.generation_step(s)
+            mean = float(np.asarray(m["fitness"]).mean())
+            first = mean if first is None else first
+        assert mean > first + 15, (first, mean)
+
+    def test_8dev_equals_1dev(self, setup, devices8):
+        e8 = self._engine(setup, population_mesh())
+        e1 = self._engine(setup, single_device_mesh())
+        s8 = e8.init_state(setup["flat"], jax.random.PRNGKey(5))
+        s1 = e1.init_state(setup["flat"], jax.random.PRNGKey(5))
+        for _ in range(3):
+            s8, m8 = e8.generation_step(s8)
+            s1, m1 = e1.generation_step(s1)
+        np.testing.assert_array_equal(
+            np.asarray(m8["fitness"]), np.asarray(m1["fitness"])
+        )
+        np.testing.assert_allclose(
+            np.asarray(s8.params_flat), np.asarray(s1.params_flat),
+            rtol=2e-5, atol=1e-6,
+        )
+
+    def test_member_reconstruction(self, setup):
+        e = self._engine(setup, single_device_mesh())
+        s = e.init_state(setup["flat"], jax.random.PRNGKey(2))
+        ev = e.evaluate(s)
+        # member 3's reconstructed theta re-rolls to its recorded fitness
+        from estorch_tpu.envs.rollout import make_rollout
+        import estorch_tpu.parallel.engine as eng_mod
+
+        theta3 = e.member_params(s, 3)
+        _, rkey = eng_mod._gen_keys(s)
+        keys = jax.random.split(rkey, 32)
+        rollout = make_rollout(setup["env"], setup["apply"], 100)
+        res = rollout(setup["spec"].unravel(theta3), keys[3])
+        assert float(res.total_reward) == float(ev.fitness[3])
+
+    def test_odd_population_allowed(self, setup):
+        """No pair structure -> odd populations are legal when they divide
+        the mesh (single device here)."""
+        cfg = EngineConfig(population_size=7, sigma=0.1, horizon=10, mirrored=False)
+        e = ESEngine(setup["env"], setup["apply"], setup["spec"], setup["table"],
+                     setup["opt"], cfg, single_device_mesh())
+        s = e.init_state(setup["flat"], jax.random.PRNGKey(0))
+        s, m = e.generation_step(s)
+        assert np.asarray(m["fitness"]).shape == (7,)
+
+
 class TestMinimumPopulation:
     def test_population_of_two(self, setup):
         """One antithetic pair — the smallest legal population — must run."""
