@@ -103,6 +103,7 @@ class ES:
         sigma_decay: float = 1.0,
         sigma_min: float = 0.0,
         mirrored: bool = True,
+        episodes_per_member: int = 1,
     ):
         self.population_size = population_size
         self.sigma = sigma
@@ -115,6 +116,7 @@ class ES:
         self._sigma_decay = float(sigma_decay)
         self._sigma_min = float(sigma_min)
         self._mirrored = bool(mirrored)
+        self._episodes_per_member = int(episodes_per_member)
 
         self._policy_arg = policy
         self._policy_kwargs = dict(policy_kwargs or {})
@@ -141,6 +143,11 @@ class ES:
                 raise ValueError(
                     "mirrored=False is a device-path option; the host backend "
                     "always uses antithetic pairs"
+                )
+            if episodes_per_member != 1:
+                raise ValueError(
+                    "episodes_per_member is a device-path option; host agents "
+                    "control their own rollout count inside rollout()"
                 )
             self.backend = "host"
             self._init_host(
@@ -232,6 +239,7 @@ class ES:
             sigma_decay=self._sigma_decay,
             sigma_min=self._sigma_min,
             mirrored=self._mirrored,
+            episodes_per_member=self._episodes_per_member,
         )
         return flat, state_key
 

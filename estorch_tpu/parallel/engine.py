@@ -63,6 +63,9 @@ class EngineConfig:
     mirrored: bool = True  # antithetic pairs (variance reduction — kept on
     # by default everywhere, incl. the bundled configs). Set False for the
     # reference's plain per-member sampling (device path only).
+    episodes_per_member: int = 1  # rollouts averaged per member (device
+    # path only): reduces fitness noise AND raises per-step batch (n·e rows
+    # through the policy matmuls — better MXU use for small populations)
 
 
 class ESState(NamedTuple):
@@ -114,6 +117,10 @@ class ESEngine:
         if config.compute_dtype not in ("float32", "bfloat16"):
             raise ValueError(
                 f"compute_dtype must be float32 or bfloat16, got {config.compute_dtype!r}"
+            )
+        if config.episodes_per_member < 1:
+            raise ValueError(
+                f"episodes_per_member must be >= 1, got {config.episodes_per_member}"
             )
         if config.compute_dtype == "bfloat16":
             base_apply = policy_apply
@@ -268,7 +275,17 @@ class ESEngine:
             def member_eval(off, sign, key):
                 eps = self.table.slice(off, dim)
                 theta = state.params_flat + state.sigma * sign * eps
-                res = self._rollout(self.spec.unravel(theta), key)
+                params = self.spec.unravel(theta)
+                if cfg.episodes_per_member > 1:
+                    ep_keys = jax.random.split(key, cfg.episodes_per_member)
+                    res = jax.vmap(self._rollout, in_axes=(None, 0))(params, ep_keys)
+                    # fitness = mean return; BC = first episode's; steps summed
+                    return (
+                        res.total_reward.mean(),
+                        jax.tree_util.tree_map(lambda x: x[0], res.bc),
+                        res.steps.sum(),
+                    )
+                res = self._rollout(params, key)
                 return res.total_reward, res.bc, res.steps
 
             f, bc, st = jax.vmap(member_eval)(offs_c, signs_c, keys_c)

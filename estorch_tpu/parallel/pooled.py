@@ -61,6 +61,11 @@ class PooledEngine:
                 "the pooled path currently requires mirrored sampling "
                 "(its perturbation materialization is pair-structured)"
             )
+        if config.episodes_per_member != 1:
+            raise ValueError(
+                "episodes_per_member is a device-path option; the pooled "
+                "path rolls one episode per member env"
+            )
         # update-only device engine: shares offsets/psum/optax with the
         # fully-on-device path; its ctor also applies the compute_dtype wrap,
         # which we reuse below instead of wrapping a second time

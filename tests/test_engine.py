@@ -252,6 +252,51 @@ class TestUnmirroredSampling:
         assert np.asarray(m["fitness"]).shape == (7,)
 
 
+class TestEpisodesPerMember:
+    def test_multi_episode_fitness_and_steps(self, setup):
+        cfg = EngineConfig(population_size=16, sigma=0.1, horizon=50,
+                           episodes_per_member=3)
+        e = ESEngine(setup["env"], setup["apply"], setup["spec"], setup["table"],
+                     setup["opt"], cfg, single_device_mesh())
+        s = e.init_state(setup["flat"], jax.random.PRNGKey(1))
+        ev = e.evaluate(s)
+        assert ev.fitness.shape == (16,)
+        # 3 episodes per member: total alive steps must exceed the
+        # single-episode engine's for the same seed
+        cfg1 = EngineConfig(population_size=16, sigma=0.1, horizon=50)
+        e1 = ESEngine(setup["env"], setup["apply"], setup["spec"], setup["table"],
+                      setup["opt"], cfg1, single_device_mesh())
+        ev1 = e1.evaluate(e1.init_state(setup["flat"], jax.random.PRNGKey(1)))
+        assert int(ev.steps) > int(ev1.steps)
+
+    def test_multi_episode_fitness_is_exact_episode_mean(self, setup):
+        """Member fitness must equal the mean of its episode returns,
+        replayed manually with the same keys."""
+        from estorch_tpu.envs.rollout import make_rollout
+        import estorch_tpu.parallel.engine as eng_mod
+
+        cfg = EngineConfig(population_size=4, sigma=0.1, horizon=40,
+                           episodes_per_member=3)
+        e = ESEngine(setup["env"], setup["apply"], setup["spec"], setup["table"],
+                     setup["opt"], cfg, single_device_mesh())
+        s = e.init_state(setup["flat"], jax.random.PRNGKey(7))
+        ev = e.evaluate(s)
+
+        member = 1
+        theta = e.member_params(s, member)
+        _, rkey = eng_mod._gen_keys(s)
+        pair_keys = jax.random.split(rkey, 2)  # population 4 → 2 pairs
+        member_key = pair_keys[member // 2]
+        rollout = make_rollout(setup["env"], setup["apply"], 40)
+        rets = [
+            float(rollout(setup["spec"].unravel(theta), k).total_reward)
+            for k in jax.random.split(member_key, 3)
+        ]
+        np.testing.assert_allclose(
+            float(np.asarray(ev.fitness)[member]), np.mean(rets), rtol=1e-6
+        )
+
+
 class TestMinimumPopulation:
     def test_population_of_two(self, setup):
         """One antithetic pair — the smallest legal population — must run."""
