@@ -46,7 +46,14 @@ def centered_rank(x: jax.Array) -> jax.Array:
 def centered_rank_np(x) -> np.ndarray:
     """NumPy twin of :func:`centered_rank` for host-side weighting (novelty
     family): must match the device version bit-for-bit on tie-free input and
-    tie-behavior-for-tie-behavior otherwise (both use stable argsort)."""
+    tie-behavior-for-tie-behavior otherwise (both use stable argsort).
+
+    Known (harmless) divergence: XLA flushes float32 subnormals to zero, so
+    two fitness values whose difference is subnormal (<~1.2e-38) tie on
+    device but not here.  Ranking always happens on ONE array from ONE
+    implementation per generation, so this never mixes — found by the
+    property suite (tests/test_properties.py), recorded for posterity.
+    """
     x = np.asarray(x)
     n = x.shape[0]
     if n < 2:

@@ -1,0 +1,119 @@
+"""Property-based tests (hypothesis) for the ES math core."""
+
+import numpy as np
+from hypothesis import given, settings, strategies as st
+from hypothesis.extra import numpy as hnp
+
+import jax.numpy as jnp
+
+from estorch_tpu.algo.archive import NoveltyArchive
+from estorch_tpu.ops import centered_rank, centered_rank_np, fold_mirrored_weights
+from estorch_tpu.utils.fault import mask_and_renormalize
+
+# no subnormals: XLA flushes them to zero, so device/numpy ranks legitimately
+# diverge for subnormal-magnitude differences (documented in ops/ranks.py)
+_floats = st.floats(-1e6, 1e6, allow_nan=False, allow_subnormal=False, width=32)
+
+
+class TestCenteredRankProperties:
+    @given(hnp.arrays(np.float32, st.integers(2, 64), elements=_floats, unique=True))
+    @settings(max_examples=30, deadline=None)
+    def test_device_matches_numpy_twin(self, x):
+        np.testing.assert_allclose(
+            np.asarray(centered_rank(jnp.asarray(x))), centered_rank_np(x),
+            atol=1e-7,
+        )
+
+    @given(hnp.arrays(np.float32, st.integers(2, 64), elements=_floats, unique=True))
+    @settings(max_examples=30, deadline=None)
+    def test_bounded_and_zero_sum(self, x):
+        r = centered_rank_np(x)
+        assert r.min() >= -0.5 - 1e-6 and r.max() <= 0.5 + 1e-6
+        assert abs(float(r.sum())) < 1e-4
+
+    @given(
+        hnp.arrays(np.float32, st.integers(2, 32), elements=_floats, unique=True),
+        st.randoms(use_true_random=False),
+    )
+    @settings(max_examples=25, deadline=None)
+    def test_permutation_equivariance(self, x, rnd):
+        perm = np.arange(len(x))
+        rnd.shuffle(perm)
+        np.testing.assert_allclose(
+            centered_rank_np(x[perm]), centered_rank_np(x)[perm], atol=1e-7
+        )
+
+    @given(
+        hnp.arrays(np.float32, st.integers(2, 32), elements=_floats, unique=True),
+        st.floats(1e-3, 1e3),
+        st.floats(-100, 100),
+    )
+    @settings(max_examples=25, deadline=None)
+    def test_affine_invariance(self, x, a, b):
+        y = (a * x + b).astype(np.float32)
+        if len(np.unique(y)) == len(y):  # affine map kept values distinct
+            np.testing.assert_allclose(
+                centered_rank_np(y), centered_rank_np(x), atol=1e-7
+            )
+
+
+class TestFoldProperties:
+    @given(hnp.arrays(np.float32, st.integers(1, 32).map(lambda k: 2 * k),
+                      elements=st.floats(-10, 10, allow_nan=False, width=32)))
+    @settings(max_examples=30, deadline=None)
+    def test_fold_is_signed_pair_sum(self, w):
+        folded = np.asarray(fold_mirrored_weights(jnp.asarray(w)))
+        expected = w[0::2] - w[1::2]
+        np.testing.assert_allclose(folded, expected, atol=1e-6)
+
+
+class TestArchiveProperties:
+    @given(
+        hnp.arrays(np.float32, st.tuples(st.integers(1, 12), st.just(3)),
+                   elements=st.floats(-5, 5, allow_nan=False, width=32)),
+        hnp.arrays(np.float32, st.tuples(st.integers(1, 6), st.just(3)),
+                   elements=st.floats(-5, 5, allow_nan=False, width=32)),
+    )
+    @settings(max_examples=25, deadline=None)
+    def test_novelty_nonnegative_and_self_zero_with_k1(self, bcs, queries):
+        ar = NoveltyArchive(k=1)
+        for row in bcs:
+            ar.add(row)
+        nov = ar.novelty(queries)
+        assert np.all(nov >= 0)
+        # a query that IS an archive point has k=1 novelty 0
+        nov_self = ar.novelty(bcs[0])
+        assert float(nov_self) < 1e-5
+
+    @given(st.integers(1, 10))
+    @settings(max_examples=10, deadline=None)
+    def test_novelty_monotone_in_distance(self, scale):
+        ar = NoveltyArchive(k=2)
+        ar.add(np.zeros(2))
+        ar.add(np.ones(2))
+        near = ar.novelty(np.full(2, 0.1, np.float32))
+        far = ar.novelty(np.full(2, 0.1 + scale, np.float32))
+        assert far > near
+
+
+class TestFaultProperties:
+    @given(
+        hnp.arrays(np.float32, st.integers(3, 32),
+                   elements=st.floats(-10, 10, allow_nan=False, width=32)),
+        st.data(),
+    )
+    @settings(max_examples=25, deadline=None)
+    def test_renormalized_mean_contribution_preserved(self, w, data):
+        n = len(w)
+        # at least 2 survivors
+        valid = np.array(
+            data.draw(st.lists(st.booleans(), min_size=n, max_size=n)), bool
+        )
+        if valid.sum() < 2:
+            valid[:2] = True
+        out = mask_and_renormalize(w, valid)
+        # invalid entries zeroed; survivors scaled by n/n_valid
+        assert np.all(out[~valid] == 0.0)
+        np.testing.assert_allclose(
+            out[valid], w[valid] * (n / valid.sum()), rtol=1e-5
+        )
