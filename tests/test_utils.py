@@ -167,6 +167,31 @@ class TestCheckpointHost:
         )
 
 
+class TestCheckpointPooled:
+    def test_pooled_resume_is_exact(self, tmp_path):
+        from estorch_tpu import PooledAgent
+
+        def mk():
+            return _device_es(
+                agent=PooledAgent,
+                agent_kwargs={"env_name": "cartpole", "horizon": 40},
+                seed=2,
+                table_size=1 << 14,
+            )
+
+        a = mk()
+        a.train(2, verbose=False)
+        save_checkpoint(a, str(tmp_path / "ck"))
+        b = mk()
+        restore_checkpoint(b, str(tmp_path / "ck"))
+        assert b.generation == 2
+        np.testing.assert_array_equal(
+            np.asarray(a.state.params_flat), np.asarray(b.state.params_flat)
+        )
+        b.train(1, verbose=False)  # must run cleanly from the restored state
+        assert b.generation == 3
+
+
 class TestPeriodicCheckpointer:
     def test_every_k_and_gc(self, tmp_path):
         es = _device_es()
