@@ -25,6 +25,7 @@ import time
 from typing import Callable
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..ops.ranks import centered_rank_np
@@ -148,7 +149,7 @@ class NS_ES(ES):
             novelty = self.archive.novelty(np.asarray(ev.bc))
             weights = self._weights_with_failures(fitness, novelty)
             if self.backend == "device":
-                weights = jax.numpy.asarray(weights)
+                weights = jnp.asarray(weights)
 
             new_st, gnorm = self.engine.apply_weights(st, weights)
             self.meta_states[m] = new_st

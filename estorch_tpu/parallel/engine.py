@@ -291,12 +291,16 @@ class ESEngine:
             f, bc, st = jax.vmap(member_eval)(offs_c, signs_c, keys_c)
             return 0, (f, bc, st)
 
-        xs = (
-            member_offs.reshape(n_chunks, self.eval_chunk),
-            signs.reshape(n_chunks, self.eval_chunk),
-            member_keys.reshape(n_chunks, self.eval_chunk, -1),
-        )
-        _, (f, bc, st) = jax.lax.scan(chunk_body, 0, xs)
+        if n_chunks == 1:
+            # whole shard in one vmap — no 1-iteration scan layer
+            _, (f, bc, st) = chunk_body(0, (member_offs, signs, member_keys))
+        else:
+            xs = (
+                member_offs.reshape(n_chunks, self.eval_chunk),
+                signs.reshape(n_chunks, self.eval_chunk),
+                member_keys.reshape(n_chunks, self.eval_chunk, -1),
+            )
+            _, (f, bc, st) = jax.lax.scan(chunk_body, 0, xs)
         fitness_local = f.reshape(self.members_local)
         bc_local = bc.reshape(self.members_local, self.bc_dim)
         steps_local = st.reshape(self.members_local)
