@@ -2,6 +2,7 @@ from .acrobot import Acrobot
 from .base import EnvSpec, JaxEnv
 from .cartpole import CartPole
 from .mountain_car import MountainCarContinuous
+from .mountain_car_discrete import MountainCar
 from .pendulum import Pendulum
 from .rollout import RolloutResult, make_population_rollout, make_rollout, select_action
 
@@ -10,6 +11,7 @@ __all__ = [
     "EnvSpec",
     "JaxEnv",
     "CartPole",
+    "MountainCar",
     "MountainCarContinuous",
     "Pendulum",
     "RolloutResult",

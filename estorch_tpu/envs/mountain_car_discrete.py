@@ -1,0 +1,55 @@
+"""Pure-JAX MountainCar-v0 (discrete), faithful to the Gym dynamics.
+
+Completes the classic-control family on the device path (CartPole, Acrobot,
+Pendulum, MountainCarContinuous, MountainCar); parity-tested against
+gymnasium in tests/test_envs.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class MountainCar:
+    min_position: float = -1.2
+    max_position: float = 0.6
+    max_speed: float = 0.07
+    goal_position: float = 0.5
+    goal_velocity: float = 0.0
+    force: float = 0.001
+    gravity: float = 0.0025
+
+    obs_dim: int = 2
+    action_dim: int = 3  # push left / no-op / push right
+    discrete: bool = True
+    default_horizon: int = 200
+    bc_dim: int = 1
+
+    def reset(self, key: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        pos = jax.random.uniform(key, (), minval=-0.6, maxval=-0.4)
+        state = jnp.stack([pos, jnp.float32(0.0)])
+        return state, state
+
+    def step(self, state, action):
+        position, velocity = state[0], state[1]
+        velocity = velocity + (action - 1) * self.force + jnp.cos(
+            3 * position
+        ) * (-self.gravity)
+        velocity = jnp.clip(velocity, -self.max_speed, self.max_speed)
+        position = jnp.clip(position + velocity, self.min_position, self.max_position)
+        velocity = jnp.where(
+            (position == self.min_position) & (velocity < 0), 0.0, velocity
+        )
+        done = (position >= self.goal_position) & (velocity >= self.goal_velocity)
+        reward = jnp.float32(-1.0)
+        new_state = jnp.stack([position, velocity])
+        return new_state, new_state, reward, done
+
+    def behavior(self, state, obs) -> jax.Array:
+        """BC = final position (how far up the hill it got)."""
+        return state[:1]

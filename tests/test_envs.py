@@ -104,6 +104,29 @@ class TestMountainCarParity:
             np.testing.assert_allclose(float(rew), grew, rtol=1e-4, atol=1e-5)
 
 
+class TestMountainCarDiscreteParity:
+    def test_step_for_step_vs_gymnasium(self):
+        from estorch_tpu.envs import MountainCar
+
+        start = np.array([-0.5, 0.0], dtype=np.float64)
+        actions = [2, 2, 0, 1, 2, 2, 0, 2]
+
+        def set_state(u):
+            u.state = start.copy()
+
+        gym_traj = _drive_gym("MountainCar-v0", set_state, actions,
+                              lambda u, o: np.asarray(o, dtype=np.float64))
+
+        env = MountainCar()
+        state = jnp.array(start, dtype=jnp.float32)
+        for i, ((gobs, grew, gterm), a) in enumerate(zip(gym_traj, actions)):
+            state, obs, rew, done = env.step(state, jnp.int32(a))
+            np.testing.assert_allclose(np.asarray(obs), gobs, rtol=1e-4, atol=1e-6,
+                                       err_msg=f"diverged at step {i}")
+            assert float(rew) == grew
+            assert bool(done) == gterm
+
+
 class TestAcrobotParity:
     def test_step_for_step_vs_gymnasium(self):
         start = np.array([0.05, -0.08, 0.02, 0.06], dtype=np.float64)
