@@ -1,28 +1,16 @@
 """End-to-end convergence: BASELINE config 1's acceptance criterion.
 
-CartPole-v1, 2-layer MLP, vanilla ES (the CPU smoke config): the trained
-CENTER policy must clear gymnasium's 'solved' bar (mean return ≥ 475) on
-held-out evaluation episodes.  ~18s on the 8-virtual-device CPU mesh.
+Runs the actual cartpole_smoke recipe (configs.py) at population 128 (the
+only deviation, for CI speed): the trained CENTER policy must clear
+gymnasium's 'solved' bar (mean return ≥ 475) on held-out evaluation
+episodes.  ~18s on the 8-virtual-device CPU mesh.
 """
 
-import optax
-
-from estorch_tpu import ES, JaxAgent, MLPPolicy
-from estorch_tpu.envs import CartPole
+from estorch_tpu.configs import cartpole_smoke
 
 
 def test_cartpole_solved():
-    es = ES(
-        policy=MLPPolicy,
-        agent=JaxAgent,
-        optimizer=optax.adam,
-        population_size=128,
-        sigma=0.1,
-        seed=0,
-        policy_kwargs={"action_dim": 2, "hidden": (32, 32)},
-        agent_kwargs={"env": CartPole()},
-        optimizer_kwargs={"learning_rate": 3e-2},
-    )
+    es = cartpole_smoke(population_size=128, seed=0)
     es.train(25, verbose=False)
     ev = es.evaluate_policy(n_episodes=50)
     assert ev["mean"] >= 475.0, f"not solved: {ev}"
