@@ -104,6 +104,7 @@ class ES:
         sigma_min: float = 0.0,
         mirrored: bool = True,
         episodes_per_member: int = 1,
+        worker_mode: str = "thread",
     ):
         self.population_size = population_size
         self.sigma = sigma
@@ -152,10 +153,15 @@ class ES:
             self.backend = "host"
             self._init_host(
                 optimizer, dict(optimizer_kwargs or {}), table_size, device,
-                weight_decay,
+                weight_decay, worker_mode,
             )
             self._post_engine_init()
             return
+        if worker_mode != "thread":
+            raise ValueError(
+                "worker_mode is a host-path option (thread|process); device/"
+                "pooled paths parallelize on the mesh"
+            )
         if _is_jax_env(getattr(self.agent, "env", None)):
             self.backend = "device"
         elif hasattr(self.agent, "env_name"):
@@ -307,7 +313,7 @@ class ES:
     # ----------------------------------------------------------- host backend
 
     def _init_host(self, optimizer, optimizer_kwargs, table_size, device,
-                   weight_decay=0.0):
+                   weight_decay=0.0, worker_mode="thread"):
         """Reference-parity path: torch policy + host Agent.rollout workers."""
         import copy
 
@@ -359,6 +365,7 @@ class ES:
             device="cpu" if device is None else str(device),
             prototype_agent=self.agent,  # dispatch probe doubles as worker 0
             weight_decay=weight_decay,
+            worker_mode=worker_mode,
         )
         self.state = self.engine.init_state()
 

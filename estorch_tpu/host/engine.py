@@ -79,6 +79,7 @@ class HostEngine:
         device: str = "cpu",
         prototype_agent: Any | None = None,
         weight_decay: float = 0.0,
+        worker_mode: str = "thread",
     ):
         import torch
 
@@ -113,9 +114,15 @@ class HostEngine:
         self._optimizer_kwargs = dict(optimizer_kwargs)
         self.optimizer = optimizer_ctor(self.master.parameters(), **optimizer_kwargs)
 
+        if worker_mode not in ("thread", "process"):
+            raise ValueError(
+                f"worker_mode must be 'thread' or 'process', got {worker_mode!r}"
+            )
+        self.worker_mode = worker_mode
         self._prototype_agent = prototype_agent
         self._workers: list[tuple[Any, Any]] = []  # (scratch policy, agent)
         self._pool: ThreadPoolExecutor | None = None
+        self._proc_pool = None  # lazily built ProcessPool (process mode)
         self.set_n_proc(n_proc)
 
     # ---------------------------------------------------------------- setup
@@ -129,16 +136,22 @@ class HostEngine:
 
     def set_n_proc(self, n_proc: int) -> None:
         """Grow the worker set (scratch policy + agent per worker) and keep a
-        persistent thread pool — no per-generation thread spawn/join."""
+        persistent thread pool — no per-generation thread spawn/join.
+
+        Process mode builds only worker 0 (used by evaluate_center); the
+        fork pool owns its own per-process policies/agents."""
         n_proc = max(1, int(n_proc))
-        while len(self._workers) < n_proc:
+        want_local = 1 if self.worker_mode == "process" else n_proc
+        while len(self._workers) < want_local:
             agent = (
                 self._prototype_agent
                 if not self._workers and self._prototype_agent is not None
                 else self.agent_factory()
             )
             self._workers.append((self._new_scratch_policy(), agent))
-        if self._pool is None or n_proc != getattr(self, "n_proc", None):
+        if self.worker_mode == "thread" and (
+            self._pool is None or n_proc != getattr(self, "n_proc", None)
+        ):
             if self._pool is not None:
                 self._pool.shutdown(wait=False)
             self._pool = ThreadPoolExecutor(max_workers=n_proc)
@@ -162,11 +175,18 @@ class HostEngine:
                                         dtype=torch.float32))
         for policy, _ in self._workers:
             policy.load_state_dict(self.master.state_dict())
+        if self._proc_pool is not None:
+            # forked workers carry the OLD buffers; rebuild with fresh state
+            self._proc_pool.close()
+            self._proc_pool = None
 
     def close(self) -> None:
         if self._pool is not None:
             self._pool.shutdown(wait=False)
             self._pool = None
+        if self._proc_pool is not None:
+            self._proc_pool.close()
+            self._proc_pool = None
 
     def __del__(self):
         try:
@@ -246,7 +266,25 @@ class HostEngine:
         steps = int(getattr(agent, "last_episode_steps", 0))
         return HostRolloutResult(float(reward), bc, steps)
 
+    def _proc_evaluate(self, state: HostState) -> HostEvalResult:
+        from .procpool import ProcessPool
+
+        if self._proc_pool is None or self._proc_pool.n_proc != self.n_proc:
+            if self._proc_pool is not None:
+                self._proc_pool.close()
+            self._proc_pool = ProcessPool(
+                self.policy_factory, self.agent_factory, self.n_proc,
+                self.population_size, self.dim, self.table,
+                master_state=self.master.state_dict(),
+            )
+        fitness, bc, steps = self._proc_pool.evaluate(
+            state.params_flat, self.sigma, self._pair_offsets(state)
+        )
+        return HostEvalResult(fitness=fitness, bc=bc, steps=int(steps))
+
     def evaluate(self, state: HostState) -> HostEvalResult:
+        if self.worker_mode == "process":
+            return self._proc_evaluate(state)
         offs = self._pair_offsets(state)
         results: list[HostRolloutResult | None] = [None] * self.population_size
 

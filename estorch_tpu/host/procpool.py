@@ -1,0 +1,183 @@
+"""Process-based host workers — the reference's n_proc semantics, GIL-free.
+
+The reference fans rollouts over OS processes (torch.distributed / MPI,
+SURVEY.md §2 item 7).  HostEngine's default thread workers are enough when
+gym/torch release the GIL, but pure-Python rollout code serializes; this
+pool forks real processes instead:
+
+- fork inherits the policy/agent FACTORIES and the shared noise table
+  (copy-on-write — the table is never shipped over a pipe);
+- each worker lazily builds its own scratch policy + agent after fork
+  (no pickling of user objects, no shared stateful envs);
+- per generation each worker receives only (params_flat, sigma, offsets)
+  once and evaluates its member slice; results return as
+  (indices, fitness, bc, steps) arrays;
+- a worker that dies mid-generation marks its whole slice NaN — the
+  straggler-drop path (utils/fault.py) renormalizes the update, exactly the
+  recovery SURVEY.md §5 prescribes (the reference hangs forever here).
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+from typing import Any, Callable
+
+import numpy as np
+
+
+def _worker_main(
+    conn,
+    policy_factory: Callable[[], Any],
+    agent_factory: Callable[[], Any],
+    worker_id: int,
+    n_proc: int,
+    population_size: int,
+    dim: int,
+    table,  # numpy array, shared via fork COW
+    master_state,  # master policy state_dict (fork-inherited) — syncs BUFFERS
+):
+    """Worker loop: build policy/agent once, evaluate member slices forever."""
+    import torch
+
+    torch.set_num_threads(1)  # workers parallelize across processes, not BLAS
+    policy = policy_factory()
+    # vector_to_parameters only writes parameters; buffers (frozen VBN stats,
+    # running means) must come from the master, same as thread scratch policies
+    policy.load_state_dict(master_state)
+    agent = agent_factory()
+
+    def load(flat):
+        with torch.no_grad():
+            torch.nn.utils.vector_to_parameters(
+                torch.from_numpy(np.ascontiguousarray(flat)).clone(),
+                policy.parameters(),
+            )
+
+    while True:
+        msg = conn.recv()
+        if msg is None:
+            return
+        seq, params_flat, sigma, offsets = msg
+        indices = list(range(worker_id, population_size, n_proc))
+        fitness = np.full(len(indices), np.nan, np.float32)
+        bcs: list[np.ndarray] = []
+        steps = 0
+        for j, i in enumerate(indices):
+            sign = 1.0 if i % 2 == 0 else -1.0
+            off = int(offsets[i // 2])
+            theta = params_flat + sigma * sign * table[off : off + dim]
+            load(theta)
+            try:
+                out = agent.rollout(policy)
+            except Exception:  # noqa: BLE001 — NaN marks the member failed
+                bcs.append(np.zeros(0, np.float32))
+                continue
+            if isinstance(out, tuple):
+                fitness[j] = float(out[0])
+                bcs.append(np.asarray(out[1], np.float32).reshape(-1))
+            else:
+                fitness[j] = float(out)
+                bcs.append(np.zeros(0, np.float32))
+            steps += int(getattr(agent, "last_episode_steps", 0))
+        bc_dim = max((b.shape[0] for b in bcs), default=0)
+        bc = np.zeros((len(indices), bc_dim), np.float32)
+        for j, b in enumerate(bcs):
+            if b.shape[0]:
+                bc[j] = b
+        conn.send((seq, np.asarray(indices, np.int64), fitness, bc, steps))
+
+
+class ProcessPool:
+    """Persistent fork-based worker team for HostEngine."""
+
+    def __init__(
+        self,
+        policy_factory,
+        agent_factory,
+        n_proc: int,
+        population_size: int,
+        dim: int,
+        table: np.ndarray,
+        master_state=None,
+    ):
+        if os.name != "posix":
+            raise RuntimeError("process workers need fork (posix)")
+        ctx = mp.get_context("fork")
+        self.n_proc = int(n_proc)
+        self.population_size = population_size
+        self._seq = 0
+        if master_state is None:
+            master_state = policy_factory().state_dict()
+        self._procs = []
+        self._conns = []
+        for w in range(self.n_proc):
+            parent, child = ctx.Pipe()
+            p = ctx.Process(
+                target=_worker_main,
+                args=(child, policy_factory, agent_factory, w, self.n_proc,
+                      population_size, dim, table, master_state),
+                daemon=True,
+            )
+            p.start()
+            child.close()
+            self._procs.append(p)
+            self._conns.append(parent)
+
+    def evaluate(self, params_flat: np.ndarray, sigma: float, offsets: np.ndarray,
+                 timeout_s: float = 600.0):
+        """Fan one generation out; returns (fitness, bc, steps) with dead
+        workers' slices left NaN (straggler-drop handles them upstream)."""
+        self._seq += 1
+        seq = self._seq
+        msg = (seq, np.asarray(params_flat, np.float32), float(sigma),
+               np.asarray(offsets))
+        for c in self._conns:
+            try:
+                c.send(msg)
+            except (BrokenPipeError, OSError):
+                pass  # dead worker: its slice stays NaN
+
+        fitness = np.full(self.population_size, np.nan, np.float32)
+        parts = []
+        for w, c in enumerate(self._conns):
+            if not self._procs[w].is_alive() and not c.poll(0):
+                continue
+            # drain: a straggler from a PREVIOUS generation may have queued a
+            # stale result — sequence tags keep generations from mixing
+            while c.poll(timeout_s):
+                try:
+                    got = c.recv()
+                except (EOFError, OSError):
+                    break
+                if got[0] == seq:
+                    parts.append(got[1:])
+                    break
+                # got[0] < seq: stale straggler result — discard, keep polling
+        bc_dim = max((p[2].shape[1] for p in parts), default=0)
+        bc = np.zeros((self.population_size, bc_dim), np.float32)
+        steps = 0
+        for indices, f, b, st in parts:
+            fitness[indices] = f
+            if b.shape[1]:
+                bc[indices] = b
+            steps += st
+        return fitness, bc, steps
+
+    def close(self) -> None:
+        for c in self._conns:
+            try:
+                c.send(None)
+                c.close()
+            except (BrokenPipeError, OSError):
+                pass
+        for p in self._procs:
+            p.join(timeout=5)
+            if p.is_alive():
+                p.terminate()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

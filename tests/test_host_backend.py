@@ -216,6 +216,77 @@ class TestHostTorchVBN:
             vbn(torch.randn(4))
 
 
+class TestProcessWorkers:
+    def test_process_mode_matches_thread_mode(self):
+        """Same seed: fork-based workers must produce identical params to
+        thread workers (deterministic fitness; layout member-indexed)."""
+        a = _make()
+        a.train(3, n_proc=2, verbose=False)
+        b = _make(worker_mode="process")
+        b.train(3, n_proc=2, verbose=False)
+        np.testing.assert_allclose(
+            a.state.params_flat, b.state.params_flat, rtol=1e-6, atol=1e-7
+        )
+        b.engine.close()
+
+    def test_process_mode_survives_member_exception(self):
+        class SometimesFails(QuadraticAgent):
+            def rollout(self, policy):
+                # deterministic: each worker's 3rd rollout fails
+                self._n = getattr(self, "_n", 0) + 1
+                if self._n == 3:
+                    raise RuntimeError("boom")
+                return super().rollout(policy)
+
+        es = _make(agent_cls=SometimesFails, worker_mode="process")
+        es.train(2, n_proc=2, verbose=False)  # must not raise
+        assert len(es.history) == 2
+        es.engine.close()
+
+    def test_process_workers_carry_master_buffers(self):
+        """Forked workers must inherit master BUFFERS (frozen VBN stats) —
+        vector_to_parameters only syncs parameters (regression)."""
+        from estorch_tpu.models import TorchVirtualBatchNorm
+
+        class VBNPolicy(torch.nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.l1 = torch.nn.Linear(4, 8)
+                self.vbn = TorchVirtualBatchNorm(8)
+                self.l2 = torch.nn.Linear(8, 2)
+
+            def forward(self, x):
+                return self.l2(torch.tanh(self.vbn(self.l1(x))))
+
+        class VBNAgent:
+            def rollout(self, policy):
+                with torch.no_grad():
+                    out = policy(torch.zeros(3, 4))  # batched: VBN must be frozen
+                    return -float((out**2).sum())
+
+        es = ES(VBNPolicy, VBNAgent, torch.optim.Adam, population_size=8,
+                sigma=0.05, seed=0, optimizer_kwargs={"lr": 1e-2},
+                table_size=1 << 12, worker_mode="process")
+        es.engine.freeze_vbn(torch.randn(32, 4).numpy())
+        es.train(2, n_proc=2, verbose=False)
+        # every member must have evaluated (no NaN-from-uninitialized-VBN)
+        assert es.history[-1]["n_failed"] == 0
+        es.engine.close()
+
+    def test_worker_mode_rejected_on_device_path(self):
+        import optax
+
+        from estorch_tpu import JaxAgent, MLPPolicy
+        from estorch_tpu.envs import CartPole
+
+        with pytest.raises(ValueError, match="worker_mode"):
+            ES(MLPPolicy, JaxAgent, optax.adam, population_size=16,
+               policy_kwargs={"action_dim": 2},
+               agent_kwargs={"env": CartPole()},
+               optimizer_kwargs={"learning_rate": 1e-2},
+               table_size=1 << 14, worker_mode="process")
+
+
 class TestHostOptimizerIsolation:
     def test_meta_centers_do_not_share_adam_moments(self):
         """Interleaving updates of two states must not change either's result
