@@ -80,6 +80,7 @@ class HostEngine:
         prototype_agent: Any | None = None,
         weight_decay: float = 0.0,
         worker_mode: str = "thread",
+        proc_timeout_s: float = 600.0,
     ):
         import torch
 
@@ -119,6 +120,10 @@ class HostEngine:
                 f"worker_mode must be 'thread' or 'process', got {worker_mode!r}"
             )
         self.worker_mode = worker_mode
+        # per-generation straggler budget PER WORKER in process mode; size to
+        # population/n_proc × slowest-rollout (slices that exceed it are
+        # NaN-dropped). Mutable attribute: es.engine.proc_timeout_s = ...
+        self.proc_timeout_s = float(proc_timeout_s)
         self._prototype_agent = prototype_agent
         self._workers: list[tuple[Any, Any]] = []  # (scratch policy, agent)
         self._pool: ThreadPoolExecutor | None = None
@@ -278,7 +283,8 @@ class HostEngine:
                 master_state=self.master.state_dict(),
             )
         fitness, bc, steps = self._proc_pool.evaluate(
-            state.params_flat, self.sigma, self._pair_offsets(state)
+            state.params_flat, self.sigma, self._pair_offsets(state),
+            timeout_s=self.proc_timeout_s,
         )
         return HostEvalResult(fitness=fitness, bc=bc, steps=int(steps))
 

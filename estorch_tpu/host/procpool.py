@@ -54,6 +54,8 @@ def _worker_main(
                 policy.parameters(),
             )
 
+    from .engine import HostEngine  # reuse the duck-typed rollout parsing
+
     while True:
         msg = conn.recv()
         if msg is None:
@@ -69,17 +71,13 @@ def _worker_main(
             theta = params_flat + sigma * sign * table[off : off + dim]
             load(theta)
             try:
-                out = agent.rollout(policy)
+                res = HostEngine._call_rollout(agent, policy)
             except Exception:  # noqa: BLE001 — NaN marks the member failed
                 bcs.append(np.zeros(0, np.float32))
                 continue
-            if isinstance(out, tuple):
-                fitness[j] = float(out[0])
-                bcs.append(np.asarray(out[1], np.float32).reshape(-1))
-            else:
-                fitness[j] = float(out)
-                bcs.append(np.zeros(0, np.float32))
-            steps += int(getattr(agent, "last_episode_steps", 0))
+            fitness[j] = res.total_reward
+            bcs.append(res.bc)
+            steps += res.steps
         bc_dim = max((b.shape[0] for b in bcs), default=0)
         bc = np.zeros((len(indices), bc_dim), np.float32)
         for j, b in enumerate(bcs):

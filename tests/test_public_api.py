@@ -1,0 +1,81 @@
+"""The public API surface is a parity CONTRACT (SURVEY.md Appendix A) —
+this test freezes it so refactors can't silently drop exports."""
+
+import inspect
+
+
+def test_reference_parity_imports():
+    """The reference's import line, estorch_tpu edition."""
+    from estorch_tpu import (  # noqa: F401
+        ES,
+        NS_ES,
+        NSR_ES,
+        NSRA_ES,
+        VirtualBatchNorm,
+    )
+
+
+def test_extended_surface_imports():
+    from estorch_tpu import (  # noqa: F401
+        JaxAgent,
+        MLPPolicy,
+        NatureCNN,
+        NoveltyArchive,
+        PooledAgent,
+    )
+    from estorch_tpu.models import TorchVirtualBatchNorm  # noqa: F401
+    from estorch_tpu.envs import (  # noqa: F401
+        Acrobot,
+        CartPole,
+        MountainCar,
+        MountainCarContinuous,
+        Pendulum,
+    )
+    from estorch_tpu.parallel import (  # noqa: F401
+        global_population_mesh,
+        initialize_distributed,
+        population_mesh,
+    )
+    from estorch_tpu.utils import (  # noqa: F401
+        JsonlWriter,
+        PeriodicCheckpointer,
+        restore_checkpoint,
+        save_checkpoint,
+    )
+
+
+def test_es_constructor_signature_matches_reference():
+    """Appendix A ctor args must all exist with these names."""
+    from estorch_tpu import ES
+
+    params = inspect.signature(ES.__init__).parameters
+    for name in ("policy", "agent", "optimizer", "population_size", "sigma",
+                 "device", "policy_kwargs", "agent_kwargs", "optimizer_kwargs"):
+        assert name in params, f"reference ctor arg {name!r} missing"
+
+
+def test_train_signature_matches_reference():
+    from estorch_tpu import ES
+
+    params = inspect.signature(ES.train).parameters
+    assert "n_steps" in params
+    assert "n_proc" in params
+
+
+def test_novelty_ctor_extras_match_reference():
+    """Appendix A: k, meta-population size; NSRA: weight, delta, patience."""
+    from estorch_tpu import NS_ES, NSRA_ES
+
+    ns = inspect.signature(NS_ES.__init__).parameters
+    assert "k" in ns and "meta_population_size" in ns
+    nsra = inspect.signature(NSRA_ES.__init__).parameters
+    for name in ("weight", "weight_delta", "stagnation_patience"):
+        assert name in nsra
+
+
+def test_instance_attributes_exposed():
+    """es.policy / es.best_policy / es.best_reward exist as the reference's."""
+    from estorch_tpu import ES
+
+    assert isinstance(ES.policy, property)
+    assert isinstance(ES.best_policy, property)
