@@ -264,10 +264,10 @@ class ES:
         self, policy, policy_kwargs, optimizer, optimizer_kwargs,
         table_size, eval_chunk, grad_chunk, weight_decay, mesh, device, vbn_batch,
     ):
-        from ..envs.native_pool import env_spec
+        from ..envs.gym_vec_pool import pool_env_spec
         from ..parallel.pooled import PooledEngine
 
-        spec_info = env_spec(self.agent.env_name)
+        spec_info = pool_env_spec(self.agent.env_name)
         self.env = None
         obs0 = jnp.zeros(spec_info["obs_shape"], jnp.float32)
 
@@ -291,9 +291,9 @@ class ES:
     def _pooled_reference_batch(self, n: int):
         """Random-action observations from the pool for VBN statistics,
         reshaped to the policy-facing observation shape (pixels etc.)."""
-        from ..envs.native_pool import NativeEnvPool
+        from ..envs.gym_vec_pool import make_pool
 
-        pool = NativeEnvPool(self.agent.env_name, n_envs=max(1, n // 4))
+        pool = make_pool(self.agent.env_name, max(1, n // 4))
         rng = np.random.default_rng(self.seed)
         frames = [pool.reset()]
         for _ in range(4):

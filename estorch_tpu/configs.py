@@ -163,6 +163,30 @@ def humanoid_nsres(**over):
     return NSR_ES(**kw)
 
 
+def halfcheetah_pooled(**over):
+    """BASELINE config 2, pooled edition: HalfCheetah physics in gym.vector
+    workers while the population's policy forwards run device-batched —
+    the no-MJX path to MuJoCo at scale (vs halfcheetah_vbn's per-member
+    host rollouts)."""
+    import optax
+
+    from . import ES, MLPPolicy, PooledAgent
+
+    kw = dict(
+        policy=MLPPolicy,
+        agent=PooledAgent,
+        optimizer=optax.adam,
+        population_size=1000,
+        sigma=0.02,
+        policy_kwargs={"action_dim": 6, "hidden": (64, 64), "discrete": False},
+        agent_kwargs={"env_name": "gym:HalfCheetah-v5", "horizon": 1000},
+        optimizer_kwargs={"learning_rate": 1e-2},
+        weight_decay=0.005,
+    )
+    kw.update(over)
+    return ES(**kw)
+
+
 def pong84_conv(**over):
     """Conv-rollout stress without ALE: NatureCNN on the bundled C++ pixel
     pong (84×84), pooled execution — the same machinery BASELINE config 5
@@ -217,6 +241,7 @@ CONFIGS: dict[str, Callable] = {
     "halfcheetah_vbn": halfcheetah_vbn,
     "humanoid_mirrored": humanoid_mirrored,
     "humanoid_nsres": humanoid_nsres,
+    "halfcheetah_pooled": halfcheetah_pooled,
     "pong84_conv": pong84_conv,
     "atari_frostbite": atari_frostbite,
 }

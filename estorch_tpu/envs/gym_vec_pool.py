@@ -1,0 +1,150 @@
+"""Gymnasium vector-env pool with the NativeEnvPool interface.
+
+Lets ANY gymnasium env — MuJoCo included — ride the pooled execution path
+(parallel/pooled.py): N = population envs stepped through
+``gym.vector`` while the device runs one batched policy forward per step.
+This is how BASELINE configs 2-3 (HalfCheetah/Humanoid) get device-batched
+inference without MJX: physics on host workers, the population's matmuls
+on the accelerator.
+
+Use via the ``gym:`` prefix:  ``PooledAgent(env_name="gym:HalfCheetah-v5")``.
+
+Interface-compatible with NativeEnvPool: ``reset() -> obs``,
+``step(actions) -> (obs, rew, done)``, float32 flat observation buffers
+plus ``obs_shape`` for the policy-facing view.  ONE documented semantic
+difference: gymnasium ≥1.0 vector envs auto-reset in NEXT_STEP mode — on
+the done step you receive the TERMINAL observation (the C++ pool returns
+the fresh reset state there).  The pooled engine masks with ``alive`` and
+never reads past done, so both semantics evaluate identically; consumers
+reading post-done observations must not assume the native-pool behavior.
+
+Worker model: gym.vector forks ONE process per env (async) — fine up to a
+couple of workers per core, a fork storm beyond.  ``asynchronous`` defaults
+to sync on this basis; batched-many-envs-per-worker pools are what the C++
+envpool is for (ROADMAP: ALE/EnvPool-style batching for gym envs).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class GymVecPool:
+    """N gymnasium envs behind the pool interface (auto-reset semantics)."""
+
+    def __init__(self, env_id: str, n_envs: int, n_threads: int = 0, seed: int = 0,
+                 asynchronous: bool | None = None):
+        import gymnasium as gym
+
+        self.env_name = f"gym:{env_id}"
+        self.n_envs = int(n_envs)
+        # async forks one process per env: only worth it with >1 core and a
+        # sane worker-to-core ratio; n_envs==1 is always sync (pure overhead)
+        if asynchronous is None:
+            import os
+
+            cores = (
+                len(os.sched_getaffinity(0))
+                if hasattr(os, "sched_getaffinity")
+                else (os.cpu_count() or 1)
+            )
+            asynchronous = cores > 1 and 1 < self.n_envs <= 2 * cores
+        ctor = gym.vector.AsyncVectorEnv if asynchronous else gym.vector.SyncVectorEnv
+        self._vec = ctor([self._make_one(env_id) for _ in range(self.n_envs)])
+        self._seed = int(seed)
+        self._seeded = False
+
+        obs_space = self._vec.single_observation_space
+        act_space = self._vec.single_action_space
+        self.obs_shape = tuple(obs_space.shape)
+        self.obs_dim = int(np.prod(self.obs_shape))
+        if hasattr(act_space, "n"):  # Discrete
+            self.discrete = True
+            self.n_actions = int(act_space.n)
+            self.act_dim = 1
+        else:
+            self.discrete = False
+            self.n_actions = 0
+            self.act_dim = int(np.prod(act_space.shape))
+        self._act_shape = tuple(getattr(act_space, "shape", ()) or ())
+
+    @staticmethod
+    def _make_one(env_id: str):
+        def thunk():
+            import gymnasium as gym
+
+            return gym.make(env_id)
+
+        return thunk
+
+    @property
+    def is_native(self) -> bool:
+        return False
+
+    def reset(self) -> np.ndarray:
+        # seed only ONCE: later resets continue the envs' RNG streams, so
+        # every generation draws fresh initial states (native-pool parity) —
+        # reseeding each call would evaluate identical starts forever
+        if not self._seeded:
+            obs, _ = self._vec.reset(seed=self._seed)
+            self._seeded = True
+        else:
+            obs, _ = self._vec.reset()
+        return np.asarray(obs, np.float32).reshape(self.n_envs, self.obs_dim)
+
+    def step(self, actions: np.ndarray):
+        a = np.asarray(actions)
+        if self.discrete:
+            a = a.reshape(self.n_envs).astype(np.int64)
+        else:
+            a = a.reshape((self.n_envs,) + self._act_shape).astype(np.float32)
+        obs, rew, term, trunc, _ = self._vec.step(a)
+        done = np.asarray(term) | np.asarray(trunc)
+        return (
+            np.asarray(obs, np.float32).reshape(self.n_envs, self.obs_dim),
+            np.asarray(rew, np.float32),
+            done,
+        )
+
+    def close(self) -> None:
+        try:
+            self._vec.close()
+        except Exception:
+            pass
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def make_pool(env_name: str, n_envs: int, n_threads: int = 0, seed: int = 0):
+    """Pool factory: ``gym:<EnvId>`` → GymVecPool, else the C++ NativeEnvPool."""
+    if env_name.startswith("gym:"):
+        return GymVecPool(env_name[4:], n_envs, n_threads=n_threads, seed=seed)
+    from .native_pool import NativeEnvPool
+
+    return NativeEnvPool(env_name, n_envs, n_threads=n_threads, seed=seed)
+
+
+def pool_env_spec(env_name: str) -> dict:
+    """env_spec covering both pool families (probe-free for native envs)."""
+    if env_name.startswith("gym:"):
+        import gymnasium as gym
+
+        env = gym.make(env_name[4:])
+        obs_shape = tuple(env.observation_space.shape)
+        act = env.action_space
+        spec = {
+            "obs_dim": int(np.prod(obs_shape)),
+            "obs_shape": obs_shape,
+            "discrete": hasattr(act, "n"),
+            "n_actions": int(getattr(act, "n", 0)),
+            "act_dim": 1 if hasattr(act, "n") else int(np.prod(act.shape)),
+        }
+        env.close()
+        return spec
+    from .native_pool import env_spec
+
+    return env_spec(env_name)

@@ -24,7 +24,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..envs.native_pool import NativeEnvPool
+from ..envs.gym_vec_pool import make_pool
 from ..ops.noise import member_offsets, pair_signs
 from ..ops.ranks import centered_rank_np
 from .engine import ESEngine, ESState
@@ -78,14 +78,14 @@ class PooledEngine:
                 raise ValueError(
                     "double_buffer needs an even population of at least 2"
                 )
-            self.pool_a = NativeEnvPool(env_name, n_envs=half, n_threads=n_threads, seed=seed)
-            self.pool_b = NativeEnvPool(env_name, n_envs=half, n_threads=n_threads, seed=seed + 10_007)
+            self.pool_a = make_pool(env_name, half, n_threads=n_threads, seed=seed)
+            self.pool_b = make_pool(env_name, half, n_threads=n_threads, seed=seed + 10_007)
             self.pool = self.pool_a  # dims/metadata accessor
         else:
-            self.pool = NativeEnvPool(
-                env_name, n_envs=config.population_size, n_threads=n_threads, seed=seed
+            self.pool = make_pool(
+                env_name, config.population_size, n_threads=n_threads, seed=seed
             )
-        self.center_pool = NativeEnvPool(env_name, n_envs=1, n_threads=1, seed=seed + 1)
+        self.center_pool = make_pool(env_name, 1, n_threads=1, seed=seed + 1)
         self.bc_dim = self.pool.obs_dim  # BC = final observation
         discrete = self.pool.discrete
         obs_shape = self.pool.obs_shape  # policy-facing shape (pixels etc.)
@@ -247,9 +247,9 @@ class PooledEngine:
             total += float(rew[0])
             steps += 1
             if bool(done[0]):
-                # the pool auto-resets on done, so nobs[0] is a FRESH reset
-                # state — keep the pre-terminal frame as the BC, matching
-                # evaluate()'s final_obs convention
+                # post-done nobs[0] is not this episode's frame (C++ pool:
+                # fresh reset state; gym pool: terminal obs) — keep the
+                # pre-step frame as the BC, matching evaluate()'s convention
                 break
             obs = nobs[0]
         return RolloutResult(

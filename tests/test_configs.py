@@ -13,6 +13,7 @@ class TestConfigs:
             "halfcheetah_vbn",
             "humanoid_mirrored",
             "humanoid_nsres",
+            "halfcheetah_pooled",
             "pong84_conv",
             "atari_frostbite",
         }
