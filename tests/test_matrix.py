@@ -1,0 +1,102 @@
+"""Cross-backend × algorithm integration matrix.
+
+Every algorithm on every backend, two generations each — the seams where
+integration breaks hide (novelty-on-pooled, NSRA-on-host, gym-pool
+variants). Asserts the contract every combination must honor: records
+complete, fitness finite, state advances, novelty bookkeeping consistent.
+"""
+
+import numpy as np
+import optax
+import pytest
+import torch
+
+from estorch_tpu import ES, NS_ES, NSR_ES, NSRA_ES, JaxAgent, MLPPolicy, PooledAgent
+from estorch_tpu.envs import CartPole
+
+ALGOS = {
+    "ES": (ES, {}),
+    "NS_ES": (NS_ES, {"meta_population_size": 2, "k": 3}),
+    "NSR_ES": (NSR_ES, {"meta_population_size": 2, "k": 3}),
+    "NSRA_ES": (NSRA_ES, {"meta_population_size": 2, "k": 3, "weight": 0.7}),
+}
+
+
+class _TorchMLP(torch.nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.net = torch.nn.Sequential(
+            torch.nn.Linear(4, 8), torch.nn.Tanh(), torch.nn.Linear(8, 2)
+        )
+
+    def forward(self, x):
+        return self.net(x)
+
+
+class _QuadAgent:
+    def rollout(self, policy):
+        with torch.no_grad():
+            v = torch.nn.utils.parameters_to_vector(policy.parameters())
+            r = -float(((v - 0.1) ** 2).sum())
+        self.last_episode_steps = 1
+        return r, v[:2].numpy()
+
+
+BACKENDS = {
+    "device": dict(
+        policy=MLPPolicy,
+        agent=JaxAgent,
+        optimizer=optax.adam,
+        policy_kwargs={"action_dim": 2, "hidden": (8,)},
+        agent_kwargs={"env": CartPole(), "horizon": 30},
+        optimizer_kwargs={"learning_rate": 1e-2},
+    ),
+    "pooled-native": dict(
+        policy=MLPPolicy,
+        agent=PooledAgent,
+        optimizer=optax.adam,
+        policy_kwargs={"action_dim": 2, "hidden": (8,)},
+        agent_kwargs={"env_name": "cartpole", "horizon": 30},
+        optimizer_kwargs={"learning_rate": 1e-2},
+    ),
+    "pooled-gym": dict(
+        policy=MLPPolicy,
+        agent=PooledAgent,
+        optimizer=optax.adam,
+        policy_kwargs={"action_dim": 2, "hidden": (8,)},
+        agent_kwargs={"env_name": "gym:CartPole-v1", "horizon": 30},
+        optimizer_kwargs={"learning_rate": 1e-2},
+    ),
+    "host": dict(
+        policy=_TorchMLP,
+        agent=_QuadAgent,
+        optimizer=torch.optim.Adam,
+        optimizer_kwargs={"lr": 1e-2},
+    ),
+}
+
+
+@pytest.mark.parametrize("backend", sorted(BACKENDS))
+@pytest.mark.parametrize("algo", sorted(ALGOS))
+def test_algo_backend_combination(backend, algo):
+    cls, extra = ALGOS[algo]
+    kw = dict(BACKENDS[backend])
+    kw.update(extra)
+    es = cls(population_size=16, sigma=0.05, seed=0, table_size=1 << 14, **kw)
+    es.train(2, verbose=False)
+
+    assert len(es.history) == 2
+    for rec in es.history:
+        assert np.isfinite(rec["reward_mean"])
+        assert np.isfinite(rec["grad_norm"])
+    assert es.generation == 2
+    if algo != "ES":
+        # archive: meta seeds + one BC per generation; meta states intact
+        assert len(es.archive) == 2 + 2
+        assert len(es.meta_states) == 2
+        assert "novelty_mean" in es.history[-1]
+    if algo == "NSRA_ES":
+        assert 0.0 <= es.history[-1]["nsra_weight"] <= 1.0
+    if backend.startswith("pooled"):
+        es.engine.pool.close()
+        es.engine.center_pool.close()
