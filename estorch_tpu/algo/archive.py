@@ -17,11 +17,21 @@ import numpy as np
 
 
 class NoveltyArchive:
-    """Append-only store of behavior characterizations with mean-k-NN novelty."""
+    """Append-only store of behavior characterizations with mean-k-NN novelty.
 
-    def __init__(self, k: int = 10, bc_dim: int | None = None):
+    ``max_size`` bounds long runs: beyond it the OLDEST entries are evicted
+    (FIFO), keeping novelty focused on recent behavior and the k-NN cost
+    constant.  0 (default) = unbounded, the reference's behavior.
+    """
+
+    def __init__(self, k: int = 10, bc_dim: int | None = None, max_size: int = 0):
         self.k = int(k)
         self.bc_dim = bc_dim
+        if max_size < 0:
+            raise ValueError(
+                f"max_size must be >= 0 (0 = unbounded), got {max_size}"
+            )
+        self.max_size = int(max_size)
         self._bcs: list[np.ndarray] = []
 
     def __len__(self) -> int:
@@ -40,6 +50,8 @@ class NoveltyArchive:
         elif bc.shape[0] != self.bc_dim:
             raise ValueError(f"BC dim {bc.shape[0]} != archive dim {self.bc_dim}")
         self._bcs.append(bc)
+        if self.max_size and len(self._bcs) > self.max_size:
+            del self._bcs[: len(self._bcs) - self.max_size]
 
     def novelty(self, bcs) -> np.ndarray:
         """Mean distance to the k nearest archived BCs, per query row.
@@ -75,12 +87,21 @@ class NoveltyArchive:
 
     def state_dict(self) -> dict:
         """For checkpointing (utils/checkpoint.py)."""
-        return {"k": self.k, "bc_dim": self.bc_dim, "bcs": self.bcs}
+        return {
+            "k": self.k,
+            "bc_dim": self.bc_dim,
+            "max_size": self.max_size,
+            "bcs": self.bcs,
+        }
 
     @classmethod
     def from_state_dict(cls, d: dict) -> "NoveltyArchive":
         bc_dim = d.get("bc_dim")
-        ar = cls(k=int(d["k"]), bc_dim=None if bc_dim is None else int(bc_dim))
+        ar = cls(
+            k=int(d["k"]),
+            bc_dim=None if bc_dim is None else int(bc_dim),
+            max_size=int(d.get("max_size", 0)),
+        )
         for row in np.asarray(d["bcs"]):
             ar.add(row)
         return ar

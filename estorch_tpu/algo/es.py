@@ -453,6 +453,9 @@ class ES:
             "env_steps": steps,
             "env_steps_per_sec": steps / dt if dt > 0 else 0.0,
             "grad_norm": grad_norm,
+            "sigma": float(np.asarray(prev_state.sigma))
+            if hasattr(prev_state, "sigma") and prev_state.sigma is not None
+            else self.sigma,
             "wall_time_s": dt,
         }
 
@@ -513,18 +516,34 @@ class ES:
             raise AttributeError("best_policy_variables is device-path only; use .best_policy")
         return {"params": self.best_policy, **self._frozen}
 
-    def evaluate_policy(self, n_episodes: int = 10, use_best: bool = False, seed: int = 0):
+    def evaluate_policy(self, n_episodes: int = 10, use_best: bool = False,
+                        seed: int = 0, meta_index: int | None = None):
         """Mean/std episode return of the current (or best) policy.
 
         The reference's users hand-roll this with ``agent.rollout(es.policy)``
         loops; here it is one vmapped compiled program on the device path and
         the engines' own center-evaluation on host/pooled paths (where
         episode randomness comes from the env/pool RNG streams — ``seed``
-        controls the device path only).
+        controls the device path only).  ``meta_index`` selects a specific
+        meta-population center (novelty family; default = center 0, the one
+        ``es.policy`` exposes).
         """
+        if meta_index is not None:
+            if not hasattr(self, "meta_states"):
+                raise ValueError(
+                    "meta_index applies to the novelty family (NS/NSR/NSRA)"
+                )
+            if use_best:
+                raise ValueError(
+                    "use_best evaluates the GLOBAL best member snapshot — "
+                    "it cannot be combined with meta_index (per-center eval)"
+                )
+            base_state = self.meta_states[meta_index]
+        else:
+            base_state = self.state
         use_best = use_best and self._best_flat is not None
         if self.backend == "device":
-            flat = jnp.asarray(self._best_flat) if use_best else self.state.params_flat
+            flat = jnp.asarray(self._best_flat) if use_best else base_state.params_flat
             fn = self._eval_policy_fn
             if fn is None:
                 from ..envs.rollout import make_rollout
@@ -538,8 +557,8 @@ class ES:
         else:
             # both engines' evaluate_center reads only state.params_flat, so
             # a params-swapped state evaluates the requested policy
-            flat = self._best_flat if use_best else self.state.params_flat
-            eval_state = self.state._replace(
+            flat = self._best_flat if use_best else base_state.params_flat
+            eval_state = base_state._replace(
                 params_flat=np.asarray(flat, np.float32)
                 if self.backend == "host"
                 else jnp.asarray(flat)

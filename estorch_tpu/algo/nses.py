@@ -44,13 +44,16 @@ class NS_ES(ES):
         *,
         k: int = 10,
         meta_population_size: int = 3,
+        archive_max_size: int = 0,
         **kwargs,
     ):
         super().__init__(policy, agent, optimizer, **kwargs)
         self.k = k
         self.meta_population_size = int(meta_population_size)
         bc_dim = getattr(self.engine, "bc_dim", None) or None
-        self.archive = NoveltyArchive(k=k, bc_dim=bc_dim)
+        self.archive = NoveltyArchive(
+            k=k, bc_dim=bc_dim, max_size=archive_max_size
+        )
 
         # meta-population: M independent centers sharing one engine/noise table.
         # state[0] reuses the base-class init; the rest start from fresh

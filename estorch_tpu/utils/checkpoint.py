@@ -90,6 +90,7 @@ def _meta_dict(es) -> dict:
     if hasattr(es, "archive"):
         meta["archive_k"] = es.archive.k
         meta["archive_bc_dim"] = es.archive.bc_dim
+        meta["archive_max_size"] = es.archive.max_size
     if hasattr(es, "weight"):  # NSRA
         meta["nsra_weight"] = float(es.weight)
         meta["nsra_stagnation"] = int(es._stagnation)
@@ -175,10 +176,14 @@ def restore_checkpoint(es, path: str) -> None:
     if hasattr(es, "archive"):
         from ..algo.archive import NoveltyArchive
 
-        ar = NoveltyArchive(k=int(meta["archive_k"]), bc_dim=meta["archive_bc_dim"])
-        for row in _np(tree["archive_bcs"]):
-            ar.add(row)
-        es.archive = ar
+        es.archive = NoveltyArchive.from_state_dict(
+            {
+                "k": meta["archive_k"],
+                "bc_dim": meta["archive_bc_dim"],
+                "max_size": meta.get("archive_max_size", 0),
+                "bcs": _np(tree["archive_bcs"]),
+            }
+        )
         es._center_bc = [_np(b) for b in tree["center_bc"]]
     if "nsra_weight" in meta and hasattr(es, "weight"):
         es.weight = float(meta["nsra_weight"])

@@ -273,6 +273,47 @@ class TestProcessWorkers:
         assert es.history[-1]["n_failed"] == 0
         es.engine.close()
 
+    def test_straggler_timeout_nans_slice_without_desync(self, tmp_path):
+        """EXACTLY one worker exceeds proc_timeout_s (file-claim makes it
+        deterministic): its slice is NaN'd that generation, and its LATE
+        reply must be discarded — the next evaluation's fitness must equal
+        the analytic values for the CURRENT thetas (sequence tags)."""
+        import time as _time
+
+        flag = str(tmp_path / "slow_claim")
+        open(flag, "w").close()
+
+        class SlowOnceAgent(QuadraticAgent):
+            def rollout(self, policy):
+                import os
+
+                try:  # atomic claim: exactly one process sleeps, exactly once
+                    os.rename(flag, flag + ".claimed")
+                    _time.sleep(1.5)
+                except OSError:
+                    pass
+                return super().rollout(policy)
+
+        es = _make(agent_cls=SlowOnceAgent, worker_mode="process", pop=8)
+        es.engine.proc_timeout_s = 0.4  # shorter than the sleep
+        es.train(1, n_proc=2, verbose=False)
+        assert es.history[0]["n_failed"] == 4  # one worker's slice dropped
+
+        # the straggler's stale gen-1 reply is (or soon will be) queued in
+        # its pipe; the next evaluation's drain must discard it and return
+        # fresh values for the CURRENT state — verified analytically
+        es.engine.proc_timeout_s = 30.0
+        ev = es.engine.evaluate(es.state)
+        expected = np.array(
+            [
+                -float(((es.engine.member_theta(es.state, i) - 0.1) ** 2).sum())
+                for i in range(8)
+            ],
+            np.float32,
+        )
+        np.testing.assert_allclose(ev.fitness, expected, rtol=1e-4, atol=1e-5)
+        es.engine.close()
+
     def test_worker_mode_rejected_on_device_path(self):
         import optax
 

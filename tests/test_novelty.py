@@ -52,6 +52,16 @@ class TestArchive:
         out = ar.novelty(np.ones(2))
         assert np.ndim(out) == 0 or out.shape == ()
 
+    def test_max_size_evicts_oldest(self):
+        ar = NoveltyArchive(k=2, max_size=3)
+        for i in range(5):
+            ar.add(np.full(2, float(i)))
+        assert len(ar) == 3
+        np.testing.assert_array_equal(ar.bcs[:, 0], [2.0, 3.0, 4.0])
+        # roundtrip preserves the cap
+        ar2 = NoveltyArchive.from_state_dict(ar.state_dict())
+        assert ar2.max_size == 3 and len(ar2) == 3
+
     def test_state_dict_roundtrip(self):
         ar = NoveltyArchive(k=4)
         for i in range(5):
@@ -163,6 +173,27 @@ class TestNoveltyTraining:
             np.asarray(b.meta_states[0].params_flat),
         )
         assert a.history[-1]["reward_mean"] == b.history[-1]["reward_mean"]
+
+    def test_evaluate_policy_meta_index(self):
+        es = self._train(NS_ES)
+        e0 = es.evaluate_policy(n_episodes=2, meta_index=0)
+        e1 = es.evaluate_policy(n_episodes=2, meta_index=1)
+        assert e0["episodes"] == e1["episodes"] == 2
+        # distinct centers generally evaluate differently
+        assert e0["mean"] != e1["mean"] or e0["max"] != e1["max"]
+
+    def test_meta_index_rejected_on_plain_es(self):
+        import optax
+
+        from estorch_tpu import ES, JaxAgent, MLPPolicy
+        from estorch_tpu.envs import CartPole
+
+        es = ES(MLPPolicy, JaxAgent, optax.adam, population_size=16,
+                policy_kwargs={"action_dim": 2, "hidden": (8,)},
+                agent_kwargs={"env": CartPole(), "horizon": 20},
+                optimizer_kwargs={"learning_rate": 1e-2}, table_size=1 << 14)
+        with pytest.raises(ValueError, match="novelty family"):
+            es.evaluate_policy(meta_index=0)
 
     def test_meta_population_centers_start_distinct(self):
         es = self._train(NS_ES)

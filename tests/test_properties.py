@@ -59,7 +59,7 @@ class TestCenteredRankProperties:
 
 class TestFoldProperties:
     @given(hnp.arrays(np.float32, st.integers(1, 32).map(lambda k: 2 * k),
-                      elements=st.floats(-10, 10, allow_nan=False, width=32)))
+                      elements=st.floats(-10, 10, allow_nan=False, allow_subnormal=False, width=32)))
     @settings(max_examples=30, deadline=None)
     def test_fold_is_signed_pair_sum(self, w):
         folded = np.asarray(fold_mirrored_weights(jnp.asarray(w)))
@@ -70,9 +70,11 @@ class TestFoldProperties:
 class TestArchiveProperties:
     @given(
         hnp.arrays(np.float32, st.tuples(st.integers(1, 12), st.just(3)),
-                   elements=st.floats(-5, 5, allow_nan=False, width=32)),
+                   elements=st.floats(-5, 5, allow_nan=False,
+                                      allow_subnormal=False, width=32)),
         hnp.arrays(np.float32, st.tuples(st.integers(1, 6), st.just(3)),
-                   elements=st.floats(-5, 5, allow_nan=False, width=32)),
+                   elements=st.floats(-5, 5, allow_nan=False,
+                                      allow_subnormal=False, width=32)),
     )
     @settings(max_examples=25, deadline=None)
     def test_novelty_nonnegative_and_self_zero_with_k1(self, bcs, queries):
@@ -99,7 +101,8 @@ class TestArchiveProperties:
 class TestFaultProperties:
     @given(
         hnp.arrays(np.float32, st.integers(3, 32),
-                   elements=st.floats(-10, 10, allow_nan=False, width=32)),
+                   elements=st.floats(-10, 10, allow_nan=False,
+                                      allow_subnormal=False, width=32)),
         st.data(),
     )
     @settings(max_examples=25, deadline=None)
