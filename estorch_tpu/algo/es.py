@@ -105,6 +105,7 @@ class ES:
         mirrored: bool = True,
         episodes_per_member: int = 1,
         worker_mode: str = "thread",
+        decomposed: bool = False,
     ):
         self.population_size = population_size
         self.sigma = sigma
@@ -118,6 +119,7 @@ class ES:
         self._sigma_min = float(sigma_min)
         self._mirrored = bool(mirrored)
         self._episodes_per_member = int(episodes_per_member)
+        self._decomposed = bool(decomposed)
 
         self._policy_arg = policy
         self._policy_kwargs = dict(policy_kwargs or {})
@@ -149,6 +151,10 @@ class ES:
                 raise ValueError(
                     "episodes_per_member is a device-path option; host agents "
                     "control their own rollout count inside rollout()"
+                )
+            if decomposed:
+                raise ValueError(
+                    "decomposed is a device-path option (models/decomposed.py)"
                 )
             self.backend = "host"
             self._init_host(
@@ -192,9 +198,25 @@ class ES:
             vbn_ref, table_size, eval_chunk, grad_chunk, weight_decay,
             mesh, device,
         )
+        dec_apply = None
+        if self._decomposed:
+            from ..models.decomposed import mlp_decomposed_apply, supports_decomposed
+
+            if not supports_decomposed(self.module):
+                raise ValueError(
+                    "decomposed=True currently supports MLPPolicy without VBN "
+                    "(models/decomposed.py); got "
+                    f"{type(self.module).__name__}"
+                )
+            module = self.module
+
+            def dec_apply(shared, noise, c, obs):
+                return mlp_decomposed_apply(module, shared, noise, c, obs)
+
         self.engine = ESEngine(
             self.env, self._policy_apply, self._spec, self.table,
             self.optimizer, self.config, self.mesh,
+            decomposed_apply=dec_apply,
         )
         self.state = self.engine.init_state(flat, state_key)
         self._post_engine_init()
@@ -246,6 +268,7 @@ class ES:
             sigma_min=self._sigma_min,
             mirrored=self._mirrored,
             episodes_per_member=self._episodes_per_member,
+            decomposed=self._decomposed,
         )
         return flat, state_key
 

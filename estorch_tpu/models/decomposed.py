@@ -1,0 +1,71 @@
+"""Decomposed population forward: one shared matmul + a streamed noise term.
+
+For a linear layer with shared center weights W and per-member noise E_i,
+
+    z_i = x_i @ (W + c_i E_i)  =  x_i @ W  +  c_i (x_i @ E_i),   c_i = σ s_i
+
+— exact (a reordering of the same contractions, not an approximation).  The
+engine's standard path materializes W + c_i E_i per member, so every layer
+is a batched per-member matvec.  Decomposed, the W-term of every layer is a
+SINGLE dense (population, d) @ (d, h) matmul (W enters vmap un-batched), a
+shape the MXU eats whole; only the noise term remains per-member.  On TPU a
+Pallas kernel can further stream E_i from the HBM table tile-by-tile
+(ROADMAP item 1); this module is the pure-JAX form that already exposes the
+big matmul to XLA.
+
+Scope: MLPPolicy-shaped networks (Dense stacks, tanh/… activations,
+optional continuous squash).  VBN layers are not yet supported here — the
+affine is decomposable too, but stats plumbing is deferred (engine rejects
+the combination loudly).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+
+
+def _ordered_dense_names(params: Any) -> list[str]:
+    names = sorted(
+        (n for n in params if n.startswith("dense_")),
+        key=lambda n: int(n.split("_")[1]),
+    )
+    names.append("head")
+    return names
+
+
+def supports_decomposed(module) -> bool:
+    """True for modules whose forward this file can reproduce exactly."""
+    from .policies import MLPPolicy
+
+    # exact type: an MLPPolicy SUBCLASS may override __call__, which this
+    # file would silently fail to reproduce — fail loudly instead
+    return type(module) is MLPPolicy and not module.use_vbn
+
+
+def mlp_decomposed_apply(
+    module, shared_params: Any, noise_params: Any, scale, obs: jnp.ndarray
+) -> jnp.ndarray:
+    """Exact MLPPolicy forward with weights (shared + scale·noise), never
+    materializing the sum.
+
+    ``noise_params`` is the member's ε unraveled into the SAME pytree shape
+    as ``shared_params`` (ops/params.py spec.unravel of the raw table
+    slice); ``scale`` is σ·sign (a traced scalar).
+    """
+    names = _ordered_dense_names(shared_params)
+    x = obs
+    for i, name in enumerate(names):
+        w = shared_params[name]["kernel"]
+        b = shared_params[name]["bias"]
+        nw = noise_params[name]["kernel"]
+        nb = noise_params[name]["bias"]
+        # x @ w is shared across members (un-batched under vmap → one dense
+        # population-wide matmul); x @ nw is the per-member noise term
+        x = (x @ w) + scale * (x @ nw) + b + scale * nb
+        if name != "head":
+            x = module.activation(x)
+    if not module.discrete:
+        x = jnp.tanh(x) * module.action_scale
+    return x

@@ -66,6 +66,10 @@ class EngineConfig:
     episodes_per_member: int = 1  # rollouts averaged per member (device
     # path only): reduces fitness noise AND raises per-step batch (n·e rows
     # through the policy matmuls — better MXU use for small populations)
+    decomposed: bool = False  # z = x@W + c(x@E): the shared-W term of every
+    # layer becomes ONE population-wide dense matmul (W un-batched under
+    # vmap) instead of per-member matvecs against materialized perturbed
+    # weights; needs a decomposed_apply (models/decomposed.py)
 
 
 class ESState(NamedTuple):
@@ -90,6 +94,21 @@ def _gen_keys(state: ESState) -> tuple[jax.Array, jax.Array]:
     return jax.random.fold_in(base, 0), jax.random.fold_in(base, 1)
 
 
+def _bf16_apply(base_apply):
+    """Wrap an (params_pytree, obs) apply to run in bfloat16: every array in
+    the params pytree (weights, noise trees, scale scalars) casts to bf16,
+    FLOATING observations cast too (integer pixel bytes pass through so the
+    policy's own normalization still fires), output returns to float32."""
+
+    def wrapped(p, obs):
+        p16 = jax.tree_util.tree_map(lambda x: jnp.asarray(x, jnp.bfloat16), p)
+        if jnp.issubdtype(obs.dtype, jnp.floating):
+            obs = obs.astype(jnp.bfloat16)
+        return base_apply(p16, obs).astype(jnp.float32)
+
+    return wrapped
+
+
 def _choose_eval_chunk(requested: int, local_members: int) -> int:
     """Largest divisor of ``local_members`` that is ≤ the requested chunk."""
     if requested <= 0 or requested >= local_members:
@@ -112,8 +131,14 @@ class ESEngine:
         optimizer: optax.GradientTransformation,
         config: EngineConfig,
         mesh: Mesh,
+        decomposed_apply=None,
     ):
         self.env = env
+        if config.decomposed and decomposed_apply is None and env is not None:
+            raise ValueError(
+                "EngineConfig.decomposed=True needs a decomposed_apply "
+                "(models/decomposed.py::mlp_decomposed_apply for MLPPolicy)"
+            )
         if config.compute_dtype not in ("float32", "bfloat16"):
             raise ValueError(
                 f"compute_dtype must be float32 or bfloat16, got {config.compute_dtype!r}"
@@ -123,19 +148,7 @@ class ESEngine:
                 f"episodes_per_member must be >= 1, got {config.episodes_per_member}"
             )
         if config.compute_dtype == "bfloat16":
-            base_apply = policy_apply
-
-            def policy_apply(p, obs):  # noqa: F811 — deliberate wrap
-                p16 = jax.tree_util.tree_map(
-                    lambda x: x.astype(jnp.bfloat16), p
-                )
-                # cast only FLOATING observations: integer obs (raw pixel
-                # bytes) must reach the policy unchanged so its own
-                # normalization (e.g. NatureCNN's /255) still fires
-                if jnp.issubdtype(obs.dtype, jnp.floating):
-                    obs = obs.astype(jnp.bfloat16)
-                out = base_apply(p16, obs)
-                return out.astype(jnp.float32)
+            policy_apply = _bf16_apply(policy_apply)
 
         self.policy_apply = policy_apply
         self.spec = spec
@@ -168,6 +181,21 @@ class ESEngine:
         self.bc_dim = int(env.bc_dim)
 
         self._rollout = make_rollout(env, policy_apply, config.horizon)
+
+        self._rollout_decomposed = None
+        if config.decomposed:
+            def packed_apply(packed, obs):
+                shared, noise, c = packed
+                return decomposed_apply(shared, noise, c, obs)
+
+            if config.compute_dtype == "bfloat16":
+                # the packed (shared, noise, c) tuple is one pytree: the
+                # shared wrap casts all of it, INCLUDING the scale c
+                packed_apply = _bf16_apply(packed_apply)
+
+            self._rollout_decomposed = make_rollout(
+                env, packed_apply, config.horizon
+            )
 
         # All inputs/outputs are fully replicated (P()); the population axis
         # only exists INSIDE the program (axis_index-derived shards).
@@ -268,24 +296,37 @@ class ESEngine:
         cfg = self.config
         dim = self.spec.dim
         n_chunks = self.members_local // self.eval_chunk
+        if cfg.decomposed:
+            # shared center tree: unraveled ONCE, enters the member vmap as
+            # an un-batched constant — its matmuls fuse across the population
+            shared_tree = self.spec.unravel(state.params_flat)
 
         def chunk_body(_, xs):
             offs_c, signs_c, keys_c = xs
 
             def member_eval(off, sign, key):
                 eps = self.table.slice(off, dim)
-                theta = state.params_flat + state.sigma * sign * eps
-                params = self.spec.unravel(theta)
+                if cfg.decomposed:
+                    rollout = self._rollout_decomposed
+                    params = (
+                        shared_tree,
+                        self.spec.unravel(eps),
+                        state.sigma * sign,
+                    )
+                else:
+                    rollout = self._rollout
+                    theta = state.params_flat + state.sigma * sign * eps
+                    params = self.spec.unravel(theta)
                 if cfg.episodes_per_member > 1:
                     ep_keys = jax.random.split(key, cfg.episodes_per_member)
-                    res = jax.vmap(self._rollout, in_axes=(None, 0))(params, ep_keys)
+                    res = jax.vmap(rollout, in_axes=(None, 0))(params, ep_keys)
                     # fitness = mean return; BC = first episode's; steps summed
                     return (
                         res.total_reward.mean(),
                         jax.tree_util.tree_map(lambda x: x[0], res.bc),
                         res.steps.sum(),
                     )
-                res = self._rollout(params, key)
+                res = rollout(params, key)
                 return res.total_reward, res.bc, res.steps
 
             f, bc, st = jax.vmap(member_eval)(offs_c, signs_c, keys_c)

@@ -66,6 +66,11 @@ class PooledEngine:
                 "episodes_per_member is a device-path option; the pooled "
                 "path rolls one episode per member env"
             )
+        if config.decomposed:
+            raise ValueError(
+                "decomposed is a device-path option; the pooled path "
+                "materializes per-member thetas for its batched forward"
+            )
         # update-only device engine: shares offsets/psum/optax with the
         # fully-on-device path; its ctor also applies the compute_dtype wrap,
         # which we reuse below instead of wrapping a second time

@@ -1,0 +1,103 @@
+"""Decomposed population forward: z = x@W + c(x@E) must be EXACTLY the
+standard materialized-weights path (it is a reordering, not an
+approximation), across feature combinations."""
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+
+from estorch_tpu import ES, JaxAgent, MLPPolicy, PooledAgent
+from estorch_tpu.envs import CartPole, Pendulum
+
+
+def _pair(decomposed, **over):
+    kw = dict(
+        policy=MLPPolicy,
+        agent=JaxAgent,
+        optimizer=optax.adam,
+        population_size=32,
+        sigma=0.1,
+        seed=0,
+        policy_kwargs={"action_dim": 2, "hidden": (16,)},
+        agent_kwargs={"env": CartPole(), "horizon": 60},
+        optimizer_kwargs={"learning_rate": 2e-2},
+        table_size=1 << 16,
+    )
+    kw.update(over)
+    return ES(decomposed=decomposed, **kw)
+
+
+def _assert_equivalent(a, b, gens=3, exact=True):
+    """``exact`` asserts tight float tolerance (the decomposition reorders
+    IEEE sums, so bitwise equality would be flaky by construction — observed
+    bit-identical today, but a near-tie argmax flip under a last-ulp logit
+    difference is allowed to move one fitness value)."""
+    a.train(gens, verbose=False)
+    b.train(gens, verbose=False)
+    for ra, rb in zip(a.history, b.history):
+        tol = 1e-6 if exact else 5e-2
+        assert ra["reward_mean"] == pytest.approx(rb["reward_mean"], rel=tol, abs=1.0)
+    pa = np.asarray(a.state.params_flat)
+    pb = np.asarray(b.state.params_flat)
+    if exact:
+        np.testing.assert_allclose(pa, pb, rtol=1e-4, atol=1e-5)
+    else:
+        np.testing.assert_allclose(pa, pb, rtol=1e-3, atol=1e-3)
+
+
+class TestDecomposedEquivalence:
+    def test_identical_to_standard_path(self):
+        _assert_equivalent(_pair(False), _pair(True))
+
+    def test_identical_with_unmirrored_and_annealing(self):
+        over = dict(mirrored=False, sigma_decay=0.9, sigma_min=0.02)
+        _assert_equivalent(_pair(False, **over), _pair(True, **over))
+
+    def test_continuous_with_episodes_matches_to_rounding(self):
+        """Continuous rewards accumulate transcendental terms, so reordered
+        matmul rounding shows at ~1e-7 — tolerance, not exactness, here."""
+        over = dict(
+            policy_kwargs={"action_dim": 1, "hidden": (16,), "discrete": False,
+                           "action_scale": 2.0},
+            agent_kwargs={"env": Pendulum(), "horizon": 40},
+            episodes_per_member=2,
+        )
+        _assert_equivalent(_pair(False, **over), _pair(True, **over), exact=False)
+
+    def test_bf16_close_to_standard_bf16(self):
+        over = dict(compute_dtype="bfloat16")
+        _assert_equivalent(_pair(False, **over), _pair(True, **over), exact=False)
+
+
+class TestDecomposedValidation:
+    def test_vbn_rejected(self):
+        with pytest.raises(ValueError, match="decomposed"):
+            _pair(True, policy_kwargs={"action_dim": 2, "hidden": (16,),
+                                       "use_vbn": True})
+
+    def test_host_rejected(self):
+        import torch
+
+        class P(torch.nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.l = torch.nn.Linear(4, 2)
+
+            def forward(self, x):
+                return self.l(x)
+
+        class A:
+            def rollout(self, policy):
+                return 0.0
+
+        with pytest.raises(ValueError, match="device-path"):
+            ES(P, A, __import__("torch").optim.Adam, population_size=8,
+               optimizer_kwargs={"lr": 1e-2}, table_size=1 << 12,
+               decomposed=True)
+
+    def test_pooled_rejected(self):
+        with pytest.raises(ValueError, match="device-path"):
+            _pair(True, agent=PooledAgent,
+                  agent_kwargs={"env_name": "cartpole", "horizon": 30})
