@@ -422,6 +422,22 @@ class ES:
                 jax.block_until_ready(self.state.params_flat)
             dt = time.perf_counter() - t0
 
+            # backend parity: host/pooled raise inside their weighting when
+            # fewer than 2 members survive (utils/fault.py); the fused device
+            # program cannot raise, so it reports n_valid and we fail HERE
+            # rather than let a dead env train on zero-weight updates
+            n_valid = metrics.get("n_valid")
+            if n_valid is not None and int(n_valid) < 2:
+                # roll back: host/pooled raise BEFORE mutating state, so a
+                # caller that catches + checkpoints must not see the
+                # dead-generation state here either
+                self.state = prev_state
+                raise RuntimeError(
+                    f"only {int(n_valid)}/{self.population_size} population "
+                    "members produced valid fitness — cannot form an update; "
+                    "check env/rollout health"
+                )
+
             record = self._base_record(
                 prev_state, fitness, int(metrics["steps"]),
                 float(np.asarray(metrics["grad_norm"])), dt,

@@ -393,5 +393,6 @@ class HostEngine:
             "bc": ev.bc,
             "steps": ev.steps,
             "grad_norm": gnorm,
+            "n_valid": int(np.isfinite(np.asarray(ev.fitness)).sum()),
         }
         return new_state, metrics

@@ -8,7 +8,13 @@ from .noise import (
     sample_pair_offsets,
 )
 from .params import ParamSpec, count_params, make_param_spec
-from .ranks import centered_rank, centered_rank_np, compute_ranks, normalized_score
+from .ranks import (
+    centered_rank,
+    centered_rank_np,
+    centered_rank_safe,
+    compute_ranks,
+    normalized_score,
+)
 from .gradient import es_gradient, fold_mirrored_weights, rank_weighted_noise_sum
 
 __all__ = [
@@ -24,6 +30,7 @@ __all__ = [
     "make_param_spec",
     "centered_rank",
     "centered_rank_np",
+    "centered_rank_safe",
     "compute_ranks",
     "normalized_score",
     "es_gradient",

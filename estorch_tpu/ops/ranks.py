@@ -43,6 +43,41 @@ def centered_rank(x: jax.Array) -> jax.Array:
     return ranks / (n - 1) - 0.5
 
 
+def centered_rank_safe(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Non-finite-tolerant centered ranks — the in-program (jittable) twin of
+    ``utils/fault.py::rank_weights_with_failures``.
+
+    ``jnp.argsort`` sorts NaN LAST, so without a guard a member whose rollout
+    produced NaN reward would receive the TOP centered rank (+0.5) and its
+    noise would dominate a still-finite update — silent corruption.  Here
+    invalid (NaN/±inf) members are zero-weighted, valid members are ranked
+    among themselves (stable, matching the host scheme), and survivors are
+    rescaled by n/n_valid so the engine's static 1/n normalization yields the
+    mean over actual contributors.
+
+    Bit-identical to :func:`centered_rank` when all entries are finite (the
+    fixed-seed goldens pin this).  Returns ``(weights, n_valid)``; when fewer
+    than 2 members are valid the weights are all zero (the host backend
+    raises instead — in-program we cannot, so callers surface ``n_valid``).
+    """
+    n = x.shape[0]
+    valid = jnp.isfinite(x)
+    n_valid = valid.sum().astype(jnp.int32)
+    if n < 2:
+        return jnp.zeros_like(x, dtype=jnp.float32), n_valid
+    # invalid -> +inf sorts after every finite value (stable among themselves,
+    # harmless: they get weight 0); valid members' positions in the sorted
+    # order are then exactly their ranks within the valid subset
+    order = jnp.argsort(jnp.where(valid, x, jnp.inf))
+    pos = jnp.zeros((n,), dtype=jnp.int32).at[order].set(jnp.arange(n, dtype=jnp.int32))
+    denom = jnp.maximum(n_valid - 1, 1).astype(jnp.float32)
+    sub = pos.astype(jnp.float32) / denom - 0.5
+    scale = jnp.float32(n) / jnp.maximum(n_valid, 1).astype(jnp.float32)
+    weights = jnp.where(valid, sub * scale, 0.0)
+    weights = jnp.where(n_valid >= 2, weights, jnp.zeros_like(weights))
+    return weights.astype(jnp.float32), n_valid
+
+
 def centered_rank_np(x) -> np.ndarray:
     """NumPy twin of :func:`centered_rank` for host-side weighting (novelty
     family): must match the device version bit-for-bit on tie-free input and

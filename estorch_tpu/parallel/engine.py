@@ -40,7 +40,7 @@ from ..envs.rollout import make_rollout
 from ..ops.gradient import es_gradient, rank_weighted_noise_sum
 from ..ops.noise import NoiseTable, member_offsets, pair_signs, sample_pair_offsets
 from ..ops.params import ParamSpec
-from ..ops.ranks import centered_rank
+from ..ops.ranks import centered_rank_safe
 from .mesh import POP_AXIS, pairs_per_device
 
 
@@ -402,13 +402,17 @@ class ESEngine:
         red_offs, member_offs, signs, member_keys = self._local_offsets_signs_keys(state)
         f_l, bc_l, st_l = self._eval_local(state, member_offs, signs, member_keys)
         fitness, bc, steps = self._gather_global(f_l, bc_l, st_l)
-        weights = centered_rank(fitness)
+        # NaN-safe ranking: a failed rollout (NaN/inf fitness) is dropped and
+        # survivors renormalized — same semantics as the host backend's
+        # utils/fault.py::rank_weights_with_failures, but inside the program
+        weights, n_valid = centered_rank_safe(fitness)
         new_state, gnorm = self._update_from_weights(state, weights, red_offs)
         metrics = {
             "fitness": fitness,
             "bc": bc,
             "steps": steps,
             "grad_norm": gnorm,
+            "n_valid": n_valid,
         }
         return new_state, metrics
 

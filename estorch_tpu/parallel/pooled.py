@@ -26,7 +26,7 @@ import jax.numpy as jnp
 
 from ..envs.gym_vec_pool import make_pool
 from ..ops.noise import member_offsets, pair_signs
-from ..ops.ranks import centered_rank_np
+from ..utils.fault import rank_weights_with_failures
 from .engine import ESEngine, ESState
 
 
@@ -268,12 +268,16 @@ class PooledEngine:
 
     def generation_step(self, state: ESState):
         ev = self.evaluate(state)
-        weights = centered_rank_np(ev.fitness)
+        fit = np.asarray(ev.fitness)
+        # NaN-safe: a crashed/diverged rollout must not win the top rank
+        # (np.argsort sorts NaN last) — drop it and renormalize survivors
+        weights = rank_weights_with_failures(fit)
         new_state, gnorm = self.apply_weights(state, weights)
         metrics = {
             "fitness": ev.fitness,
             "bc": ev.bc,
             "steps": ev.steps,
             "grad_norm": gnorm,
+            "n_valid": int(np.isfinite(fit).sum()),
         }
         return new_state, metrics

@@ -8,6 +8,7 @@ import pytest
 from estorch_tpu.ops import (
     NoiseTable,
     centered_rank,
+    centered_rank_safe,
     compute_ranks,
     es_gradient,
     fold_mirrored_weights,
@@ -47,6 +48,56 @@ class TestRanks:
 
     def test_degenerate_sizes(self):
         assert centered_rank(jnp.array([5.0])).tolist() == [0.0]
+
+
+class TestCenteredRankSafe:
+    """Device twin of utils/fault.py::rank_weights_with_failures."""
+
+    def test_all_finite_bit_identical_to_centered_rank(self):
+        x = jax.random.normal(jax.random.key(2), (129,))
+        w, n_valid = centered_rank_safe(x)
+        np.testing.assert_array_equal(np.asarray(w), np.asarray(centered_rank(x)))
+        assert int(n_valid) == 129
+
+    def test_verdict_example_nan_not_promoted(self):
+        """The exact round-1 bug: centered_rank([1, nan, 3, 2]) gave the NaN
+        member weight +0.5 (argsort sorts NaN last)."""
+        w, n_valid = centered_rank_safe(jnp.array([1.0, jnp.nan, 3.0, 2.0]))
+        assert int(n_valid) == 3
+        assert float(w[1]) == 0.0
+        # survivors ranked among themselves, renormalized by n/n_valid = 4/3
+        expected = np.array([-0.5, 0.0, 0.5, 0.0], np.float32) * (4.0 / 3.0)
+        np.testing.assert_allclose(
+            np.asarray(w), [expected[0], 0.0, expected[2], 0.0], atol=1e-6
+        )
+
+    def test_matches_host_oracle_random_failures(self):
+        from estorch_tpu.utils.fault import rank_weights_with_failures
+
+        rng = np.random.RandomState(11)
+        for trial in range(5):
+            x = rng.randn(64).astype(np.float32)
+            bad = rng.rand(64) < 0.2
+            x[bad] = [np.nan, np.inf, -np.inf][trial % 3]
+            if np.isfinite(x).sum() < 2:
+                continue
+            w, n_valid = centered_rank_safe(jnp.asarray(x))
+            np.testing.assert_allclose(
+                np.asarray(w), rank_weights_with_failures(x), atol=1e-6,
+                err_msg=f"trial {trial}",
+            )
+            assert int(n_valid) == int(np.isfinite(x).sum())
+
+    def test_under_jit(self):
+        x = jnp.array([np.nan, 2.0, 1.0, np.nan])
+        w, n_valid = jax.jit(centered_rank_safe)(x)
+        np.testing.assert_allclose(np.asarray(w), [0.0, 1.0, -1.0, 0.0], atol=1e-6)
+        assert int(n_valid) == 2
+
+    def test_fewer_than_two_valid_zeroes_update(self):
+        w, n_valid = centered_rank_safe(jnp.array([jnp.nan, 5.0, jnp.nan]))
+        assert int(n_valid) == 1
+        np.testing.assert_array_equal(np.asarray(w), np.zeros(3, np.float32))
 
 
 class TestNoiseTable:
