@@ -38,6 +38,17 @@ class GymVecPool:
 
         self.env_name = f"gym:{env_id}"
         self.n_envs = int(n_envs)
+        if n_threads:
+            # interface parity with NativeEnvPool only — gym.vector has no
+            # thread knob (sync = in-process, async = one fork per env)
+            import warnings
+
+            warnings.warn(
+                f"n_threads={n_threads} has no effect on gym: envs (it tunes "
+                "the C++ native pool); gym.vector parallelism is controlled "
+                "by `asynchronous` instead",
+                stacklevel=3,
+            )
         # async forks one process per env: only worth it with >1 core and a
         # sane worker-to-core ratio; n_envs==1 is always sync (pure overhead)
         if asynchronous is None:

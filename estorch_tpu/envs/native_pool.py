@@ -55,14 +55,24 @@ def _stale(lib_path: str) -> bool:
 
 def _load_library() -> Optional[ctypes.CDLL]:
     if not os.path.exists(_LIB_PATH) or _stale(_LIB_PATH):
+        # serialize concurrent builders (fork workers, parallel test runs):
+        # without the lock two `make -B` runs race and one process can load
+        # a partially-written .so; under the lock the loser re-checks and
+        # finds the winner's fresh library
+        lock_path = os.path.join(os.path.abspath(_NATIVE_DIR), ".build.lock")
         try:
-            subprocess.run(
-                ["make", "-C", os.path.abspath(_NATIVE_DIR), "-B"],
-                check=True,
-                capture_output=True,
-                timeout=120,
-            )
-        except (subprocess.SubprocessError, FileNotFoundError):
+            import fcntl  # POSIX-only; ImportError lands in the fallback path
+
+            with open(lock_path, "w") as lk:
+                fcntl.flock(lk, fcntl.LOCK_EX)
+                if not os.path.exists(_LIB_PATH) or _stale(_LIB_PATH):
+                    subprocess.run(
+                        ["make", "-C", os.path.abspath(_NATIVE_DIR), "-B"],
+                        check=True,
+                        capture_output=True,
+                        timeout=120,
+                    )
+        except (subprocess.SubprocessError, ImportError, OSError):
             if not os.path.exists(_LIB_PATH):
                 return None
             # stale-but-present: fall through and load it anyway

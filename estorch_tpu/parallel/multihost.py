@@ -61,10 +61,22 @@ def initialize(
             process_id=process_id,
         )
         return True
-    except Exception:
+    except Exception as e:
         if explicit:
             raise
-        return False  # not a cluster: single-process run
+        # not a cluster → single-process run; but say WHY, so an operator on
+        # a real pod can tell "not a cluster" from "cluster init failed"
+        # (silent fallback would mean N duplicate single-host runs)
+        import warnings
+
+        warnings.warn(
+            f"jax.distributed.initialize() (argless) failed: {e!r} — "
+            "continuing as a single-process run. On a pod, this means the "
+            "cluster env was NOT picked up; each host would train "
+            "independently.",
+            stacklevel=2,
+        )
+        return False
 
 
 def global_population_mesh():

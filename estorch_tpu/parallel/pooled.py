@@ -90,7 +90,9 @@ class PooledEngine:
             self.pool = make_pool(
                 env_name, config.population_size, n_threads=n_threads, seed=seed
             )
-        self.center_pool = make_pool(env_name, 1, n_threads=1, seed=seed + 1)
+        # n_threads=0 (auto): a 1-env pool gains nothing from threads, and a
+        # nonzero value would trip GymVecPool's unused-n_threads warning
+        self.center_pool = make_pool(env_name, 1, n_threads=0, seed=seed + 1)
         self.bc_dim = self.pool.obs_dim  # BC = final observation
         discrete = self.pool.discrete
         obs_shape = self.pool.obs_shape  # policy-facing shape (pixels etc.)

@@ -112,6 +112,9 @@ def save_checkpoint(es, path: str) -> None:
     ckptr.wait_until_finished()
     with open(os.path.join(path, "meta.json"), "w") as f:
         json.dump(_meta_dict(es), f, indent=2)
+    # per-generation records survive resume (meta's history_len cross-checks)
+    with open(os.path.join(path, "history.json"), "w") as f:
+        json.dump(es.history, f)
     if es.backend == "host":
         import torch
 
@@ -156,6 +159,21 @@ def restore_checkpoint(es, path: str) -> None:
     br = float(tree["best_reward"])
     es.best_reward = -np.inf if br <= -1e29 else br
     es._best_flat = _np(tree["best_flat"]) if int(tree["has_best"]) else None
+
+    hist_path = os.path.join(path, "history.json")
+    if os.path.exists(hist_path):  # absent in pre-round-2 checkpoints
+        with open(hist_path) as f:
+            es.history = json.load(f)
+        if len(es.history) != meta.get("history_len", len(es.history)):
+            import warnings
+
+            warnings.warn(
+                f"checkpoint history.json holds {len(es.history)} records "
+                f"but meta.json recorded {meta['history_len']} — the "
+                "checkpoint write was likely interrupted; records may be "
+                "stale/partial (numeric state is unaffected)",
+                stacklevel=2,
+            )
 
     host_opts = None
     if es.backend == "host":

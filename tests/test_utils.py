@@ -62,6 +62,20 @@ class TestCheckpointDevice:
         )
         assert int(b.state.generation) == 4
 
+    def test_history_survives_resume(self, tmp_path):
+        """Per-generation records must come back (ADVICE round 1): a resumed
+        run's logs continue from the interruption point, not from scratch."""
+        a = _device_es()
+        a.train(3, verbose=False)
+        save_checkpoint(a, str(tmp_path / "ck"))
+        b = _device_es()
+        restore_checkpoint(b, str(tmp_path / "ck"))
+        assert len(b.history) == 3
+        assert [r["generation"] for r in b.history] == [0, 1, 2]
+        assert b.history[2]["reward_max"] == a.history[2]["reward_max"]
+        b.train(1, verbose=False)
+        assert [r["generation"] for r in b.history] == [0, 1, 2, 3]
+
     def test_best_snapshot_restored(self, tmp_path):
         a = _device_es()
         a.train(3, verbose=False)
@@ -106,8 +120,9 @@ class TestCheckpointDevice:
         np.testing.assert_array_equal(
             np.asarray(ref.state.params_flat), np.asarray(b.state.params_flat)
         )
+        # history is restored too, so b's records 3: are the post-resume ones
         assert [r["meta_index"] for r in ref.history[3:]] == [
-            r["meta_index"] for r in b.history
+            r["meta_index"] for r in b.history[3:]
         ]
 
     def test_backend_mismatch_rejected(self, tmp_path):
