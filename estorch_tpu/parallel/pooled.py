@@ -97,6 +97,13 @@ class PooledEngine:
         discrete = self.pool.discrete
         obs_shape = self.pool.obs_shape  # policy-facing shape (pixels etc.)
 
+        # core.policy_apply is the obs/output shim only (engine.py): the
+        # bf16 param cast is the caller's job.  Perturbation stays f32; the
+        # materialized theta matrix casts ONCE per generation — unravel
+        # preserves dtype for single-dtype trees, so every per-step
+        # inference below reads bf16 weights with no further casts.
+        bf16 = config.compute_dtype == "bfloat16"
+
         def materialize(params_flat, sigma, pair_offs):
             """(population, dim) perturbed parameter matrix from the table."""
             offs = member_offsets(pair_offs)
@@ -104,9 +111,13 @@ class PooledEngine:
             def one(off, sign):
                 eps = self.core.table.slice(off, spec.dim)
                 return params_flat + sigma * sign * eps
-            return jax.vmap(one)(offs, signs)
+            thetas = jax.vmap(one)(offs, signs)
+            return thetas.astype(jnp.bfloat16) if bf16 else thetas
 
         self._materialize = jax.jit(materialize)
+
+        def _params(flat):
+            return spec.unravel(flat.astype(jnp.bfloat16) if bf16 else flat)
 
         def batch_actions(thetas, obs):
             """One env step's policy forward for the whole population."""
@@ -121,7 +132,7 @@ class PooledEngine:
         # batch shape, so the same callable serves full and half populations
 
         def center_action(params_flat, obs):
-            out = policy_apply(spec.unravel(params_flat), obs.reshape(obs_shape))
+            out = policy_apply(_params(params_flat), obs.reshape(obs_shape))
             if discrete:
                 return jnp.argmax(out, axis=-1).astype(jnp.float32)
             return out.reshape(-1)

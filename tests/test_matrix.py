@@ -100,3 +100,21 @@ def test_algo_backend_combination(backend, algo):
     if backend.startswith("pooled"):
         es.engine.pool.close()
         es.engine.center_pool.close()
+
+
+@pytest.mark.parametrize("backend", ["device", "pooled-native"])
+def test_bf16_compute_dtype_backends(backend):
+    """bf16 responsibility is split between engine.py (obs/output shim) and
+    the param-cast at each builder (engine._member_cast / pooled
+    materialize) — lock in that both halves stay wired on both backends."""
+    kw = dict(BACKENDS[backend])
+    es = ES(population_size=16, sigma=0.05, seed=0, table_size=1 << 14,
+            compute_dtype="bfloat16", **kw)
+    es.train(2, verbose=False)
+    assert len(es.history) == 2
+    for rec in es.history:
+        assert np.isfinite(rec["reward_mean"])
+    assert str(es.state.params_flat.dtype) == "float32"  # master stays f32
+    if backend.startswith("pooled"):
+        es.engine.pool.close()
+        es.engine.center_pool.close()
