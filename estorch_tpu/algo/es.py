@@ -137,16 +137,6 @@ class ES:
                     "compute_dtype is a device/pooled-path option; the host "
                     "backend runs torch policies in their native dtype"
                 )
-            if sigma_decay != 1.0:
-                raise ValueError(
-                    "sigma_decay is a device/pooled-path option; it is not "
-                    "implemented on the host backend (pass sigma_decay=1.0)"
-                )
-            if not mirrored:
-                raise ValueError(
-                    "mirrored=False is a device-path option; the host backend "
-                    "always uses antithetic pairs"
-                )
             if episodes_per_member != 1:
                 raise ValueError(
                     "episodes_per_member is a device-path option; host agents "
@@ -389,6 +379,9 @@ class ES:
             prototype_agent=self.agent,  # dispatch probe doubles as worker 0
             weight_decay=weight_decay,
             worker_mode=worker_mode,
+            sigma_decay=self._sigma_decay,
+            sigma_min=self._sigma_min,
+            mirrored=self._mirrored,
         )
         self.state = self.engine.init_state()
 

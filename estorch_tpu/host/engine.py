@@ -42,6 +42,8 @@ class HostState(NamedTuple):
     opt_state: Any  # opaque: the torch optimizer mutates in place; None otherwise
     key: int
     generation: int
+    sigma: float | None = None  # current perturbation scale (annealable,
+    # per center); None = pre-sigma-field state, engine falls back to init σ
 
 
 class HostEvalResult(NamedTuple):
@@ -54,6 +56,16 @@ class HostRolloutResult(NamedTuple):
     total_reward: float
     bc: np.ndarray
     steps: int
+
+
+def member_sign_offset(offs: np.ndarray, i: int, mirrored: bool) -> tuple[float, int]:
+    """Member i's perturbation sign and noise-table offset.  THE single
+    definition of the host noise indexing — thread workers (HostEngine),
+    fork workers (procpool), and member_params reconstruction must all
+    agree or fitness attribution silently corrupts."""
+    if mirrored:
+        return (1.0 if i % 2 == 0 else -1.0), int(offs[i // 2])
+    return 1.0, int(offs[i])
 
 
 class HostEngine:
@@ -81,17 +93,23 @@ class HostEngine:
         weight_decay: float = 0.0,
         worker_mode: str = "thread",
         proc_timeout_s: float = 600.0,
+        sigma_decay: float = 1.0,
+        sigma_min: float = 0.0,
+        mirrored: bool = True,
     ):
         import torch
 
         self.torch = torch
-        if population_size % 2 != 0:
+        self.mirrored = bool(mirrored)
+        if mirrored and population_size % 2 != 0:
             raise ValueError(
                 f"population_size must be even (mirrored sampling), got {population_size}"
             )
         self.population_size = population_size
         self.n_pairs = population_size // 2
         self.sigma = float(sigma)
+        self.sigma_decay = float(sigma_decay)
+        self.sigma_min = float(sigma_min)
         self.weight_decay = float(weight_decay)
         self.seed = int(seed)
         self.device = device
@@ -226,6 +244,7 @@ class HostEngine:
             opt_state=None,
             key=self.seed if key is None else int(key),
             generation=0,
+            sigma=self.sigma,
         )
 
     def compile(self, state: HostState) -> float:
@@ -236,24 +255,33 @@ class HostEngine:
     # ------------------------------------------------------------ noise math
 
     def _pair_offsets(self, state: HostState) -> np.ndarray:
-        """Per-generation antithetic-pair offsets; deterministic in (key, gen),
-        mirroring the device engine's fold_in derivation."""
+        """Per-generation noise offsets; deterministic in (key, gen),
+        mirroring the device engine's fold_in derivation.  One offset per
+        antithetic PAIR when mirrored, one per MEMBER otherwise (the
+        reference's plain per-member sampling)."""
+        n = self.n_pairs if self.mirrored else self.population_size
         rng = np.random.default_rng(
             np.random.SeedSequence(entropy=state.key, spawn_key=(state.generation,))
         )
         return rng.integers(
-            0, self.table_size - self.dim + 1, size=self.n_pairs, dtype=np.int64
+            0, self.table_size - self.dim + 1, size=n, dtype=np.int64
         )
 
     def _eps(self, offset: int) -> np.ndarray:
         return self.table[offset : offset + self.dim]
 
+    def _member_sign_off(self, offs: np.ndarray, i: int) -> tuple[float, int]:
+        return member_sign_offset(offs, i, self.mirrored)
+
     def member_theta(self, state: HostState, member_index: int) -> np.ndarray:
         offs = self._pair_offsets(state)
-        sign = 1.0 if member_index % 2 == 0 else -1.0
-        return state.params_flat + self.sigma * sign * self._eps(
-            int(offs[member_index // 2])
-        )
+        sign, off = self._member_sign_off(offs, member_index)
+        return state.params_flat + self._state_sigma(state) * sign * self._eps(off)
+
+    def _state_sigma(self, state: HostState) -> float:
+        # pre-sigma-field states (e.g. hand-built in tests) fall back to init
+        # σ; None (not 0.0) is the sentinel so a fully-decayed σ==0 is honored
+        return self.sigma if state.sigma is None else float(state.sigma)
 
     # alias matching the device engine's name
     def member_params(self, state: HostState, member_index: int) -> np.ndarray:
@@ -281,9 +309,10 @@ class HostEngine:
                 self.policy_factory, self.agent_factory, self.n_proc,
                 self.population_size, self.dim, self.table,
                 master_state=self.master.state_dict(),
+                mirrored=self.mirrored,
             )
         fitness, bc, steps = self._proc_pool.evaluate(
-            state.params_flat, self.sigma, self._pair_offsets(state),
+            state.params_flat, self._state_sigma(state), self._pair_offsets(state),
             timeout_s=self.proc_timeout_s,
         )
         return HostEvalResult(fitness=fitness, bc=bc, steps=int(steps))
@@ -292,13 +321,14 @@ class HostEngine:
         if self.worker_mode == "process":
             return self._proc_evaluate(state)
         offs = self._pair_offsets(state)
+        sigma = self._state_sigma(state)
         results: list[HostRolloutResult | None] = [None] * self.population_size
 
         def run_slice(w: int):
             policy, agent = self._workers[w]
             for i in range(w, self.population_size, self.n_proc):
-                sign = 1.0 if i % 2 == 0 else -1.0
-                theta = state.params_flat + self.sigma * sign * self._eps(int(offs[i // 2]))
+                sign, off = self._member_sign_off(offs, i)
+                theta = state.params_flat + sigma * sign * self._eps(off)
                 self._load(policy, theta)
                 try:
                     results[i] = self._call_rollout(agent, policy)
@@ -347,11 +377,16 @@ class HostEngine:
 
         w = np.asarray(weights, dtype=np.float32)
         offs = self._pair_offsets(state)
-        pair_w = w[0::2] - w[1::2]  # fold_mirrored_weights, numpy edition
+        sigma = self._state_sigma(state)
         grad_ascent = np.zeros(self.dim, dtype=np.float32)
-        for k, o in enumerate(offs):
-            grad_ascent += pair_w[k] * self._eps(int(o))
-        grad_ascent /= self.population_size * self.sigma
+        if self.mirrored:
+            pair_w = w[0::2] - w[1::2]  # fold_mirrored_weights, numpy edition
+            for k, o in enumerate(offs):
+                grad_ascent += pair_w[k] * self._eps(int(o))
+        else:
+            for i, o in enumerate(offs):
+                grad_ascent += w[i] * self._eps(int(o))
+        grad_ascent /= self.population_size * sigma
         if self.weight_decay > 0.0:
             # same L2 pull as the device engine's _update_from_weights
             grad_ascent = grad_ascent - self.weight_decay * state.params_flat
@@ -374,11 +409,16 @@ class HostEngine:
             i += n
         self.optimizer.step()
 
+        new_sigma = sigma
+        if self.sigma_decay != 1.0:
+            # same multiplicative anneal + floor as the device engine
+            new_sigma = max(sigma * self.sigma_decay, self.sigma_min)
         new_state = HostState(
             params_flat=self._flat(),
             opt_state=copy.deepcopy(self.optimizer.state_dict()),
             key=state.key,
             generation=state.generation + 1,
+            sigma=new_sigma,
         )
         return new_state, float(np.linalg.norm(grad_ascent))
 

@@ -36,6 +36,7 @@ def _worker_main(
     dim: int,
     table,  # numpy array, shared via fork COW
     master_state,  # master policy state_dict (fork-inherited) — syncs BUFFERS
+    mirrored: bool = True,
 ):
     """Worker loop: build policy/agent once, evaluate member slices forever."""
     import torch
@@ -65,9 +66,10 @@ def _worker_main(
         fitness = np.full(len(indices), np.nan, np.float32)
         bcs: list[np.ndarray] = []
         steps = 0
+        from .engine import member_sign_offset
+
         for j, i in enumerate(indices):
-            sign = 1.0 if i % 2 == 0 else -1.0
-            off = int(offsets[i // 2])
+            sign, off = member_sign_offset(offsets, i, mirrored)
             theta = params_flat + sigma * sign * table[off : off + dim]
             load(theta)
             try:
@@ -98,6 +100,7 @@ class ProcessPool:
         dim: int,
         table: np.ndarray,
         master_state=None,
+        mirrored: bool = True,
     ):
         if os.name != "posix":
             raise RuntimeError("process workers need fork (posix)")
@@ -114,7 +117,7 @@ class ProcessPool:
             p = ctx.Process(
                 target=_worker_main,
                 args=(child, policy_factory, agent_factory, w, self.n_proc,
-                      population_size, dim, table, master_state),
+                      population_size, dim, table, master_state, mirrored),
                 daemon=True,
             )
             p.start()

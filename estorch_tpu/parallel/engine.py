@@ -268,13 +268,17 @@ class ESEngine:
         )
 
     def all_pair_offsets(self, state: ESState) -> jax.Array:
-        """The full (population/2,) offset vector for this generation — the
-        same derivation every device performs inside the update program, so
-        external evaluators (pooled path) perturb with identical noise."""
+        """The full per-PAIR (mirrored) or per-MEMBER (unmirrored) offset
+        vector for this generation — the same derivation every device
+        performs inside the update program, so external evaluators (pooled
+        path) perturb with identical noise."""
         okey, _ = _gen_keys(state)
-        return sample_pair_offsets(
-            okey, self.config.population_size // 2, self.table.size, self.spec.dim
+        n = (
+            self.config.population_size // 2
+            if self.config.mirrored
+            else self.config.population_size
         )
+        return sample_pair_offsets(okey, n, self.table.size, self.spec.dim)
 
     def _member_cast(self, tree):
         """bf16 path: cast a member's param tree once, where it is built."""

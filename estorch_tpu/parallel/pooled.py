@@ -56,11 +56,6 @@ class PooledEngine:
         self.env_name = env_name
         self.spec = spec
         self.config = config
-        if not config.mirrored:
-            raise ValueError(
-                "the pooled path currently requires mirrored sampling "
-                "(its perturbation materialization is pair-structured)"
-            )
         if config.episodes_per_member != 1:
             raise ValueError(
                 "episodes_per_member is a device-path option; the pooled "
@@ -104,10 +99,16 @@ class PooledEngine:
         # inference below reads bf16 weights with no further casts.
         bf16 = config.compute_dtype == "bfloat16"
 
-        def materialize(params_flat, sigma, pair_offs):
-            """(population, dim) perturbed parameter matrix from the table."""
-            offs = member_offsets(pair_offs)
-            signs = pair_signs(config.population_size)
+        def materialize(params_flat, sigma, all_offs):
+            """(population, dim) perturbed parameter matrix from the table.
+            ``all_offs`` is per-pair (mirrored) or per-member (unmirrored),
+            matching core.all_pair_offsets."""
+            if config.mirrored:
+                offs = member_offsets(all_offs)
+                signs = pair_signs(config.population_size)
+            else:
+                offs = all_offs
+                signs = jnp.ones((config.population_size,), jnp.float32)
             def one(off, sign):
                 eps = self.core.table.slice(off, spec.dim)
                 return params_flat + sigma * sign * eps

@@ -35,12 +35,12 @@ def _pack_state(es, st) -> dict:
         "params_flat": _np(st.params_flat),
         "generation": int(st.generation),
     }
+    d["sigma"] = float(st.sigma)
     if es.backend == "host":
         d["key"] = int(st.key)
     else:
         d["key"] = _np(st.key)
         d["opt_state"] = _to_numpy_tree(st.opt_state)
-        d["sigma"] = float(st.sigma)
     return d
 
 
@@ -73,7 +73,8 @@ def _state_tree(es) -> dict:
     return tree
 
 
-CHECKPOINT_FORMAT_VERSION = 2  # v2: device states carry annealable sigma
+CHECKPOINT_FORMAT_VERSION = 3  # v3: HOST states carry annealable sigma too
+# (v2 added it to device states only)
 
 
 def _meta_dict(es) -> dict:
@@ -137,11 +138,15 @@ def restore_checkpoint(es, path: str) -> None:
     with open(os.path.join(path, "meta.json")) as f:
         meta = json.load(f)
     version = meta.get("format_version", 0)
-    if version != CHECKPOINT_FORMAT_VERSION:
+    # v3 only added sigma to HOST states; a v2 DEVICE/POOLED checkpoint's
+    # payload is byte-identical to v3 and remains loadable
+    v2_compatible = version == 2 and meta.get("backend") != "host"
+    if version != CHECKPOINT_FORMAT_VERSION and not v2_compatible:
         raise ValueError(
             f"checkpoint format v{version} != supported "
-            f"v{CHECKPOINT_FORMAT_VERSION} (v1 device states lack the "
-            "annealable sigma field); re-save from the run that wrote it"
+            f"v{CHECKPOINT_FORMAT_VERSION} (older states lack the annealable "
+            "sigma field — v2 device-only, v3 all backends); re-save from "
+            "the run that wrote it"
         )
     if meta["backend"] != es.backend:
         raise ValueError(
@@ -220,6 +225,7 @@ def _unpack_state(es, packed: dict, host_opt=None):
             opt_state=host_opt,
             key=int(packed["key"]),
             generation=int(packed["generation"]),
+            sigma=float(packed["sigma"]),
         )
     import jax.numpy as jnp
 

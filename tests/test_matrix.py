@@ -102,6 +102,39 @@ def test_algo_backend_combination(backend, algo):
         es.engine.center_pool.close()
 
 
+@pytest.mark.parametrize("backend", sorted(BACKENDS))
+def test_unmirrored_backends(backend):
+    """mirrored=False (the reference's plain per-member sampling) must run
+    on every backend — round-1 VERDICT next-round #7."""
+    kw = dict(BACKENDS[backend])
+    es = ES(population_size=16, sigma=0.05, seed=0, table_size=1 << 14,
+            mirrored=False, **kw)
+    es.train(2, verbose=False)
+    assert len(es.history) == 2
+    for rec in es.history:
+        assert np.isfinite(rec["reward_mean"])
+        assert np.isfinite(rec["grad_norm"])
+    if backend.startswith("pooled"):
+        es.engine.pool.close()
+        es.engine.center_pool.close()
+
+
+@pytest.mark.parametrize("backend", sorted(BACKENDS))
+def test_sigma_decay_backends(backend):
+    """sigma_decay anneals identically on every backend."""
+    kw = dict(BACKENDS[backend])
+    es = ES(population_size=16, sigma=0.05, seed=0, table_size=1 << 14,
+            sigma_decay=0.5, sigma_min=0.02, **kw)
+    es.train(2, verbose=False)
+    assert es.history[0]["sigma"] == pytest.approx(0.05)
+    assert es.history[1]["sigma"] == pytest.approx(0.025)
+    sig = float(np.asarray(es.state.sigma))
+    assert sig == pytest.approx(0.02)  # floored
+    if backend.startswith("pooled"):
+        es.engine.pool.close()
+        es.engine.center_pool.close()
+
+
 @pytest.mark.parametrize("backend", ["device", "pooled-native"])
 def test_bf16_compute_dtype_backends(backend):
     """bf16 responsibility is split between engine.py (obs/output shim) and
