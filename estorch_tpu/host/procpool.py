@@ -55,7 +55,8 @@ def _worker_main(
                 policy.parameters(),
             )
 
-    from .engine import HostEngine  # reuse the duck-typed rollout parsing
+    # reuse the duck-typed rollout parsing + the single noise-indexing rule
+    from .engine import HostEngine, member_sign_offset
 
     while True:
         msg = conn.recv()
@@ -66,8 +67,6 @@ def _worker_main(
         fitness = np.full(len(indices), np.nan, np.float32)
         bcs: list[np.ndarray] = []
         steps = 0
-        from .engine import member_sign_offset
-
         for j, i in enumerate(indices):
             sign, off = member_sign_offset(offsets, i, mirrored)
             theta = params_flat + sigma * sign * table[off : off + dim]

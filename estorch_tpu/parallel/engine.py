@@ -62,7 +62,7 @@ class EngineConfig:
     sigma_min: float = 0.0  # σ floor when annealing
     mirrored: bool = True  # antithetic pairs (variance reduction — kept on
     # by default everywhere, incl. the bundled configs). Set False for the
-    # reference's plain per-member sampling (device path only).
+    # reference's plain per-member sampling (supported on all backends).
     episodes_per_member: int = 1  # rollouts averaged per member (device
     # path only): reduces fitness noise AND raises per-step batch (n·e rows
     # through the policy matmuls — better MXU use for small populations)

@@ -35,7 +35,9 @@ def _pack_state(es, st) -> dict:
         "params_flat": _np(st.params_flat),
         "generation": int(st.generation),
     }
-    d["sigma"] = float(st.sigma)
+    # host states may carry the None sentinel (pre-sigma-field, engine falls
+    # back to its init σ) — persist that fallback value, not a crash
+    d["sigma"] = float(es.engine.sigma if st.sigma is None else st.sigma)
     if es.backend == "host":
         d["key"] = int(st.key)
     else:
