@@ -106,6 +106,8 @@ class ES:
         episodes_per_member: int = 1,
         worker_mode: str = "thread",
         decomposed: bool = False,
+        noise_kernel: bool = False,
+        streamed: bool = False,
     ):
         self.population_size = population_size
         self.sigma = sigma
@@ -120,6 +122,8 @@ class ES:
         self._mirrored = bool(mirrored)
         self._episodes_per_member = int(episodes_per_member)
         self._decomposed = bool(decomposed)
+        self._noise_kernel = bool(noise_kernel)
+        self._streamed = bool(streamed)
 
         self._policy_arg = policy
         self._policy_kwargs = dict(policy_kwargs or {})
@@ -145,6 +149,15 @@ class ES:
             if decomposed:
                 raise ValueError(
                     "decomposed is a device-path option (models/decomposed.py)"
+                )
+            if noise_kernel:
+                raise ValueError(
+                    "noise_kernel is a device/pooled-path option "
+                    "(ops/pallas_noise.py streams from the device table)"
+                )
+            if streamed:
+                raise ValueError(
+                    "streamed is a device-path option (ops/pallas_noise.py)"
                 )
             self.backend = "host"
             self._init_host(
@@ -203,10 +216,30 @@ class ES:
             def dec_apply(shared, noise, c, obs):
                 return mlp_decomposed_apply(module, shared, noise, c, obs)
 
+        str_apply = None
+        if self._streamed:
+            from ..models.decomposed import supports_decomposed
+            from ..ops.pallas_noise import flat_layer_offsets, mlp_streamed_apply
+
+            if not supports_decomposed(self.module):
+                raise ValueError(
+                    "streamed=True currently supports MLPPolicy without VBN "
+                    f"(ops/pallas_noise.py); got {type(self.module).__name__}"
+                )
+            layer_offs = flat_layer_offsets(self._spec.unravel(flat))
+            module = self.module
+            table_data = self.table.data
+
+            def str_apply(shared, offs, c, obs):
+                return mlp_streamed_apply(
+                    module, shared, table_data, offs, c, obs, layer_offs
+                )
+
         self.engine = ESEngine(
             self.env, self._policy_apply, self._spec, self.table,
             self.optimizer, self.config, self.mesh,
             decomposed_apply=dec_apply,
+            streamed_apply=str_apply,
         )
         self.state = self.engine.init_state(flat, state_key)
         self._post_engine_init()
@@ -259,6 +292,8 @@ class ES:
             mirrored=self._mirrored,
             episodes_per_member=self._episodes_per_member,
             decomposed=self._decomposed,
+            noise_kernel=self._noise_kernel,
+            streamed=self._streamed,
         )
         return flat, state_key
 

@@ -94,3 +94,60 @@ def make_population_rollout(
     """
     single = make_rollout(env, policy_apply, horizon)
     return jax.vmap(single, in_axes=(0, 0))
+
+
+def make_batched_rollout(
+    env: Any,
+    horizon: int,
+) -> Callable[[Callable[[jax.Array], jax.Array], jax.Array], RolloutResult]:
+    """Population-batched episode scan: ONE policy call per step for ALL
+    members, instead of vmapping a per-member rollout.
+
+    ``rollout(batched_apply, keys)``: ``batched_apply(obs_batch (n, obs_dim))
+    -> (n, act)`` closes over whatever per-member parameterization the
+    caller uses — this is the entry point for the Pallas streamed forward
+    (ops/pallas_noise.py::mlp_streamed_apply), whose population kernel
+    cannot live under a member vmap.  Env dynamics are vmapped; masking
+    semantics are identical to :func:`make_rollout`.
+    """
+    discrete = bool(env.discrete)
+    v_reset = jax.vmap(env.reset)
+    v_step = jax.vmap(env.step)
+    v_behavior = jax.vmap(env.behavior)
+
+    def rollout(batched_apply, keys: jax.Array) -> RolloutResult:
+        states0, obs0 = v_reset(keys)
+        n = obs0.shape[0]
+
+        def step_fn(carry, _):
+            states, obs, done, total, steps = carry
+            out = batched_apply(obs)
+            action = select_action(out, discrete)
+            nstate, nobs, reward, ndone = v_step(states, action)
+            alive = jnp.logical_not(done)
+            total = total + reward * alive.astype(jnp.float32)
+            steps = steps + alive.astype(jnp.int32)
+
+            def keep(new, old):
+                mask = alive.reshape((-1,) + (1,) * (new.ndim - 1))
+                return jnp.where(mask, new, old)
+
+            states_next = jax.tree_util.tree_map(keep, nstate, states)
+            obs_next = keep(nobs, obs)
+            done_next = done | ndone
+            return (states_next, obs_next, done_next, total, steps), None
+
+        init = (
+            states0,
+            obs0,
+            jnp.zeros((n,), jnp.bool_),
+            jnp.zeros((n,), jnp.float32),
+            jnp.zeros((n,), jnp.int32),
+        )
+        (states, obs, done, total, steps), _ = jax.lax.scan(
+            step_fn, init, None, length=horizon
+        )
+        bc = v_behavior(states, obs).astype(jnp.float32)
+        return RolloutResult(total_reward=total, bc=bc, steps=steps)
+
+    return rollout

@@ -1,0 +1,318 @@
+"""Pallas TPU kernels that stream ε directly from the HBM noise table.
+
+The pure-JAX paths materialize per-member noise: the update reduction
+(ops/gradient.py) gathers (chunk, dim) blocks before contracting, and the
+decomposed forward (models/decomposed.py) unravels a full (dim,) noise tree
+per member that then lives in HBM for the whole episode — O(population·dim)
+resident bytes at config-3 scale (10k × 166k ≈ 6.6 GB, more than a v5e's
+HBM).  These kernels never materialize ε: tiles are DMA'd from the table
+through double-buffered VMEM and consumed in place (ROADMAP item 1;
+SURVEY.md §7 design deltas 1/4).
+
+Two kernels share the grid shape:
+
+- :func:`weighted_noise_sum` — the update reduction Σ_k w_k·ε_k.  Grid over
+  noise rows; each row is DMA'd once and FMA'd into a VMEM accumulator that
+  is only written back at the end.  Replaces gather→materialize→matvec with
+  a single streamed pass (no (chunk, dim) intermediates).
+- :func:`population_noise_matvec` — the per-member noise term of the
+  decomposed forward, y_i = c_i·(x_i @ E_i), with E_i = the member's table
+  slice viewed as a (d, h) matrix.  Grid over (members × row-blocks); each
+  row-block is one contiguous B·h-float DMA, consumed as B static AXPYs —
+  no reshape, no per-member weight materialization, ever.
+
+Both run in interpret mode on CPU (equivalence-tested against the pure-JAX
+paths in tests/test_pallas_noise.py) and compile to Mosaic on TPU.  The
+``interpret`` default follows the backend.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _default_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# --------------------------------------------------------------------------
+# update reduction: Σ_k w_k · table[o_k : o_k + dim]
+# --------------------------------------------------------------------------
+
+
+def _weighted_sum_kernel(dim: int):
+    """Kernel body factory (dim is static)."""
+
+    def kernel(offs_ref, w_ref, table_ref, out_ref, buf, sem):
+        i = pl.program_id(0)
+        n = pl.num_programs(0)
+
+        def dma(slot, row):
+            return pltpu.make_async_copy(
+                table_ref.at[pl.ds(offs_ref[row], dim)],
+                buf.at[slot],
+                sem.at[slot],
+            )
+
+        @pl.when(i == 0)
+        def _init():
+            out_ref[...] = jnp.zeros_like(out_ref)
+            dma(0, 0).start()
+
+        # double buffering: next row's DMA flies while this row is consumed
+        @pl.when(i + 1 < n)
+        def _prefetch():
+            dma((i + 1) % 2, i + 1).start()
+
+        slot = jax.lax.rem(i, 2)
+        dma(slot, i).wait()
+        out_ref[...] += w_ref[i] * buf[slot, :]
+
+    return kernel
+
+
+@partial(jax.jit, static_argnames=("dim", "interpret"))
+def weighted_noise_sum(
+    table_data: jax.Array,  # (table_size,) float32 — NoiseTable.data
+    offsets: jax.Array,  # (n,) int32 row offsets
+    weights: jax.Array,  # (n,) float32 weight per row
+    dim: int,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Streamed Σ_k w_k·ε_k: one DMA per noise row, zero materialization.
+
+    Drop-in for ops/gradient.py::rank_weighted_noise_sum (same contract);
+    VMEM cost is 3·dim floats (double buffer + accumulator), so it suits
+    dims up to ~1M params.  Callers with larger dims should keep the
+    chunked pure-JAX path.
+    """
+    if interpret is None:
+        interpret = _default_interpret()
+    n = int(offsets.shape[0])
+    if n == 0:
+        return jnp.zeros((dim,), table_data.dtype)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # offsets, weights
+        grid=(n,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],  # table stays in HBM
+        out_specs=pl.BlockSpec((dim,), lambda i, *_: (0,), memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((2, dim), table_data.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    return pl.pallas_call(
+        _weighted_sum_kernel(dim),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((dim,), table_data.dtype),
+        interpret=interpret,
+    )(offsets.astype(jnp.int32), weights.astype(table_data.dtype), table_data)
+
+
+# --------------------------------------------------------------------------
+# decomposed-forward noise term: y_i = c_i · (x_i @ E_i)
+# --------------------------------------------------------------------------
+
+
+def _pick_row_block(d: int, h: int, budget_floats: int = 64 * 1024) -> int:
+    """Largest divisor of d whose B·h DMA fits the per-buffer budget.
+
+    Capped at 128 rows: the AXPY loop below unrolls B times, so an
+    unbounded B (e.g. a wide layer feeding a 1-unit head) would balloon
+    Mosaic compile time for no bandwidth gain.
+    """
+    best = 1
+    for b in range(1, d + 1):
+        if d % b == 0 and b * h <= budget_floats and b <= 128:
+            best = b
+    return best
+
+
+def _noise_matvec_kernel(d: int, h: int, block_rows: int, layer_offset: int):
+    n_blocks = d // block_rows
+
+    def kernel(offs_ref, c_ref, x_ref, table_ref, y_ref, buf, sem):
+        i = pl.program_id(0)  # member
+        k = pl.program_id(1)  # row block (inner axis)
+        n_i = pl.num_programs(0)
+
+        def dma(slot, member, blk):
+            start = offs_ref[member] + layer_offset + blk * (block_rows * h)
+            return pltpu.make_async_copy(
+                table_ref.at[pl.ds(start, block_rows * h)],
+                buf.at[slot],
+                sem.at[slot],
+            )
+
+        step = i * n_blocks + k
+
+        @pl.when(step == 0)
+        def _warmup():
+            dma(0, 0, 0).start()
+
+        # prefetch the NEXT grid step's block (possibly the next member's
+        # first block) while this one is consumed
+        nxt = step + 1
+
+        @pl.when(nxt < n_i * n_blocks)
+        def _prefetch():
+            dma(
+                jax.lax.rem(nxt, 2),
+                nxt // n_blocks,
+                jax.lax.rem(nxt, n_blocks),
+            ).start()
+
+        @pl.when(k == 0)
+        def _init():
+            y_ref[...] = jnp.zeros_like(y_ref)
+
+        slot = jax.lax.rem(step, 2)
+        dma(slot, i, k).wait()
+
+        # B static AXPYs against contiguous h-float views of the DMA'd
+        # block — the (B, h) matrix view never needs a reshape
+        acc = jnp.zeros((h,), y_ref.dtype)
+        for r in range(block_rows):
+            acc = acc + x_ref[0, r] * buf[slot, pl.ds(r * h, h)]
+        y_ref[0, :] += c_ref[i] * acc
+
+    return kernel
+
+
+@partial(
+    jax.jit,
+    static_argnames=("d", "h", "layer_offset", "interpret", "block_rows"),
+)
+def population_noise_matvec(
+    table_data: jax.Array,  # (table_size,) float32
+    offsets: jax.Array,  # (n,) int32 — each member's flat-ε start offset
+    c: jax.Array,  # (n,) float32 — σ·sign per member
+    x: jax.Array,  # (n, d) float32 — the layer's input batch
+    layer_offset: int,  # this layer's kernel start WITHIN the member ε vector
+    d: int,
+    h: int,
+    interpret: bool | None = None,
+    block_rows: int | None = None,
+) -> jax.Array:
+    """y[i] = c[i] · (x[i] @ E_i) with E_i streamed from the table.
+
+    ``E_i = table[offsets[i]+layer_offset : …+d·h]`` viewed row-major as
+    (d, h) — exactly the layout ops/params.py's unravel gives a Dense
+    kernel, so this reproduces models/decomposed.py's noise term without
+    materializing any member's noise tree.
+    """
+    if interpret is None:
+        interpret = _default_interpret()
+    n = int(x.shape[0])
+    if block_rows is None:
+        block_rows = _pick_row_block(d, h)
+    if d % block_rows != 0:
+        raise ValueError(f"block_rows {block_rows} must divide d {d}")
+    if block_rows > 512:
+        raise ValueError(
+            f"block_rows {block_rows} would unroll {block_rows} AXPYs into "
+            "the kernel body; keep it <= 512 (auto-pick caps at 128)"
+        )
+    n_blocks = d // block_rows
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # offsets, c
+        grid=(n, n_blocks),
+        in_specs=[
+            # x: one member's row-block per grid step — (1, B) in VMEM
+            pl.BlockSpec(
+                (1, block_rows), lambda i, k, *_: (i, k), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(memory_space=pl.ANY),  # table stays in HBM
+        ],
+        out_specs=pl.BlockSpec(
+            (1, h), lambda i, k, *_: (i, 0), memory_space=pltpu.VMEM
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, block_rows * h), table_data.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    return pl.pallas_call(
+        _noise_matvec_kernel(d, h, block_rows, layer_offset),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((n, h), table_data.dtype),
+        interpret=interpret,
+    )(
+        offsets.astype(jnp.int32),
+        c.astype(table_data.dtype),
+        x.astype(table_data.dtype),
+        table_data,
+    )
+
+
+# --------------------------------------------------------------------------
+# full streamed MLP forward (population-batched)
+# --------------------------------------------------------------------------
+
+
+def flat_layer_offsets(params) -> dict[str, dict[str, int]]:
+    """Each leaf's start offset within the ravel_pytree flat vector.
+
+    ravel_pytree flattens in tree order (sorted dict keys), each leaf
+    row-major — the layout every table slice is unraveled with, so these
+    offsets address a member's ε exactly like spec.unravel does.
+    """
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    offsets: dict[str, dict[str, int]] = {}
+    pos = 0
+    for path, leaf in flat:
+        layer = path[0].key
+        name = path[1].key
+        offsets.setdefault(layer, {})[name] = pos
+        pos += int(leaf.size)
+    return offsets
+
+
+def mlp_streamed_apply(
+    module,
+    shared_params,
+    table_data: jax.Array,
+    offsets: jax.Array,  # (n,) member ε start offsets
+    c: jax.Array,  # (n,) σ·sign
+    obs: jax.Array,  # (n, obs_dim) population observation batch
+    layer_offsets: dict[str, dict[str, int]],
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Population-batched MLPPolicy forward, weights (shared + c·ε) with ε
+    streamed from the table.
+
+    The shared-W term of every layer is one dense (n, d) @ (d, h) matmul
+    (MXU); the noise term streams through :func:`population_noise_matvec`;
+    bias noise is a tiny (n, h) gather.  Bit-for-bit this reorders the same
+    contractions as models/decomposed.py::mlp_decomposed_apply, which the
+    tests pin to float tolerance.
+    """
+    from ..models.decomposed import _ordered_dense_names
+
+    names = _ordered_dense_names(shared_params)
+    x = obs
+    for name in names:
+        w = shared_params[name]["kernel"]
+        b = shared_params[name]["bias"]
+        d, h = int(w.shape[0]), int(w.shape[1])
+        noise_term = population_noise_matvec(
+            table_data, offsets, c, x,
+            layer_offset=layer_offsets[name]["kernel"],
+            d=d, h=h, interpret=interpret,
+        )
+        # bias noise: h floats per member — a tiny gather, not worth a DMA
+        bias_off = layer_offsets[name]["bias"]
+        nb = jax.vmap(
+            lambda o: jax.lax.dynamic_slice(table_data, (o + bias_off,), (h,))
+        )(offsets)
+        x = x @ w + noise_term + b + c[:, None] * nb
+        if name != "head":
+            x = module.activation(x)
+    if not module.discrete:
+        x = jnp.tanh(x) * module.action_scale
+    return x

@@ -70,6 +70,16 @@ class EngineConfig:
     # layer becomes ONE population-wide dense matmul (W un-batched under
     # vmap) instead of per-member matvecs against materialized perturbed
     # weights; needs a decomposed_apply (models/decomposed.py)
+    noise_kernel: bool = False  # Pallas streamed update reduction
+    # (ops/pallas_noise.py): ε rows DMA'd from the HBM table through
+    # double-buffered VMEM and FMA'd in place — no (chunk, dim)
+    # materialization. Interpret-mode off-TPU, Mosaic on-chip.
+    streamed: bool = False  # Pallas streamed FORWARD: the decomposed
+    # population forward with every layer's ε tiles DMA'd from the table —
+    # no member's noise tree is ever materialized, so resident noise bytes
+    # drop from O(population·dim) to O(2·tile). Implies a population-
+    # batched rollout (one policy call per step for the whole local shard).
+    # Needs a streamed_apply (ES builds it for MLPPolicy); f32 only.
 
 
 class ESState(NamedTuple):
@@ -157,6 +167,7 @@ class ESEngine:
         config: EngineConfig,
         mesh: Mesh,
         decomposed_apply=None,
+        streamed_apply=None,
     ):
         self.env = env
         if config.decomposed and decomposed_apply is None and env is not None:
@@ -164,6 +175,25 @@ class ESEngine:
                 "EngineConfig.decomposed=True needs a decomposed_apply "
                 "(models/decomposed.py::mlp_decomposed_apply for MLPPolicy)"
             )
+        if config.streamed:
+            if config.decomposed:
+                raise ValueError(
+                    "streamed IS the kernel form of decomposed — enable one"
+                )
+            if config.episodes_per_member != 1:
+                raise ValueError(
+                    "streamed currently supports episodes_per_member=1"
+                )
+            if config.compute_dtype != "float32":
+                raise ValueError(
+                    "streamed runs in float32 (the table and kernel are f32)"
+                )
+            if streamed_apply is None and env is not None:
+                raise ValueError(
+                    "EngineConfig.streamed=True needs a streamed_apply "
+                    "(ops/pallas_noise.py::mlp_streamed_apply for MLPPolicy)"
+                )
+        self._streamed_apply = streamed_apply
         if config.compute_dtype not in ("float32", "bfloat16"):
             raise ValueError(
                 f"compute_dtype must be float32 or bfloat16, got {config.compute_dtype!r}"
@@ -207,6 +237,12 @@ class ESEngine:
         self.bc_dim = int(env.bc_dim)
 
         self._rollout = make_rollout(env, policy_apply, config.horizon)
+
+        self._rollout_batched = None
+        if config.streamed:
+            from ..envs.rollout import make_batched_rollout
+
+            self._rollout_batched = make_batched_rollout(env, config.horizon)
 
         self._rollout_decomposed = None
         if config.decomposed:
@@ -331,6 +367,10 @@ class ESEngine:
         cfg = self.config
         dim = self.spec.dim
         n_chunks = self.members_local // self.eval_chunk
+        if cfg.streamed:
+            return self._eval_local_streamed(
+                state, member_offs, signs, member_keys, n_chunks
+            )
         if cfg.decomposed:
             # shared center tree: unraveled (and, for bf16, cast) ONCE,
             # enters the member vmap as an un-batched constant — its matmuls
@@ -370,8 +410,14 @@ class ESEngine:
             f, bc, st = jax.vmap(member_eval)(offs_c, signs_c, keys_c)
             return 0, (f, bc, st)
 
+        return self._scan_chunks(chunk_body, member_offs, signs, member_keys, n_chunks)
+
+    def _scan_chunks(self, chunk_body, member_offs, signs, member_keys, n_chunks):
+        """Dispatch the local shard through ``chunk_body`` in eval_chunk
+        pieces (single-chunk: no 1-iteration scan layer) and restore the
+        member-major result shapes.  Shared by the standard/decomposed vmap
+        path and the streamed batched path."""
         if n_chunks == 1:
-            # whole shard in one vmap — no 1-iteration scan layer
             _, (f, bc, st) = chunk_body(0, (member_offs, signs, member_keys))
         else:
             xs = (
@@ -380,10 +426,29 @@ class ESEngine:
                 member_keys.reshape(n_chunks, self.eval_chunk, -1),
             )
             _, (f, bc, st) = jax.lax.scan(chunk_body, 0, xs)
-        fitness_local = f.reshape(self.members_local)
-        bc_local = bc.reshape(self.members_local, self.bc_dim)
-        steps_local = st.reshape(self.members_local)
-        return fitness_local, bc_local, steps_local
+        return (
+            f.reshape(self.members_local),
+            bc.reshape(self.members_local, self.bc_dim),
+            st.reshape(self.members_local),
+        )
+
+    def _eval_local_streamed(self, state, member_offs, signs, member_keys, n_chunks):
+        """Population-batched evaluation with the Pallas streamed forward:
+        one policy call per env step for the whole chunk, every layer's ε
+        DMA'd from the table — no member noise tree is ever materialized."""
+        shared_tree = self.spec.unravel(state.params_flat)
+
+        def chunk_body(_, xs):
+            offs_c, signs_c, keys_c = xs
+            c = state.sigma * signs_c
+
+            def batched_apply(obs_batch):
+                return self._streamed_apply(shared_tree, offs_c, c, obs_batch)
+
+            res = self._rollout_batched(batched_apply, keys_c)
+            return 0, (res.total_reward, res.bc, res.steps)
+
+        return self._scan_chunks(chunk_body, member_offs, signs, member_keys, n_chunks)
 
     def _gather_global(self, fitness_local, bc_local, steps_local):
         """Device-major all_gather → identical global arrays on every device."""
@@ -403,7 +468,17 @@ class ESEngine:
         w_local = jax.lax.dynamic_slice(
             weights, (d * self.members_local,), (self.members_local,)
         )
-        if cfg.mirrored:
+        if cfg.noise_kernel:
+            # Pallas streamed reduction: each ε row is DMA'd once and FMA'd
+            # into a VMEM accumulator — no materialized noise blocks
+            from ..ops.gradient import fold_mirrored_weights as _fold
+            from ..ops.pallas_noise import weighted_noise_sum
+
+            row_w = _fold(w_local) if cfg.mirrored else w_local
+            grad_local = weighted_noise_sum(
+                self.table.data, reduction_offs, row_w, dim=self.spec.dim
+            ) / (cfg.population_size * state.sigma)
+        elif cfg.mirrored:
             # local folded partial of the estimator; scaling commutes with psum
             grad_local = es_gradient(
                 self.table, reduction_offs, w_local,

@@ -61,6 +61,11 @@ class PooledEngine:
                 "episodes_per_member is a device-path option; the pooled "
                 "path rolls one episode per member env"
             )
+        if config.streamed:
+            raise ValueError(
+                "streamed is a device-path option; the pooled path's policy "
+                "forward runs per env step against materialized thetas"
+            )
         if config.decomposed:
             raise ValueError(
                 "decomposed is a device-path option; the pooled path "

@@ -1,0 +1,304 @@
+"""Pallas streamed-noise kernels vs their pure-JAX twins (interpret mode).
+
+The kernels must be bit-compatible REORDERINGS of existing math:
+- weighted_noise_sum ≡ ops/gradient.py::rank_weighted_noise_sum
+- population_noise_matvec ≡ the c·(x@E) noise term of models/decomposed.py
+- mlp_streamed_apply ≡ mlp_decomposed_apply over a population batch
+
+On CPU they run in interpret mode; the SAME code compiles to Mosaic on TPU
+(bench.py A/Bs it on-chip when the chip is reachable).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from estorch_tpu.ops import make_noise_table, make_param_spec, rank_weighted_noise_sum
+from estorch_tpu.ops.pallas_noise import (
+    flat_layer_offsets,
+    mlp_streamed_apply,
+    population_noise_matvec,
+    weighted_noise_sum,
+)
+
+TABLE = make_noise_table(1 << 16, seed=3)
+
+
+class TestWeightedNoiseSum:
+    @pytest.mark.parametrize("n,dim", [(1, 8), (7, 33), (64, 128), (33, 257)])
+    def test_matches_pure_jax(self, n, dim):
+        key = jax.random.key(n * 1000 + dim)
+        offs = jax.random.randint(key, (n,), 0, TABLE.size - dim, dtype=jnp.int32)
+        w = jax.random.normal(jax.random.fold_in(key, 1), (n,))
+        got = weighted_noise_sum(TABLE.data, offs, w, dim=dim, interpret=True)
+        want = rank_weighted_noise_sum(TABLE, offs, w, dim=dim)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-6)
+
+    def test_zero_weights_zero_sum(self):
+        offs = jnp.array([5, 10, 15], jnp.int32)
+        got = weighted_noise_sum(TABLE.data, offs, jnp.zeros(3), dim=16, interpret=True)
+        np.testing.assert_array_equal(np.asarray(got), np.zeros(16, np.float32))
+
+    def test_single_row_is_scaled_slice(self):
+        got = weighted_noise_sum(
+            TABLE.data, jnp.array([42], jnp.int32), jnp.array([2.5]), dim=64,
+            interpret=True,
+        )
+        want = 2.5 * np.asarray(TABLE.data[42:106])
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6)
+
+    def test_empty_input(self):
+        got = weighted_noise_sum(
+            TABLE.data, jnp.zeros((0,), jnp.int32), jnp.zeros((0,)), dim=8,
+            interpret=True,
+        )
+        np.testing.assert_array_equal(np.asarray(got), np.zeros(8, np.float32))
+
+
+class TestPopulationNoiseMatvec:
+    @pytest.mark.parametrize("n,d,h", [(4, 8, 16), (6, 17, 5), (16, 32, 32), (3, 64, 7)])
+    def test_matches_einsum_oracle(self, n, d, h):
+        key = jax.random.key(n + 10 * d + 100 * h)
+        offs = jax.random.randint(key, (n,), 0, TABLE.size - d * h - 64, dtype=jnp.int32)
+        c = jax.random.normal(jax.random.fold_in(key, 1), (n,))
+        x = jax.random.normal(jax.random.fold_in(key, 2), (n, d))
+        layer_off = 32
+
+        got = population_noise_matvec(
+            TABLE.data, offs, c, x, layer_offset=layer_off, d=d, h=h, interpret=True
+        )
+        # oracle: materialize each member's E and einsum
+        E = jax.vmap(
+            lambda o: jax.lax.dynamic_slice(TABLE.data, (o + layer_off,), (d * h,))
+        )(offs).reshape(n, d, h)
+        want = c[:, None] * jnp.einsum("nd,ndh->nh", x, E)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-5
+        )
+
+    def test_explicit_block_rows(self):
+        """A forced non-trivial row blocking must not change the result."""
+        key = jax.random.key(0)
+        n, d, h = 4, 12, 6
+        offs = jax.random.randint(key, (n,), 0, TABLE.size - d * h, dtype=jnp.int32)
+        c = jnp.ones((n,))
+        x = jax.random.normal(jax.random.fold_in(key, 1), (n, d))
+        a = population_noise_matvec(
+            TABLE.data, offs, c, x, layer_offset=0, d=d, h=h,
+            interpret=True, block_rows=3,
+        )
+        b = population_noise_matvec(
+            TABLE.data, offs, c, x, layer_offset=0, d=d, h=h,
+            interpret=True, block_rows=12,
+        )
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+    def test_indivisible_block_rows_rejected(self):
+        with pytest.raises(ValueError, match="divide"):
+            population_noise_matvec(
+                TABLE.data, jnp.zeros((2,), jnp.int32), jnp.ones((2,)),
+                jnp.ones((2, 10)), layer_offset=0, d=10, h=4,
+                interpret=True, block_rows=3,
+            )
+
+
+class TestStreamedMLPForward:
+    def _setup(self, n=6, obs_dim=5, hidden=(8, 8), act=3):
+        from estorch_tpu.models import MLPPolicy
+
+        module = MLPPolicy(action_dim=act, hidden=hidden, discrete=False)
+        obs0 = jnp.zeros(obs_dim)
+        params = module.init(jax.random.PRNGKey(0), obs0)["params"]
+        flat, spec = make_param_spec(params)
+        key = jax.random.key(9)
+        offs = jax.random.randint(
+            key, (n,), 0, TABLE.size - spec.dim, dtype=jnp.int32
+        )
+        c = 0.1 * jax.random.normal(jax.random.fold_in(key, 1), (n,))
+        obs = jax.random.normal(jax.random.fold_in(key, 2), (n, obs_dim))
+        return module, params, spec, offs, c, obs
+
+    def test_matches_decomposed_apply(self):
+        """Streamed forward ≡ pure-JAX decomposed forward, member by member."""
+        from estorch_tpu.models.decomposed import mlp_decomposed_apply
+
+        module, params, spec, offs, c, obs = self._setup()
+        lo = flat_layer_offsets(params)
+        got = mlp_streamed_apply(
+            module, params, TABLE.data, offs, c, obs, lo, interpret=True
+        )
+        for i in range(obs.shape[0]):
+            eps_tree = spec.unravel(TABLE.slice(offs[i], spec.dim))
+            want_i = mlp_decomposed_apply(module, params, eps_tree, c[i], obs[i])
+            np.testing.assert_allclose(
+                np.asarray(got[i]), np.asarray(want_i), rtol=1e-4, atol=1e-5,
+                err_msg=f"member {i}",
+            )
+
+    def test_matches_materialized_perturbation(self):
+        """…and ≡ the STANDARD engine path: apply(θ + c·ε) directly."""
+        module, params, spec, offs, c, obs = self._setup(hidden=(16,))
+        lo = flat_layer_offsets(params)
+        flat = spec.flatten(params)
+        got = mlp_streamed_apply(
+            module, params, TABLE.data, offs, c, obs, lo, interpret=True
+        )
+        for i in range(obs.shape[0]):
+            theta = flat + c[i] * TABLE.slice(offs[i], spec.dim)
+            want_i = module.apply({"params": spec.unravel(theta)}, obs[i])
+            np.testing.assert_allclose(
+                np.asarray(got[i]), np.asarray(want_i), rtol=1e-4, atol=1e-5,
+                err_msg=f"member {i}",
+            )
+
+    def test_layer_offsets_cover_flat_vector(self):
+        _, params, spec, *_ = self._setup()
+        lo = flat_layer_offsets(params)
+        total = sum(
+            int(np.prod(leaf.shape))
+            for leaf in jax.tree_util.tree_leaves(params)
+        )
+        assert total == spec.dim
+        all_offs = sorted(o for layer in lo.values() for o in layer.values())
+        assert all_offs[0] == 0
+        assert all(b > a for a, b in zip(all_offs, all_offs[1:]))
+
+
+class TestEngineNoiseKernel:
+    """noise_kernel=True must reproduce the chunked pure-JAX update inside
+    the real sharded generation program (8 virtual devices, interpret mode)."""
+
+    def _engines(self, mirrored):
+        import optax
+
+        from estorch_tpu.envs import CartPole
+        from estorch_tpu.parallel import EngineConfig, ESEngine, population_mesh
+
+        def apply(p, obs):
+            return jnp.tanh(obs @ p["w1"] + p["b1"]) @ p["w2"] + p["b2"]
+
+        params = {
+            "w1": jax.random.normal(jax.random.key(0), (4, 16)) * 0.5,
+            "b1": jnp.zeros(16),
+            "w2": jax.random.normal(jax.random.key(1), (16, 2)) * 0.5,
+            "b2": jnp.zeros(2),
+        }
+        flat, spec = make_param_spec(params)
+        out = []
+        for nk in (False, True):
+            cfg = EngineConfig(
+                population_size=32, sigma=0.1, horizon=30,
+                mirrored=mirrored, noise_kernel=nk,
+            )
+            out.append(
+                ESEngine(CartPole(), apply, spec, TABLE,
+                         optax.adam(1e-2), cfg, population_mesh())
+            )
+        return out, flat
+
+    @pytest.mark.parametrize("mirrored", [True, False])
+    def test_kernel_update_matches_pure_jax(self, mirrored, devices8):
+        (ref, kern), flat = self._engines(mirrored)
+        s_ref = ref.init_state(flat, jax.random.PRNGKey(5))
+        s_k = kern.init_state(flat, jax.random.PRNGKey(5))
+        for gen in range(2):
+            s_ref, m_ref = ref.generation_step(s_ref)
+            s_k, m_k = kern.generation_step(s_k)
+            np.testing.assert_array_equal(
+                np.asarray(m_ref["fitness"]), np.asarray(m_k["fitness"]),
+                err_msg=f"gen {gen}",
+            )
+            np.testing.assert_allclose(
+                np.asarray(s_ref.params_flat), np.asarray(s_k.params_flat),
+                rtol=1e-5, atol=1e-6, err_msg=f"gen {gen}",
+            )
+
+    def test_streamed_engine_matches_standard(self, devices8):
+        """The FULL streamed path (batched rollout + Pallas forward) must
+        reproduce the standard engine's fitness and update on the mesh."""
+        import optax
+
+        from estorch_tpu import ES, JaxAgent, MLPPolicy
+        from estorch_tpu.envs import CartPole
+
+        def mk(**over):
+            return ES(
+                MLPPolicy, JaxAgent, optax.adam,
+                population_size=32, sigma=0.1, seed=0,
+                policy_kwargs={"action_dim": 2, "hidden": (16,)},
+                agent_kwargs={"env": CartPole(), "horizon": 60},
+                optimizer_kwargs={"learning_rate": 3e-2},
+                table_size=1 << 16, **over,
+            )
+
+        std, stream = mk(), mk(streamed=True)
+        for gen in range(2):
+            std.train(1, verbose=False)
+            stream.train(1, verbose=False)
+            np.testing.assert_allclose(
+                np.asarray(stream.state.params_flat),
+                np.asarray(std.state.params_flat),
+                rtol=2e-5, atol=1e-6, err_msg=f"gen {gen}",
+            )
+        # fitness recorded identically (CartPole argmax actions: float-
+        # associativity can only flip near-ties, so allow tiny disagreement)
+        f_std = [r["reward_mean"] for r in std.history]
+        f_str = [r["reward_mean"] for r in stream.history]
+        np.testing.assert_allclose(f_str, f_std, rtol=0.1)
+
+    def test_streamed_learns(self, devices8):
+        import optax
+
+        from estorch_tpu import ES, JaxAgent, MLPPolicy
+        from estorch_tpu.envs import CartPole
+
+        es = ES(
+            MLPPolicy, JaxAgent, optax.adam,
+            population_size=32, sigma=0.1, seed=0,
+            policy_kwargs={"action_dim": 2, "hidden": (16,)},
+            agent_kwargs={"env": CartPole(), "horizon": 100},
+            optimizer_kwargs={"learning_rate": 3e-2},
+            table_size=1 << 16, streamed=True, noise_kernel=True,
+        )
+        es.train(8, verbose=False)
+        first = es.history[0]["reward_mean"]
+        last = es.history[-1]["reward_mean"]
+        assert last > first + 15, (first, last)
+
+    def test_streamed_rejected_on_pooled(self):
+        """streamed must fail LOUDLY on the pooled path, not silently run
+        the standard materialized forward."""
+        import optax
+
+        from estorch_tpu import ES, MLPPolicy, PooledAgent
+
+        with pytest.raises(ValueError, match="streamed"):
+            ES(
+                MLPPolicy, PooledAgent, optax.adam,
+                population_size=8, sigma=0.1,
+                policy_kwargs={"action_dim": 2, "hidden": (8,)},
+                agent_kwargs={"env_name": "cartpole", "horizon": 10},
+                optimizer_kwargs={"learning_rate": 1e-2},
+                table_size=1 << 14, streamed=True,
+            )
+
+    def test_rejected_on_host_backend(self):
+        import torch
+
+        from estorch_tpu import ES
+
+        class P(torch.nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.lin = torch.nn.Linear(2, 2)
+
+            def forward(self, x):
+                return self.lin(x)
+
+        class A:
+            def rollout(self, policy):
+                return 0.0
+
+        with pytest.raises(ValueError, match="noise_kernel"):
+            ES(P, A, torch.optim.Adam, population_size=4, noise_kernel=True)
