@@ -1,7 +1,7 @@
 """Benchmark: device-native ES generation throughput on the flagship config.
 
 Prints ONE JSON line:
-    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "extras": {...}}
 
 Metric: env-steps/sec/chip (BASELINE.json primary metric) for a full ES
 generation — noise-table perturbation, vmapped policy rollouts, centered
@@ -9,11 +9,23 @@ ranks, psum'd rank-weighted update — on Pendulum (never terminates, so every
 scanned step is a real env step; no done-mask inflation) with a 64x64 MLP,
 population 4096, horizon 200: ~819k env steps per generation.
 
+extras: a Humanoid-sized-policy point (SyntheticEnv obs 376 → 256×256 → 17,
+the __graft_entry__ flagship shape) and a pop-10240 point, each with an MFU
+estimate (policy-forward FLOPs vs a v5e bf16 peak of 197 TFLOP/s).
+
 vs_baseline: ratio against a reference-style estorch loop measured live on
 this host — per-member Python loop, torch CPU MLP forward per step,
 gymnasium Pendulum env.step — the architecture SURVEY.md §3.2/§3.3 documents
 (single process; the reference scales it by n_proc workers, so divide by
 core count for a per-core figure if comparing to the 720-core runs).
+
+Stage protocol (each stage is a child process so a tunnel wedge in one
+measurement cannot take down the bench — round-1 lesson):
+    bench.py --stage-one '<json cfg>'   measure one config, print one JSON
+    bench.py --stage-ab                 run the full A/B matrix (standard /
+                                        decomposed / streamed × f32 / bf16),
+                                        one JSON line per config as it lands
+    bench.py                            headline + extras, the driver entry
 """
 
 import json
@@ -23,44 +35,81 @@ import time
 
 import numpy as np
 
+V5E_BF16_PEAK = 197e12  # TPU v5e per-chip bf16 peak FLOP/s
+
+SMALL = {"env": "pendulum", "hidden": [64, 64], "population": 4096,
+         "horizon": 200}
+BIG = {"env": "synthetic", "hidden": [256, 256], "population": 4096,
+       "horizon": 200}
+POP10K = {"env": "synthetic", "hidden": [256, 256], "population": 10240,
+          "horizon": 200}
 
 
+def _env_and_policy(cfg):
+    from estorch_tpu.envs import Pendulum, SyntheticEnv
 
-def measure_tpu(population=4096, horizon=200, gens=5, force_cpu=False) -> tuple[float, str]:
+    if cfg["env"] == "pendulum":
+        env = Pendulum()
+        pk = {"action_dim": 1, "hidden": tuple(cfg["hidden"]),
+              "discrete": False, "action_scale": 2.0}
+    else:
+        env = SyntheticEnv()
+        pk = {"action_dim": env.action_dim, "hidden": tuple(cfg["hidden"]),
+              "discrete": False, "action_scale": 1.0}
+    return env, pk
+
+
+def policy_flops_per_member_step(cfg):
+    """2·Σ(m·n) over the MLP's matmuls — the MXU work per member env-step."""
+    env, _ = _env_and_policy(cfg)
+    dims = [env.obs_dim, *cfg["hidden"], env.action_dim]
+    return 2 * sum(a * b for a, b in zip(dims[:-1], dims[1:]))
+
+
+def measure_one(cfg, force_cpu=False):
+    """Run one config; returns dict(rate, platform, mfu, ...)."""
     if force_cpu:
         from estorch_tpu.utils import force_cpu_backend
 
         force_cpu_backend(8)
+    import jax
     import optax
 
     from estorch_tpu import ES, JaxAgent, MLPPolicy
-    from estorch_tpu.envs import Pendulum
 
-    import jax
-
+    env, pk = _env_and_policy(cfg)
     on_tpu = not force_cpu and jax.devices()[0].platform == "tpu"
+    dtype = cfg.get("dtype", "bfloat16" if on_tpu else "float32")
     es = ES(
         policy=MLPPolicy,
         agent=JaxAgent,
         optimizer=optax.adam,
-        population_size=population,
+        population_size=cfg["population"],
         sigma=0.05,
-        policy_kwargs={"action_dim": 1, "hidden": (64, 64), "discrete": False,
-                       "action_scale": 2.0},
-        agent_kwargs={"env": Pendulum(), "horizon": horizon},
+        policy_kwargs=pk,
+        agent_kwargs={"env": env, "horizon": cfg["horizon"]},
         optimizer_kwargs={"learning_rate": 1e-2},
-        eval_chunk=0,  # whole shard per vmap: +60% over chunked on CPU
-        # bf16 policy compute on real TPU (MXU-native); CPU bf16 is emulated
-        compute_dtype="bfloat16" if on_tpu else "float32",
+        eval_chunk=cfg.get("eval_chunk", 0),
+        compute_dtype=dtype,
+        decomposed=cfg.get("decomposed", False),
+        noise_kernel=cfg.get("noise_kernel", False),
+        streamed=cfg.get("streamed", False),
     )
-    es.train(1, verbose=False)  # warm-up generation (post-AOT sanity)
+    gens = cfg.get("gens", 5)
+    es.train(1, verbose=False)  # warm-up generation (compile + AOT sanity)
     t0 = time.perf_counter()
     es.train(gens, verbose=False)
     dt = time.perf_counter() - t0
     steps = sum(r["env_steps"] for r in es.history[-gens:])
     n_chips = es.mesh.devices.size
-    platform = es.mesh.devices.flat[0].platform
-    return steps / dt / n_chips, platform
+    rate = steps / dt / n_chips
+    return {
+        "rate": rate,
+        "platform": es.mesh.devices.flat[0].platform,
+        "dtype": dtype,
+        "mfu": rate * policy_flops_per_member_step(cfg) / V5E_BF16_PEAK,
+        "cfg": cfg,
+    }
 
 
 def measure_reference_style_baseline(budget_s=6.0) -> float:
@@ -89,47 +138,90 @@ def measure_reference_style_baseline(budget_s=6.0) -> float:
     return steps / (time.perf_counter() - t0)
 
 
-def _measure_tpu_subprocess(timeout_s: int = 480):
-    """Run the TPU measurement in a child with a hard timeout — the tunnel
-    can wedge at init OR mid-run, and bench must still emit its JSON line.
-    Returns (rate, platform) or None; failure diagnostics go to OUR stderr
-    (the JSON-line contract owns stdout only)."""
+def run_stage(cfg, timeout_s=480):
+    """One config in a child with a hard timeout — the tunnel can wedge at
+    init OR mid-run, and bench must still emit its JSON line.  Returns the
+    child's result dict or None; diagnostics go to OUR stderr (the JSON-line
+    contract owns stdout only)."""
     try:
         r = subprocess.run(
-            [sys.executable, __file__, "--stage-tpu"],
-            timeout=timeout_s,
-            capture_output=True,
-            text=True,
+            [sys.executable, __file__, "--stage-one", json.dumps(cfg)],
+            timeout=timeout_s, capture_output=True, text=True,
         )
     except subprocess.TimeoutExpired:
-        print(f"bench: TPU child timed out after {timeout_s}s (tunnel wedge?)",
-              file=sys.stderr)
+        print(f"bench: stage timed out after {timeout_s}s (tunnel wedge?) "
+              f"cfg={cfg}", file=sys.stderr)
         return None
     if r.returncode != 0:
-        print(f"bench: TPU child exited {r.returncode}; stderr tail:\n"
+        print(f"bench: stage exited {r.returncode} cfg={cfg}; stderr tail:\n"
               f"{r.stderr[-2000:]}", file=sys.stderr)
         return None
     try:
-        last = [ln for ln in r.stdout.strip().splitlines() if ln.startswith("{")][-1]
-        d = json.loads(last)
-        return float(d["rate"]), str(d["platform"])
-    except (IndexError, KeyError, ValueError):
-        print(f"bench: TPU child output unparseable; stdout tail:\n"
+        last = [ln for ln in r.stdout.strip().splitlines()
+                if ln.startswith("{")][-1]
+        out = json.loads(last)
+        float(out["rate"]), str(out["platform"]), str(out["dtype"])  # validate
+        return out
+    except (IndexError, KeyError, TypeError, ValueError):
+        print(f"bench: stage output unparseable cfg={cfg}; stdout tail:\n"
               f"{r.stdout[-1000:]}\nstderr tail:\n{r.stderr[-1000:]}",
               file=sys.stderr)
         return None
 
 
+AB_MATRIX = [
+    # (label, base-config, overrides)
+    ("small/standard/f32", SMALL, {"dtype": "float32"}),
+    ("small/standard/bf16", SMALL, {"dtype": "bfloat16"}),
+    ("small/decomposed/f32", SMALL, {"dtype": "float32", "decomposed": True}),
+    ("small/decomposed/bf16", SMALL, {"dtype": "bfloat16", "decomposed": True}),
+    ("small/decomposed/bf16+nk", SMALL,
+     {"dtype": "bfloat16", "decomposed": True, "noise_kernel": True}),
+    ("small/streamed/f32", SMALL, {"dtype": "float32", "streamed": True}),
+    ("small/streamed/f32+nk", SMALL,
+     {"dtype": "float32", "streamed": True, "noise_kernel": True}),
+    ("big/standard/bf16", BIG, {"dtype": "bfloat16"}),
+    ("big/decomposed/bf16", BIG, {"dtype": "bfloat16", "decomposed": True}),
+    ("big/streamed/f32", BIG, {"dtype": "float32", "streamed": True}),
+    ("pop10k/decomposed/bf16", POP10K,
+     {"dtype": "bfloat16", "decomposed": True, "gens": 3}),
+]
+
+
+def stage_ab():
+    for label, base, over in AB_MATRIX:
+        cfg = {**base, **over}
+        res = run_stage(cfg, timeout_s=600)
+        line = {"label": label, **(res or {"rate": None, "cfg": cfg})}
+        print(json.dumps(line), flush=True)
+
+
 def main():
-    result = _measure_tpu_subprocess()
+    # dtype deliberately unset: measure_one picks bf16 on TPU, f32 elsewhere
+    headline_cfg = {**SMALL, "decomposed": True}
+    result = run_stage(headline_cfg)
     if result is None:
-        rate, platform = measure_tpu(force_cpu=True)
+        result = measure_one(headline_cfg, force_cpu=True)
         fell_back = True
     else:
-        rate, platform = result
         fell_back = False
+    rate, platform = result["rate"], result["platform"]
+    on_tpu = platform == "tpu"
     base_rate = measure_reference_style_baseline()
-    unit = f"env-steps/s/chip (Pendulum MLP64x64 pop4096 h200, {platform}"
+
+    extras = {"mfu_headline": round(result["mfu"], 6)}
+    if on_tpu:
+        for name, base in (("big_policy", BIG), ("pop10k", POP10K)):
+            r = run_stage({**base, "decomposed": True, "gens": 3},
+                          timeout_s=600)
+            extras[name] = (
+                {"rate": round(r["rate"], 1), "mfu": round(r["mfu"], 6),
+                 "dtype": r["dtype"]}
+                if r else None
+            )
+
+    unit = (f"env-steps/s/chip (Pendulum MLP64x64 pop4096 h200 "
+            f"decomposed/{result['dtype']}, {platform}")
     unit += ", TPU-PATH-FAILED cpu fallback — see stderr)" if fell_back else ")"
     print(
         json.dumps(
@@ -138,14 +230,18 @@ def main():
                 "value": round(rate, 1),
                 "unit": unit,
                 "vs_baseline": round(rate / base_rate, 2),
+                "extras": extras,
             }
         )
     )
 
 
 if __name__ == "__main__":
-    if "--stage-tpu" in sys.argv:
-        rate, platform = measure_tpu(force_cpu=False)
-        print(json.dumps({"rate": rate, "platform": platform}))
+    if "--stage-one" in sys.argv:
+        cfg = json.loads(sys.argv[sys.argv.index("--stage-one") + 1])
+        out = measure_one(cfg)
+        print(json.dumps(out))
+    elif "--stage-ab" in sys.argv:
+        stage_ab()
     else:
         main()

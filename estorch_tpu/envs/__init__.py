@@ -3,17 +3,23 @@ from .base import EnvSpec, JaxEnv
 from .cartpole import CartPole
 from .mountain_car import MountainCarContinuous
 from .mountain_car_discrete import MountainCar
+from .locomotion import Cheetah2D, Hopper2D, Swimmer2D
 from .pendulum import Pendulum
 from .rollout import RolloutResult, make_population_rollout, make_rollout, select_action
+from .synthetic import SyntheticEnv
 
 __all__ = [
     "Acrobot",
     "EnvSpec",
     "JaxEnv",
     "CartPole",
+    "Cheetah2D",
+    "Hopper2D",
+    "Swimmer2D",
     "MountainCar",
     "MountainCarContinuous",
     "Pendulum",
+    "SyntheticEnv",
     "RolloutResult",
     "make_population_rollout",
     "make_rollout",

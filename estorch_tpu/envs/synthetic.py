@@ -1,0 +1,59 @@
+"""Synthetic benchmark env: configurable obs dim, negligible step cost.
+
+Exists for throughput benchmarking at Humanoid-like observation sizes
+(obs 376 / act 17) without MuJoCo physics: the reference benchmarks ES on
+MuJoCo tasks where virtually all device FLOPs are the policy forward (the
+physics run on CPU workers, SURVEY.md §3.3); this env reproduces that FLOP
+profile on-device — elementwise-only dynamics (O(obs_dim) per step, ~1e-3
+of the policy matmul cost at Humanoid size) so a measured env-steps/sec is
+an honest policy-throughput number, not inflated by a trivial policy or
+deflated by synthetic physics.
+
+Never terminates (like Pendulum), so every scanned step is a live step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class SyntheticEnv:
+    """Leaky shift-register dynamics driven by the action.
+
+    state' = a·state + b·roll(state, 1) + scatter(action);  obs = state;
+    reward = -mean(state²).  Chaotic enough that the observation stream is
+    not constant (policy inputs vary step to step, defeating value reuse),
+    cheap enough that the policy forward dominates.
+    """
+
+    obs_dim: int = 376
+    action_dim: int = 17
+    discrete: bool = False
+    default_horizon: int = 200
+    bc_dim: int = 2
+    action_bound: float = 1.0
+    # |decay + mix·e^{iθ}| ≤ 0.99 < 1: the linear part is contractive, so
+    # bounded actions give bounded state (steady-state ≲ 0.1/(1-0.99) = 10)
+    decay: float = 0.95
+    mix: float = 0.04
+
+    def reset(self, key: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        state = 0.1 * jax.random.normal(key, (self.obs_dim,))
+        return state, state
+
+    def step(self, state, action):
+        act = jnp.clip(jnp.atleast_1d(action), -1.0, 1.0)
+        drive = jnp.zeros((self.obs_dim,)).at[: self.action_dim].set(act)
+        new_state = (
+            self.decay * state + self.mix * jnp.roll(state, 1) + 0.1 * drive
+        )
+        reward = -jnp.mean(new_state**2)
+        return new_state, new_state, reward, jnp.bool_(False)
+
+    def behavior(self, state, obs) -> jax.Array:
+        return state[: self.bc_dim]

@@ -154,6 +154,9 @@ def _choose_eval_chunk(requested: int, local_members: int) -> int:
     return c
 
 
+NOISE_KERNEL_MAX_DIM = 1_000_000  # 3·dim f32 ≈ 12 MiB of ~16 MiB v5e VMEM
+
+
 class ESEngine:
     """Compiles and caches the per-generation XLA programs for one setup."""
 
@@ -194,6 +197,16 @@ class ESEngine:
                     "(ops/pallas_noise.py::mlp_streamed_apply for MLPPolicy)"
                 )
         self._streamed_apply = streamed_apply
+        if config.noise_kernel and spec.dim > NOISE_KERNEL_MAX_DIM:
+            # weighted_noise_sum holds 3·dim f32 in VMEM (double buffer +
+            # accumulator, ops/pallas_noise.py) — past ~1M params that blows
+            # the ~16 MiB v5e VMEM budget as an opaque Mosaic error, so fail
+            # loudly here instead (chunked pure-JAX reduction handles any dim)
+            raise ValueError(
+                f"noise_kernel=True supports up to {NOISE_KERNEL_MAX_DIM:,} "
+                f"params (3·dim f32 must fit VMEM); got dim={spec.dim:,}. "
+                "Drop noise_kernel to use the chunked pure-JAX reduction."
+            )
         if config.compute_dtype not in ("float32", "bfloat16"):
             raise ValueError(
                 f"compute_dtype must be float32 or bfloat16, got {config.compute_dtype!r}"

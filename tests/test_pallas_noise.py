@@ -302,3 +302,28 @@ class TestEngineNoiseKernel:
 
         with pytest.raises(ValueError, match="noise_kernel"):
             ES(P, A, torch.optim.Adam, population_size=4, noise_kernel=True)
+
+
+def test_noise_kernel_rejects_dims_past_vmem_budget():
+    """>1M params with noise_kernel=True must fail loudly at construction
+    (3·dim f32 VMEM cost, parallel/engine.py::NOISE_KERNEL_MAX_DIM), not as
+    an opaque Mosaic compile error inside the generation step."""
+    import optax
+
+    from estorch_tpu import ES, JaxAgent, MLPPolicy
+    from estorch_tpu.envs import SyntheticEnv
+
+    env = SyntheticEnv()  # obs 376: hidden 1024x1024 → ~1.45M params
+    with pytest.raises(ValueError, match="noise_kernel.*1,000,000"):
+        ES(
+            policy=MLPPolicy,
+            agent=JaxAgent,
+            optimizer=optax.adam,
+            population_size=8,
+            policy_kwargs={"action_dim": env.action_dim,
+                           "hidden": (1024, 1024), "discrete": False},
+            agent_kwargs={"env": env, "horizon": 10},
+            optimizer_kwargs={"learning_rate": 1e-2},
+            table_size=1 << 21,
+            noise_kernel=True,
+        )
