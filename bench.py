@@ -11,7 +11,10 @@ population 4096, horizon 200: ~819k env steps per generation.
 
 extras: a Humanoid-sized-policy point (SyntheticEnv obs 376 → 256×256 → 17,
 the __graft_entry__ flagship shape) and a pop-10240 point, each with an MFU
-estimate (policy-forward FLOPs vs a v5e bf16 peak of 197 TFLOP/s).
+estimate.  "mfu" is always policy-forward FLOPs against the v5e bf16 peak
+(197 TFLOP/s) regardless of config dtype — one fixed denominator keeps
+cross-dtype A/B numbers comparable — and is null off-TPU (a CPU rate
+against a TPU peak means nothing).
 
 vs_baseline: ratio against a reference-style estorch loop measured live on
 this host — per-member Python loop, torch CPU MLP forward per step,
@@ -22,8 +25,9 @@ core count for a per-core figure if comparing to the 720-core runs).
 Stage protocol (each stage is a child process so a tunnel wedge in one
 measurement cannot take down the bench — round-1 lesson):
     bench.py --stage-one '<json cfg>'   measure one config, print one JSON
-    bench.py --stage-ab                 run the full A/B matrix (standard /
-                                        decomposed / streamed × f32 / bf16),
+    bench.py --stage-ab                 run the curated A/B subset (see
+                                        AB_MATRIX; not a full cross — e.g.
+                                        streamed is f32-only by design),
                                         one JSON line per config as it lands
     bench.py                            headline + extras, the driver entry
 """
@@ -103,11 +107,14 @@ def measure_one(cfg, force_cpu=False):
     steps = sum(r["env_steps"] for r in es.history[-gens:])
     n_chips = es.mesh.devices.size
     rate = steps / dt / n_chips
+    platform = es.mesh.devices.flat[0].platform
     return {
         "rate": rate,
-        "platform": es.mesh.devices.flat[0].platform,
+        "platform": platform,
         "dtype": dtype,
-        "mfu": rate * policy_flops_per_member_step(cfg) / V5E_BF16_PEAK,
+        # fixed bf16-peak denominator (see module docstring); null off-TPU
+        "mfu": (rate * policy_flops_per_member_step(cfg) / V5E_BF16_PEAK
+                if platform == "tpu" else None),
         "cfg": cfg,
     }
 
@@ -160,7 +167,8 @@ def run_stage(cfg, timeout_s=480):
         last = [ln for ln in r.stdout.strip().splitlines()
                 if ln.startswith("{")][-1]
         out = json.loads(last)
-        float(out["rate"]), str(out["platform"]), str(out["dtype"])  # validate
+        float(out["rate"]), str(out["platform"]), str(out["dtype"])
+        _ = out["mfu"]  # may be null off-TPU, but the key must exist
         return out
     except (IndexError, KeyError, TypeError, ValueError):
         print(f"bench: stage output unparseable cfg={cfg}; stdout tail:\n"
@@ -209,13 +217,15 @@ def main():
     on_tpu = platform == "tpu"
     base_rate = measure_reference_style_baseline()
 
-    extras = {"mfu_headline": round(result["mfu"], 6)}
+    mfu = result["mfu"]
+    extras = {"mfu_headline": round(mfu, 6) if mfu is not None else None}
     if on_tpu:
         for name, base in (("big_policy", BIG), ("pop10k", POP10K)):
             r = run_stage({**base, "decomposed": True, "gens": 3},
                           timeout_s=600)
             extras[name] = (
-                {"rate": round(r["rate"], 1), "mfu": round(r["mfu"], 6),
+                {"rate": round(r["rate"], 1),
+                 "mfu": round(r["mfu"], 6) if r["mfu"] is not None else None,
                  "dtype": r["dtype"]}
                 if r else None
             )

@@ -103,6 +103,78 @@ def cartpole_smoke(**over):
     return ES(**kw)
 
 
+def swimmer2d_device(**over):
+    """Device-native locomotion: pure-JAX planar swimmer, whole generation
+    compiled on-chip (envs/locomotion.py — the MJX-fallback path)."""
+    import optax
+
+    from . import ES, JaxAgent, MLPPolicy
+    from .envs import Swimmer2D
+
+    env = Swimmer2D()
+    kw = dict(
+        policy=MLPPolicy,
+        agent=JaxAgent,
+        optimizer=optax.adam,
+        population_size=512,
+        sigma=0.08,
+        policy_kwargs={"action_dim": env.action_dim, "hidden": (32, 32),
+                       "discrete": False, "action_scale": 1.0},
+        agent_kwargs={"env": env, "horizon": 300},
+        optimizer_kwargs={"learning_rate": 3e-2},
+    )
+    kw.update(over)
+    return ES(**kw)
+
+
+def hopper2d_device(**over):
+    """Device-native locomotion with contact + falling termination: pure-JAX
+    planar hopper (envs/locomotion.py), Hopper-class difficulty."""
+    import optax
+
+    from . import ES, JaxAgent, MLPPolicy
+    from .envs import Hopper2D
+
+    env = Hopper2D()
+    kw = dict(
+        policy=MLPPolicy,
+        agent=JaxAgent,
+        optimizer=optax.adam,
+        population_size=1024,
+        sigma=0.08,
+        policy_kwargs={"action_dim": env.action_dim, "hidden": (64, 64),
+                       "discrete": False, "action_scale": 1.0},
+        agent_kwargs={"env": env, "horizon": 400},
+        optimizer_kwargs={"learning_rate": 2e-2},
+    )
+    kw.update(over)
+    return ES(**kw)
+
+
+def cheetah2d_device(**over):
+    """Device-native locomotion, 7-body planar runner (HalfCheetah-class):
+    the on-chip stand-in for BASELINE config 2 until mjx is installable."""
+    import optax
+
+    from . import ES, JaxAgent, MLPPolicy
+    from .envs import Cheetah2D
+
+    env = Cheetah2D()
+    kw = dict(
+        policy=MLPPolicy,
+        agent=JaxAgent,
+        optimizer=optax.adam,
+        population_size=1024,
+        sigma=0.08,
+        policy_kwargs={"action_dim": env.action_dim, "hidden": (64, 64),
+                       "discrete": False, "action_scale": 1.0},
+        agent_kwargs={"env": env, "horizon": 500},
+        optimizer_kwargs={"learning_rate": 2e-2},
+    )
+    kw.update(over)
+    return ES(**kw)
+
+
 def halfcheetah_vbn(**over):
     """BASELINE config 2 — HalfCheetah MLP+VBN, population 1k (host path)."""
     import torch
@@ -238,6 +310,9 @@ def _freeze_host_vbn(es) -> None:
 
 CONFIGS: dict[str, Callable] = {
     "cartpole_smoke": cartpole_smoke,
+    "swimmer2d_device": swimmer2d_device,
+    "hopper2d_device": hopper2d_device,
+    "cheetah2d_device": cheetah2d_device,
     "halfcheetah_vbn": halfcheetah_vbn,
     "humanoid_mirrored": humanoid_mirrored,
     "humanoid_nsres": humanoid_nsres,

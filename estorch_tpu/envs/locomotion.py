@@ -1,0 +1,453 @@
+"""Pure-JAX planar locomotion: articulated chains with soft joints/contact.
+
+Device-native MuJoCo-class locomotion (SURVEY.md §7 Path A: the north-star
+route is physics *inside* the compiled generation program; `mujoco.mjx` is
+not importable in this image, so this module provides the fallback the
+round-1 verdict called for — "a pure-JAX locomotion env of honest
+difficulty").
+
+Physics formulation (chosen for XLA, not copied from anywhere): maximal
+coordinates — every body carries (position, angle, velocity, angular
+velocity) — with joints enforced as stiff spring-dampers between anchor
+points and ground contact as a penalty spring with regularized Coulomb
+friction, integrated by semi-implicit Euler at a small physics dt with an
+action frame-skip.  This is the standard "soft/spring" rigid-body scheme
+(the same family brax's spring backend and classic game physics use): every
+step is a fixed small stack of elementwise ops over (n_bodies, …) arrays —
+no constraint solver, no data-dependent branching — so a whole episode
+compiles into one ``lax.scan`` and a population of episodes into one
+``vmap`` over it, exactly like the classic-control envs (envs/base.py).
+
+Honesty of difficulty: the tasks reward forward velocity with control
+costs, terminate on falling (hopper), and are deceptive enough that random
+policies score ~0; they are NOT step-for-step MuJoCo ports (different
+integrator, soft joints) and make no parity claim — reward scales are
+task-local.  MuJoCo-the-library stays supported on the host/pooled paths
+(envs/gym_vec_pool.py).
+
+Bodies are rods of half-length ``half_len`` with anchors at their two ends;
+a chain is described by joint rows (parent, child, parent_end, child_end,
+angle offset, limits, motor gear).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+# Physics state: a dict pytree of (n_bodies,) or (n_bodies, 2) arrays plus
+# a step counter — see _init_state.
+
+
+def _rot(theta):
+    c, s = jnp.cos(theta), jnp.sin(theta)
+    return jnp.stack([jnp.stack([c, -s], -1), jnp.stack([s, c], -1)], -2)
+
+
+@dataclasses.dataclass(frozen=True)
+class _Chain:
+    """Static description of a planar articulated chain (tuples: hashable,
+
+    closed over at trace time; converted to jnp constants inside step)."""
+
+    # per body
+    mass: tuple
+    half_len: tuple
+    init_pos: tuple  # (x, y) world
+    init_angle: tuple
+    # per joint: (parent, child) body indices and which end of each
+    parent: tuple
+    child: tuple
+    parent_end: tuple  # +1 → tip (+half_len side), -1 → tail
+    child_end: tuple
+    rest_angle: tuple  # child minus parent rest angle
+    limit_lo: tuple
+    limit_hi: tuple
+    gear: tuple  # motor ANGULAR authority per joint (rad/s²): torque =
+    # gear · action · I_red, with I_red the joint's reduced inertia — so a
+    # unit action accelerates any joint comparably regardless of how light
+    # the child body is (absolute torques made foot joints, I ~1e-3,
+    # integrate at Δω ≈ 30 rad/s per physics step → instant blow-up)
+    # world
+    gravity: float = -9.81
+    ground: bool = True
+    # spring/damper constants (per unit mass of the lighter body)
+    k_joint: float = 4000.0
+    c_joint: float = 60.0
+    # angular constants, all scaled by the joint's reduced inertia: spring
+    # frequency √k_limit and damping rates c_limit/joint_damping are then
+    # joint-independent, and explicit-integration stability is one global
+    # check (dt·√k ≲ 0.5, dt·c ≲ 0.5) instead of per-body luck
+    k_limit: float = 8000.0
+    c_limit: float = 100.0
+    joint_damping: float = 30.0
+    k_contact: float = 3000.0
+    c_contact: float = 30.0
+    friction: float = 1.0
+    drag: float = 0.0  # linear drag (swimmer's fluid); 0 on land
+    angular_drag: float = 0.0
+    dt: float = 0.002
+    frame_skip: int = 8
+
+    @property
+    def n_bodies(self):
+        return len(self.mass)
+
+    @property
+    def n_joints(self):
+        return len(self.parent)
+
+
+def _solve_init_positions(chain: _Chain) -> tuple:
+    """Derive init positions so every joint's anchors coincide exactly.
+
+    Hand-specified positions inevitably leave anchor gaps that the stiff
+    joint springs turn into huge t=0 forces; only the root position and the
+    per-body angles are trusted, the rest follows from the joint graph
+    (joints are listed parent-before-child).  Pure NumPy at construction.
+    """
+    import numpy as np
+
+    pos = [np.asarray(p, np.float64) for p in chain.init_pos]
+    ang = [float(a) for a in chain.init_angle]
+
+    def end_off(i, end):
+        return np.array([np.cos(ang[i]), np.sin(ang[i])]) * end * chain.half_len[i]
+
+    for j in range(chain.n_joints):
+        p, c = chain.parent[j], chain.child[j]
+        anchor = pos[p] + end_off(p, chain.parent_end[j])
+        pos[c] = anchor - end_off(c, chain.child_end[j])
+    return tuple((float(p[0]), float(p[1])) for p in pos)
+
+
+def _anchor_world(pos, theta, half_len, end):
+    """World coordinates of a rod end: pos + R(θ)·(end·half_len, 0)."""
+    local = jnp.stack([end * half_len, jnp.zeros_like(half_len)], -1)
+    return pos + jnp.einsum("...ij,...j->...i", _rot(theta), local), local
+
+
+def _physics_step(chain: _Chain, state, motor_torque):
+    """One semi-implicit Euler step of the whole chain. Pure, jit-safe."""
+    pos, theta = state["pos"], state["theta"]  # (B,2), (B,)
+    vel, omega = state["vel"], state["omega"]
+
+    mass = jnp.asarray(chain.mass)
+    half = jnp.asarray(chain.half_len)
+    inertia = mass * (2 * half) ** 2 / 12.0 + 1e-6  # rod about center
+
+    force = jnp.zeros_like(pos)
+    torque = jnp.zeros_like(theta)
+
+    # gravity
+    force = force.at[:, 1].add(mass * chain.gravity)
+
+    # fluid / air drag (swimmer locomotion medium)
+    if chain.drag:
+        # anisotropic rod drag: normal component resisted ~30x the axial —
+        # this asymmetry is what makes undulation propel the swimmer
+        tang = jnp.stack([jnp.cos(theta), jnp.sin(theta)], -1)
+        v_ax = jnp.sum(vel * tang, -1, keepdims=True) * tang
+        v_nrm = vel - v_ax
+        force = force - chain.drag * (0.1 * v_ax + 3.0 * v_nrm) * (2 * half)[:, None]
+        torque = torque - chain.angular_drag * omega * (2 * half) ** 3
+
+    pj = jnp.asarray(chain.parent, jnp.int32)
+    cj = jnp.asarray(chain.child, jnp.int32)
+    pe = jnp.asarray(chain.parent_end)
+    ce = jnp.asarray(chain.child_end)
+
+    # --- joints: stiff spring-damper pulling the two anchors together ---
+    a_w, a_loc = _anchor_world(pos[pj], theta[pj], half[pj], pe)
+    b_w, b_loc = _anchor_world(pos[cj], theta[cj], half[cj], ce)
+    # anchor world velocities: v + ω × r  (2-D cross: ω×(x,y) = (-ωy, ωx))
+    a_r = a_w - pos[pj]
+    b_r = b_w - pos[cj]
+    a_v = vel[pj] + jnp.stack([-omega[pj] * a_r[:, 1], omega[pj] * a_r[:, 0]], -1)
+    b_v = vel[cj] + jnp.stack([-omega[cj] * b_r[:, 1], omega[cj] * b_r[:, 0]], -1)
+    m_eff = jnp.minimum(mass[pj], mass[cj])
+    f_j = (-chain.k_joint * (a_w - b_w) - chain.c_joint * (a_v - b_v)) * m_eff[:, None]
+
+    # joint angle, limits, motors (equal/opposite torques on the pair)
+    q = theta[cj] - theta[pj] - jnp.asarray(chain.rest_angle)
+    qdot = omega[cj] - omega[pj]
+    lo, hi = jnp.asarray(chain.limit_lo), jnp.asarray(chain.limit_hi)
+    i_red = inertia[pj] * inertia[cj] / (inertia[pj] + inertia[cj])
+    t_lim = (
+        chain.k_limit * (jnp.maximum(lo - q, 0.0) - jnp.maximum(q - hi, 0.0))
+        - chain.c_limit * qdot * ((q < lo) | (q > hi))
+    ) * i_red
+    t_act = jnp.asarray(chain.gear) * motor_torque * i_red
+    t_damp = -chain.joint_damping * qdot * i_red
+    t_pair = t_lim + t_act + t_damp
+
+    # scatter joint forces/torques to bodies
+    force = force.at[pj].add(f_j).at[cj].add(-f_j)
+    cross_a = a_r[:, 0] * f_j[:, 1] - a_r[:, 1] * f_j[:, 0]
+    cross_b = b_r[:, 0] * (-f_j[:, 1]) - b_r[:, 1] * (-f_j[:, 0])
+    torque = torque.at[pj].add(cross_a - t_pair).at[cj].add(cross_b + t_pair)
+
+    # --- ground contact at both rod ends (penalty + regularized friction) ---
+    if chain.ground:
+        for end in (-1.0, 1.0):
+            p_w, _ = _anchor_world(pos, theta, half, jnp.full_like(half, end))
+            r = p_w - pos
+            v_p = vel + jnp.stack([-omega * r[:, 1], omega * r[:, 0]], -1)
+            depth = jnp.minimum(p_w[:, 1], 0.0)  # ≤0 when penetrating
+            fn = (-chain.k_contact * depth - chain.c_contact * v_p[:, 1] * (depth < 0)) * mass
+            fn = jnp.maximum(fn, 0.0) * (depth < 0)
+            ft = -chain.friction * fn * jnp.tanh(v_p[:, 0] / 0.1)
+            f_c = jnp.stack([ft, fn], -1)
+            force = force + f_c
+            torque = torque + r[:, 0] * f_c[:, 1] - r[:, 1] * f_c[:, 0]
+
+    # --- semi-implicit Euler ---
+    vel = vel + chain.dt * force / mass[:, None]
+    omega = omega + chain.dt * torque / inertia
+    pos = pos + chain.dt * vel
+    theta = theta + chain.dt * omega
+    return {"pos": pos, "theta": theta, "vel": vel, "omega": omega,
+            "t": state["t"]}
+
+
+def _init_state(chain: _Chain, key):
+    pos = jnp.asarray(chain.init_pos, jnp.float32)
+    theta = jnp.asarray(chain.init_angle, jnp.float32)
+    # small random perturbation (MuJoCo-style reset noise)
+    k1, k2 = jax.random.split(key)
+    theta = theta + 0.01 * jax.random.normal(k1, theta.shape)
+    vel = 0.01 * jax.random.normal(k2, pos.shape)
+    return {"pos": pos, "theta": theta, "vel": vel,
+            "omega": jnp.zeros_like(theta), "t": jnp.int32(0)}
+
+
+class _PlanarBase:
+    """Shared JaxEnv plumbing over a _Chain; subclasses define chain,
+
+    observation, reward, and termination."""
+
+    chain: _Chain
+    discrete: bool = False
+    action_bound: float = 1.0
+
+    def _obs(self, state):
+        raise NotImplementedError
+
+    def _reward_done(self, prev, state, action):
+        raise NotImplementedError
+
+    def _finalize_chain(self, chain: _Chain):
+        """Snap init positions to the joint graph and install the chain."""
+        chain = dataclasses.replace(chain, init_pos=_solve_init_positions(chain))
+        object.__setattr__(self, "chain", chain)
+
+    def reset(self, key: jax.Array):
+        state = _init_state(self.chain, key)
+        return state, self._obs(state)
+
+    def step(self, state, action):
+        act = jnp.clip(jnp.atleast_1d(action), -1.0, 1.0)
+
+        def body(s, _):
+            return _physics_step(self.chain, s, act), None
+
+        new_state, _ = jax.lax.scan(body, state, None,
+                                    length=self.chain.frame_skip)
+        new_state = dict(new_state, t=state["t"] + 1)
+        reward, done = self._reward_done(state, new_state, act)
+        return new_state, self._obs(new_state), reward, done
+
+    def behavior(self, state, obs) -> jax.Array:
+        """BC = final torso (x, y) — where the gait carried the body."""
+        return state["pos"][0]
+
+    @property
+    def control_dt(self):
+        return self.chain.dt * self.chain.frame_skip
+
+
+def _joint_angles(chain, state):
+    pj = jnp.asarray(chain.parent, jnp.int32)
+    cj = jnp.asarray(chain.child, jnp.int32)
+    return state["theta"][cj] - state["theta"][pj] - jnp.asarray(chain.rest_angle)
+
+
+def _joint_rates(chain, state):
+    pj = jnp.asarray(chain.parent, jnp.int32)
+    cj = jnp.asarray(chain.child, jnp.int32)
+    return state["omega"][cj] - state["omega"][pj]
+
+
+@dataclasses.dataclass(frozen=True)
+class Swimmer2D(_PlanarBase):
+    """3-link planar swimmer in a viscous medium (MuJoCo Swimmer-class).
+
+    Contact-free, gravity-free: propulsion comes purely from anisotropic
+    fluid drag on the undulating chain — the easiest honest locomotion task
+    (nothing to fall over), ideal as the device-native default.
+    Reward: head forward velocity − control cost.
+    """
+
+    n_links: int = 3
+    obs_dim: int = 10  # 2·n_links angles/rates + head vel (2) + joint angles
+    action_dim: int = 2  # n_links − 1
+    default_horizon: int = 500
+    bc_dim: int = 2
+
+    def __post_init__(self):
+        n = self.n_links
+        hl = 0.5
+        chain = _Chain(
+            mass=(1.0,) * n,
+            half_len=(hl,) * n,
+            init_pos=tuple((-(2 * hl) * i, 0.0) for i in range(n)),
+            init_angle=(0.0,) * n,
+            parent=tuple(range(n - 1)),
+            child=tuple(range(1, n)),
+            parent_end=(-1.0,) * (n - 1),  # tail of parent…
+            child_end=(1.0,) * (n - 1),  # …to tip of child
+            rest_angle=(0.0,) * (n - 1),
+            limit_lo=(-1.75,) * (n - 1),
+            limit_hi=(1.75,) * (n - 1),
+            gear=(300.0,) * (n - 1),
+            gravity=0.0,
+            ground=False,
+            drag=4.0,
+            angular_drag=2.0,
+            c_joint=30.0,
+            dt=0.002,
+            frame_skip=10,
+        )
+        self._finalize_chain(chain)
+        object.__setattr__(self, "obs_dim", 2 * (n - 1) + n + 2)
+        object.__setattr__(self, "action_dim", n - 1)
+
+    def _obs(self, state):
+        return jnp.concatenate([
+            _joint_angles(self.chain, state),
+            _joint_rates(self.chain, state) * 0.1,
+            state["theta"],  # absolute link angles (heading)
+            state["vel"][0] * 0.5,  # head velocity
+        ])
+
+    def _reward_done(self, prev, state, action):
+        vx = (state["pos"][0, 0] - prev["pos"][0, 0]) / self.control_dt
+        reward = vx - 1e-4 * jnp.sum(action**2)
+        return reward, jnp.bool_(False)
+
+
+@dataclasses.dataclass(frozen=True)
+class Hopper2D(_PlanarBase):
+    """Planar one-legged hopper (MuJoCo Hopper-class): torso–thigh–shin–foot.
+
+    Ground contact + gravity; terminates when the torso falls.  Reward:
+    alive bonus + forward velocity − control cost (the MuJoCo shaping).
+    """
+
+    obs_dim: int = 11
+    action_dim: int = 3
+    default_horizon: int = 500
+    bc_dim: int = 2
+
+    def __post_init__(self):
+        # bodies: 0 torso (upright rod), 1 thigh, 2 shin, 3 foot (horizontal)
+        chain = _Chain(
+            mass=(3.5, 1.0, 1.0, 0.6),
+            half_len=(0.2, 0.2, 0.25, 0.13),
+            init_pos=((0.0, 1.05), (0.0, 0.65), (0.0, 0.2), (0.06, -0.05)),
+            init_angle=(jnp.pi / 2, jnp.pi / 2, jnp.pi / 2, 0.0),
+            parent=(0, 1, 2),
+            child=(1, 2, 3),
+            parent_end=(-1.0, -1.0, -1.0),
+            child_end=(1.0, 1.0, -1.0),
+            rest_angle=(0.0, 0.0, -jnp.pi / 2),
+            limit_lo=(-0.3, -1.5, -0.6),
+            limit_hi=(1.5, 0.1, 0.6),
+            gear=(800.0, 800.0, 500.0),
+            gravity=-9.81,
+            ground=True,
+            dt=0.002,
+            frame_skip=8,
+        )
+        self._finalize_chain(chain)
+
+    def _obs(self, state):
+        torso = state["pos"][0]
+        return jnp.concatenate([
+            jnp.array([torso[1], state["theta"][0] - jnp.pi / 2]),
+            _joint_angles(self.chain, state),
+            state["vel"][0] * 0.3,
+            jnp.array([state["omega"][0] * 0.1]),
+            _joint_rates(self.chain, state) * 0.1,
+        ])
+
+    def _reward_done(self, prev, state, action):
+        vx = (state["pos"][0, 0] - prev["pos"][0, 0]) / self.control_dt
+        reward = 1.0 + vx - 1e-3 * jnp.sum(action**2)
+        height = state["pos"][0, 1]
+        upright = jnp.abs(state["theta"][0] - jnp.pi / 2)
+        done = (height < 0.6) | (upright > 0.7)
+        return reward, done
+
+
+@dataclasses.dataclass(frozen=True)
+class Cheetah2D(_PlanarBase):
+    """Planar two-legged runner (MuJoCo HalfCheetah-class): 7 bodies.
+
+    Torso with back leg (thigh–shin) and front leg (thigh–shin) plus a
+    head/neck rod for mass distribution.  Never terminates (cheetah-style);
+    reward: forward velocity − control cost.
+    """
+
+    obs_dim: int = 17
+    action_dim: int = 6
+    default_horizon: int = 500
+    bc_dim: int = 2
+
+    def __post_init__(self):
+        # 0 torso (horizontal), 1 bthigh, 2 bshin, 3 bfoot, 4 fthigh,
+        # 5 fshin, 6 ffoot
+        chain = _Chain(
+            mass=(6.0, 1.5, 1.2, 0.8, 1.4, 1.1, 0.7),
+            half_len=(0.5, 0.15, 0.15, 0.09, 0.13, 0.12, 0.07),
+            # only the torso position is trusted; leg positions are solved
+            # from the joint graph (θ≈+π/2 + attach-by-tip ⇒ hangs below,
+            # the same convention the hopper uses)
+            init_pos=((0.0, 0.56),) + ((0.0, 0.0),) * 6,
+            init_angle=(
+                0.0,
+                jnp.pi / 2 + 0.3, jnp.pi / 2 - 0.5, 0.1,
+                jnp.pi / 2 - 0.3, jnp.pi / 2 + 0.4, 0.0,
+            ),
+            parent=(0, 1, 2, 0, 4, 5),
+            child=(1, 2, 3, 4, 5, 6),
+            parent_end=(-1.0, -1.0, -1.0, 1.0, -1.0, -1.0),
+            child_end=(1.0, 1.0, -1.0, 1.0, 1.0, -1.0),
+            rest_angle=(jnp.pi / 2 + 0.3, -0.8, 0.6 - jnp.pi / 2,
+                        jnp.pi / 2 - 0.3, 0.7, -jnp.pi / 2 - 0.4),
+            limit_lo=(-0.6, -0.8, -0.5, -0.8, -0.7, -0.5),
+            limit_hi=(1.0, 0.8, 0.5, 0.8, 0.7, 0.5),
+            gear=(700.0, 500.0, 300.0, 700.0, 500.0, 300.0),
+            gravity=-9.81,
+            ground=True,
+            dt=0.002,
+            frame_skip=8,
+        )
+        self._finalize_chain(chain)
+
+    def _obs(self, state):
+        return jnp.concatenate([
+            jnp.array([state["pos"][0, 1], state["theta"][0]]),
+            _joint_angles(self.chain, state),
+            state["vel"][0] * 0.3,
+            jnp.array([state["omega"][0] * 0.1]),
+            _joint_rates(self.chain, state) * 0.1,
+        ])
+
+    def _reward_done(self, prev, state, action):
+        vx = (state["pos"][0, 0] - prev["pos"][0, 0]) / self.control_dt
+        reward = vx - 0.05 * jnp.sum(action**2)
+        return reward, jnp.bool_(False)

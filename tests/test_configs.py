@@ -10,6 +10,9 @@ class TestConfigs:
     def test_all_baseline_configs_present(self):
         assert set(CONFIGS) == {
             "cartpole_smoke",
+            "swimmer2d_device",
+            "hopper2d_device",
+            "cheetah2d_device",
             "halfcheetah_vbn",
             "humanoid_mirrored",
             "humanoid_nsres",
@@ -23,6 +26,21 @@ class TestConfigs:
         es.train(2, verbose=False)
         assert es.backend == "device"
         assert len(es.history) == 2
+
+    def test_locomotion_configs_run_device_path(self):
+        from estorch_tpu.configs import (
+            cheetah2d_device,
+            hopper2d_device,
+            swimmer2d_device,
+        )
+
+        # hopper included deliberately: it is the one locomotion env with a
+        # termination path (falling) through the rollout done-mask
+        for recipe in (swimmer2d_device, hopper2d_device, cheetah2d_device):
+            es = recipe(population_size=16, table_size=1 << 16)
+            es.train(1, verbose=False)
+            assert es.backend == "device"
+            assert np.isfinite(es.history[0]["reward_mean"])
 
     def test_halfcheetah_vbn_runs_host_path(self):
         es = halfcheetah_vbn(population_size=16)

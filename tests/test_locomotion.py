@@ -1,0 +1,163 @@
+"""Pure-JAX planar locomotion envs (envs/locomotion.py).
+
+Covers: the JaxEnv contract under jit/scan, geometric consistency of the
+solved init pose, integration stability under random torques, termination
+semantics, and end-to-end ES learnability on the swimmer (the device-native
+MuJoCo-class path the round-1 verdict called for).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from estorch_tpu.envs import Cheetah2D, Hopper2D, Swimmer2D, make_rollout
+from estorch_tpu.envs.locomotion import _anchor_world
+
+ENVS = [Swimmer2D, Hopper2D, Cheetah2D]
+
+
+@pytest.mark.parametrize("Env", ENVS)
+class TestContract:
+    def test_reset_and_obs_shape(self, Env):
+        env = Env()
+        state, obs = env.reset(jax.random.key(0))
+        assert obs.shape == (env.obs_dim,)
+        assert np.all(np.isfinite(np.asarray(obs)))
+
+    def test_step_jits_and_shapes(self, Env):
+        env = Env()
+        state, obs = env.reset(jax.random.key(0))
+        step = jax.jit(env.step)
+        state, obs, r, d = step(state, jnp.zeros(env.action_dim))
+        assert obs.shape == (env.obs_dim,)
+        assert r.shape == () and d.shape == ()
+        assert d.dtype == jnp.bool_
+
+    def test_rollout_scan_compiles(self, Env):
+        env = Env()
+
+        def policy(params, obs):
+            return jnp.tanh(params["w"] @ obs)
+
+        rollout = make_rollout(env, policy, horizon=25)
+        params = {"w": 0.1 * jax.random.normal(jax.random.key(0),
+                                               (env.action_dim, env.obs_dim))}
+        res = jax.jit(rollout)(params, jax.random.key(1))
+        assert np.isfinite(float(res.total_reward))
+        assert res.bc.shape == (env.bc_dim,)
+
+    def test_determinism(self, Env):
+        env = Env()
+        s1, o1 = env.reset(jax.random.key(7))
+        s2, o2 = env.reset(jax.random.key(7))
+        a = jnp.full((env.action_dim,), 0.3)
+        _, o1b, r1, _ = env.step(s1, a)
+        _, o2b, r2, _ = env.step(s2, a)
+        np.testing.assert_array_equal(np.asarray(o1b), np.asarray(o2b))
+        assert float(r1) == float(r2)
+
+    def test_init_joint_anchors_coincide(self, Env):
+        """_solve_init_positions must leave zero anchor gap at every joint
+        (gaps become huge t=0 spring forces)."""
+        env = Env()
+        ch = env.chain
+        pos = jnp.asarray(ch.init_pos, jnp.float32)
+        theta = jnp.asarray(ch.init_angle, jnp.float32)
+        half = jnp.asarray(ch.half_len)
+        pj = jnp.asarray(ch.parent, jnp.int32)
+        cj = jnp.asarray(ch.child, jnp.int32)
+        a, _ = _anchor_world(pos[pj], theta[pj], half[pj], jnp.asarray(ch.parent_end))
+        b, _ = _anchor_world(pos[cj], theta[cj], half[cj], jnp.asarray(ch.child_end))
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+    def test_stable_under_random_torques(self, Env):
+        """300 control steps of uniform random actions: finite and bounded
+        (the explicit-integration stability criterion, empirically)."""
+        env = Env()
+        state, obs = env.reset(jax.random.key(0))
+
+        def body(carry, key):
+            state, _ = carry
+            a = jax.random.uniform(key, (env.action_dim,), minval=-1.0, maxval=1.0)
+            state, obs, r, d = env.step(state, a)
+            return (state, obs), obs
+
+        keys = jax.random.split(jax.random.key(1), 300)
+        (_, obs), all_obs = jax.lax.scan(body, (state, obs), keys)
+        assert np.all(np.isfinite(np.asarray(all_obs)))
+        assert float(jnp.max(jnp.abs(all_obs))) < 50.0
+
+
+class TestSemantics:
+    def test_hopper_terminates_on_fall(self):
+        env = Hopper2D()
+        state, _ = env.reset(jax.random.key(0))
+        # drop the torso below the height threshold
+        state = dict(state, pos=state["pos"].at[0, 1].set(0.3))
+        _, _, _, done = env.step(state, jnp.zeros(env.action_dim))
+        assert bool(done)
+
+    def test_swimmer_needs_actuation_to_move(self):
+        """No gravity, no contact: with zero torques the swimmer must stay
+        essentially where it started (drag kills the reset-noise drift)."""
+        env = Swimmer2D()
+        state, _ = env.reset(jax.random.key(0))
+        step = jax.jit(env.step)
+        for _ in range(100):
+            state, obs, r, d = step(state, jnp.zeros(env.action_dim))
+        assert abs(float(state["pos"][0, 0])) < 0.15
+
+    def test_swimmer_undulation_propels(self):
+        """A hand-written traveling-wave gait must produce net displacement
+        an order of magnitude beyond the passive case — the anisotropic
+        drag actually converts undulation into thrust."""
+        env = Swimmer2D()
+        state, _ = env.reset(jax.random.key(0))
+        step = jax.jit(env.step)
+        for t in range(150):
+            phase = 2 * jnp.pi * t / 25.0
+            a = 0.9 * jnp.sin(phase + jnp.arange(env.action_dim) * 2.0)
+            state, obs, r, d = step(state, a)
+        assert abs(float(state["pos"][0, 0])) > 0.5
+
+    def test_cheetah_settles_without_penetration(self):
+        """Zero action: an unactuated torque-controlled cheetah slumps (as
+        in MuJoCo) — but it must come to REST on the ground plane, not sink
+        through it or jitter forever on the contact springs."""
+        env = Cheetah2D()
+        state, _ = env.reset(jax.random.key(0))
+        step = jax.jit(env.step)
+        for _ in range(200):
+            state, obs, r, d = step(state, jnp.zeros(env.action_dim))
+        ys = np.asarray(state["pos"][:, 1])
+        assert np.all(ys > -0.05), ys  # nothing through the floor
+        ke = float(jnp.sum(state["vel"] ** 2))
+        assert ke < 0.1, ke  # settled, no contact chatter
+
+
+class TestLearnability:
+    def test_swimmer_es_improves(self):
+        """ES on the device path must lift the swimmer's mean return well
+        above the passive score within a small generation budget."""
+        import optax
+
+        from estorch_tpu import ES, JaxAgent, MLPPolicy
+
+        env = Swimmer2D()
+        es = ES(
+            policy=MLPPolicy,
+            agent=JaxAgent,
+            optimizer=optax.adam,
+            population_size=384,
+            sigma=0.08,
+            policy_kwargs={"action_dim": env.action_dim, "hidden": (32,),
+                           "discrete": False, "action_scale": 1.0},
+            agent_kwargs={"env": env, "horizon": 200},
+            optimizer_kwargs={"learning_rate": 3e-2},
+            seed=3,
+        )
+        es.train(15, verbose=False)
+        first = es.history[0]["reward_mean"]
+        last = es.history[-1]["reward_mean"]
+        assert last > first + 30.0, (first, last)
