@@ -316,6 +316,11 @@ class ES:
         from ..parallel.pooled import PooledEngine
 
         spec_info = pool_env_spec(self.agent.env_name)
+        prep = getattr(self.agent, "prep", None)
+        if prep:
+            from ..envs.atari_wrappers import apply_prep_to_spec
+
+            spec_info = apply_prep_to_spec(spec_info, prep["frame_stack"])
         self.env = None
         obs0 = jnp.zeros(spec_info["obs_shape"], jnp.float32)
 
@@ -333,6 +338,7 @@ class ES:
             self.optimizer, self.config, self.mesh,
             n_threads=self.agent.n_threads, seed=self.seed,
             double_buffer=getattr(self.agent, "double_buffer", False),
+            prep=prep,
         )
         self.state = self.engine.init_state(flat, state_key)
 
@@ -342,6 +348,13 @@ class ES:
         from ..envs.gym_vec_pool import make_pool
 
         pool = make_pool(self.agent.env_name, max(1, n // 4))
+        prep = getattr(self.agent, "prep", None)
+        if prep:
+            # VBN statistics must be collected in the policy's actual input
+            # distribution — stacked/repeated frames, not raw ones
+            from ..envs.atari_wrappers import AtariPreprocessPool
+
+            pool = AtariPreprocessPool(pool, seed=self.seed, **prep)
         rng = np.random.default_rng(self.seed)
         frames = [pool.reset()]
         for _ in range(4):

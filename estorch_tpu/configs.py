@@ -261,8 +261,10 @@ def halfcheetah_pooled(**over):
 
 def pong84_conv(**over):
     """Conv-rollout stress without ALE: NatureCNN on the bundled C++ pixel
-    pong (84×84), pooled execution — the same machinery BASELINE config 5
-    exercises, with the env swapped for the in-tree stand-in."""
+    pong (84×84), pooled execution with the full Atari preprocessing stack
+    (4-frame stacking → the CNN's designed 84×84×4 input, action repeat,
+    sticky actions; envs/atari_wrappers.py) — the same machinery BASELINE
+    config 5 exercises, with the env swapped for the in-tree stand-in."""
     import optax
 
     from . import ES, NatureCNN, PooledAgent
@@ -274,7 +276,9 @@ def pong84_conv(**over):
         population_size=256,
         sigma=0.02,
         policy_kwargs={"action_dim": 3, "use_vbn": True},
-        agent_kwargs={"env_name": "pong84", "horizon": 500},
+        agent_kwargs={"env_name": "pong84", "horizon": 500,
+                      "frame_stack": 4, "action_repeat": 2,
+                      "sticky_prob": 0.25},
         optimizer_kwargs={"learning_rate": 1e-2},
         table_size=1 << 23,
     )

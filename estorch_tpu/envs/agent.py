@@ -31,6 +31,25 @@ class PooledAgent:
     n_threads: int = 0
     double_buffer: bool = False  # overlap device forwards with env stepping
     # (two half-population pools; see parallel/pooled.py)
+    # ALE-standard preprocessing (envs/atari_wrappers.py); defaults are
+    # pass-through so non-Atari pooled configs are untouched
+    frame_stack: int = 1
+    action_repeat: int = 1
+    sticky_prob: float = 0.0
+    max_pool2: bool = False
+
+    @property
+    def prep(self) -> dict | None:
+        """Wrapper kwargs, or None when everything is at pass-through."""
+        if (self.frame_stack, self.action_repeat, self.sticky_prob,
+                self.max_pool2) == (1, 1, 0.0, False):
+            return None
+        return {
+            "frame_stack": self.frame_stack,
+            "action_repeat": self.action_repeat,
+            "sticky_prob": self.sticky_prob,
+            "max_pool2": self.max_pool2,
+        }
 
 
 @dataclasses.dataclass

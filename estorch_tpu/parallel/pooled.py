@@ -52,8 +52,10 @@ class PooledEngine:
         n_threads: int = 0,
         seed: int = 0,
         double_buffer: bool = False,
+        prep: dict | None = None,
     ):
         self.env_name = env_name
+        self.prep = dict(prep) if prep else None
         self.spec = spec
         self.config = config
         if config.episodes_per_member != 1:
@@ -77,22 +79,28 @@ class PooledEngine:
         self.core = ESEngine(None, policy_apply, spec, table, optimizer, config, mesh)
         policy_apply = self.core.policy_apply
         self.double_buffer = bool(double_buffer)
+        def _pool(n_envs, threads, pool_seed):
+            pool = make_pool(env_name, n_envs, n_threads=threads, seed=pool_seed)
+            if self.prep:
+                from ..envs.atari_wrappers import AtariPreprocessPool
+
+                pool = AtariPreprocessPool(pool, seed=pool_seed, **self.prep)
+            return pool
+
         if self.double_buffer:
             half = config.population_size // 2
             if half * 2 != config.population_size or half == 0:
                 raise ValueError(
                     "double_buffer needs an even population of at least 2"
                 )
-            self.pool_a = make_pool(env_name, half, n_threads=n_threads, seed=seed)
-            self.pool_b = make_pool(env_name, half, n_threads=n_threads, seed=seed + 10_007)
+            self.pool_a = _pool(half, n_threads, seed)
+            self.pool_b = _pool(half, n_threads, seed + 10_007)
             self.pool = self.pool_a  # dims/metadata accessor
         else:
-            self.pool = make_pool(
-                env_name, config.population_size, n_threads=n_threads, seed=seed
-            )
+            self.pool = _pool(config.population_size, n_threads, seed)
         # n_threads=0 (auto): a 1-env pool gains nothing from threads, and a
         # nonzero value would trip GymVecPool's unused-n_threads warning
-        self.center_pool = make_pool(env_name, 1, n_threads=0, seed=seed + 1)
+        self.center_pool = _pool(1, 0, seed + 1)
         self.bc_dim = self.pool.obs_dim  # BC = final observation
         discrete = self.pool.discrete
         obs_shape = self.pool.obs_shape  # policy-facing shape (pixels etc.)

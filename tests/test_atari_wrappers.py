@@ -1,0 +1,147 @@
+"""AtariPreprocessPool: stack/repeat/sticky semantics + pooled integration."""
+
+import numpy as np
+import pytest
+
+from estorch_tpu.envs.atari_wrappers import AtariPreprocessPool, apply_prep_to_spec
+
+
+class FakePool:
+    """Scripted pool: frame value = its step index (broadcast over pixels),
+
+    env 1 reports done on a chosen step. Mimics the native-pool auto-reset
+    contract (post-done obs is the fresh state)."""
+
+    def __init__(self, n_envs=2, shape=(4, 4, 1), done_at=10**9):
+        self.env_name = "fake"
+        self.n_envs = n_envs
+        self.obs_shape = shape
+        self.obs_dim = int(np.prod(shape))
+        self.act_dim = 1
+        self.discrete = True
+        self.n_actions = 3
+        self.t = 0
+        self.done_at = done_at
+        self.actions_seen = []
+
+    def is_native(self):
+        return True
+
+    def _frame(self):
+        return np.full((self.n_envs, self.obs_dim), float(self.t), np.float32)
+
+    def reset(self):
+        self.t = 0
+        return self._frame()
+
+    def step(self, actions):
+        self.actions_seen.append(np.asarray(actions).copy())
+        self.t += 1
+        rew = np.full(self.n_envs, 1.0, np.float32)
+        done = np.zeros(self.n_envs, bool)
+        if self.t == self.done_at:
+            done[1] = True
+        return self._frame(), rew, done
+
+
+class TestFrameStack:
+    def test_reset_fills_all_slots(self):
+        w = AtariPreprocessPool(FakePool(), frame_stack=4)
+        obs = w.reset()
+        assert w.obs_shape == (4, 4, 4)
+        assert obs.shape == (2, 64)
+        np.testing.assert_array_equal(obs, 0.0)
+
+    def test_stack_orders_oldest_to_newest(self):
+        w = AtariPreprocessPool(FakePool(), frame_stack=4)
+        w.reset()
+        for _ in range(3):
+            obs, _, _ = w.step(np.zeros((2, 1)))
+        frames = obs.reshape(2, 4, 4, 4)
+        # channels should read [0, 1, 2, 3] after three steps from reset 0
+        np.testing.assert_array_equal(frames[0, 0, 0, :], [0.0, 1.0, 2.0, 3.0])
+
+    def test_done_refills_stack_next_step(self):
+        w = AtariPreprocessPool(FakePool(done_at=2), frame_stack=4)
+        w.reset()
+        w.step(np.zeros((2, 1)))
+        obs, _, done = w.step(np.zeros((2, 1)))  # env 1 done here
+        assert done.tolist() == [False, True]
+        obs, _, _ = w.step(np.zeros((2, 1)))
+        frames = obs.reshape(2, 4, 4, 4)
+        # env 0 keeps history; env 1's stack is all the fresh frame
+        np.testing.assert_array_equal(frames[0, 0, 0, :], [0.0, 1.0, 2.0, 3.0])
+        np.testing.assert_array_equal(frames[1, 0, 0, :], [3.0, 3.0, 3.0, 3.0])
+
+    def test_vector_obs_stack_along_new_axis(self):
+        w = AtariPreprocessPool(FakePool(shape=(3,)), frame_stack=2)
+        obs = w.reset()
+        assert w.obs_shape == (3, 2)
+        assert obs.shape == (2, 6)
+
+
+class TestActionRepeatAndSticky:
+    def test_repeat_sums_rewards_and_steps_k_times(self):
+        base = FakePool()
+        w = AtariPreprocessPool(base, frame_stack=1, action_repeat=4)
+        w.reset()
+        obs, rew, done = w.step(np.zeros((2, 1)))
+        assert base.t == 4
+        np.testing.assert_array_equal(rew, 4.0)
+
+    def test_reward_masked_after_mid_repeat_done(self):
+        base = FakePool(done_at=2)
+        w = AtariPreprocessPool(base, frame_stack=1, action_repeat=4)
+        w.reset()
+        obs, rew, done = w.step(np.zeros((2, 1)))
+        # env 1 finished at raw step 2: only 2 of 4 rewards count
+        np.testing.assert_array_equal(rew, [4.0, 2.0])
+        assert done.tolist() == [False, True]
+
+    def test_sticky_replays_previous_action_at_expected_rate(self):
+        base = FakePool(n_envs=512)
+        w = AtariPreprocessPool(base, frame_stack=1, sticky_prob=0.25, seed=7)
+        w.reset()
+        w.step(np.full((512, 1), 2.0))
+        w.step(np.full((512, 1), 1.0))
+        second = base.actions_seen[1]
+        frac_sticky = float(np.mean(second == 2.0))
+        assert 0.15 < frac_sticky < 0.35  # ~Binomial(512, .25)
+
+    def test_first_step_never_sticky(self):
+        base = FakePool()
+        w = AtariPreprocessPool(base, frame_stack=1, sticky_prob=0.99)
+        w.reset()
+        w.step(np.full((2, 1), 2.0))
+        np.testing.assert_array_equal(base.actions_seen[0], 2.0)
+
+    def test_max_pool2_requires_repeat(self):
+        with pytest.raises(ValueError, match="max_pool2"):
+            AtariPreprocessPool(FakePool(), max_pool2=True, action_repeat=1)
+
+
+class TestSpecAdjustment:
+    def test_apply_prep_to_spec(self):
+        spec = {"obs_shape": (84, 84, 1), "obs_dim": 84 * 84, "act_dim": 1,
+                "discrete": True, "n_actions": 3}
+        out = apply_prep_to_spec(spec, 4)
+        assert out["obs_shape"] == (84, 84, 4)
+        assert out["obs_dim"] == 84 * 84 * 4
+        assert out["n_actions"] == 3  # untouched fields preserved
+
+
+class TestPooledIntegration:
+    def test_pong84_naturecnn_designed_input_end_to_end(self):
+        """BASELINE config 5's machinery with the CNN's designed 84x84x4
+        input: one pooled generation through the frame-stacked pong."""
+        import numpy as np
+
+        from estorch_tpu.configs import pong84_conv
+
+        es = pong84_conv(population_size=16, table_size=1 << 22,
+                         agent_kwargs={"env_name": "pong84", "horizon": 40,
+                                       "frame_stack": 4, "action_repeat": 2,
+                                       "sticky_prob": 0.25})
+        assert es.engine.pool.obs_shape == (84, 84, 4)
+        es.train(1, verbose=False)
+        assert np.isfinite(es.history[0]["reward_mean"])
