@@ -16,6 +16,13 @@ from estorch_tpu.envs import CartPole
 
 GOLDENS = {
     "ES": {"reward_means": [43.0, 40.375, 43.5625], "params_sum": -5.57803},
+    # identical values to ES by construction: the decomposition identity
+    # x@(W+cE) = x@W + c(x@E) is exact at these shapes on CPU f32 — if this
+    # golden ever drifts from ES's, the decomposed forward broke
+    "ES_decomposed": {
+        "reward_means": [43.0, 40.375, 43.5625],
+        "params_sum": -5.57803,
+    },
     "NS_ES": {
         "reward_means": [35.125, 36.875, 34.1875],
         "meta_sums": [-5.61163, -1.94561],
@@ -36,9 +43,11 @@ GOLDENS = {
     },
 }
 
-CLASSES = {"ES": ES, "NS_ES": NS_ES, "NSR_ES": NSR_ES, "NSRA_ES": NSRA_ES}
+CLASSES = {"ES": ES, "ES_decomposed": ES, "NS_ES": NS_ES, "NSR_ES": NSR_ES,
+           "NSRA_ES": NSRA_ES}
 EXTRA = {
     "ES": {},
+    "ES_decomposed": {"decomposed": True},
     "NS_ES": {"meta_population_size": 2, "k": 3},
     "NSR_ES": {"meta_population_size": 2, "k": 3},
     "NSRA_ES": {"meta_population_size": 2, "k": 3, "weight": 0.7},
@@ -69,7 +78,7 @@ def test_golden(name):
     g = GOLDENS[name]
     got_means = [round(r["reward_mean"], 4) for r in es.history]
     assert got_means == g["reward_means"], f"{name} reward trajectory changed"
-    if name == "ES":
+    if name.startswith("ES"):
         got = round(float(np.asarray(es.state.params_flat).sum()), 5)
         np.testing.assert_allclose(got, g["params_sum"], atol=2e-4)
     else:
