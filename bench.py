@@ -98,6 +98,7 @@ def measure_one(cfg, force_cpu=False):
         decomposed=cfg.get("decomposed", False),
         noise_kernel=cfg.get("noise_kernel", False),
         streamed=cfg.get("streamed", False),
+        low_rank=cfg.get("low_rank", 0),
     )
     gens = cfg.get("gens", 5)
     es.train(1, verbose=False)  # warm-up generation (compile + AOT sanity)
@@ -191,8 +192,12 @@ AB_MATRIX = [
     ("big/standard/bf16", BIG, {"dtype": "bfloat16"}),
     ("big/decomposed/bf16", BIG, {"dtype": "bfloat16", "decomposed": True}),
     ("big/streamed/f32", BIG, {"dtype": "float32", "streamed": True}),
+    ("big/lowrank1/bf16", BIG, {"dtype": "bfloat16", "low_rank": 1}),
+    ("big/lowrank4/bf16", BIG, {"dtype": "bfloat16", "low_rank": 4}),
     ("pop10k/decomposed/bf16", POP10K,
      {"dtype": "bfloat16", "decomposed": True, "gens": 3}),
+    ("pop10k/lowrank1/bf16", POP10K,
+     {"dtype": "bfloat16", "low_rank": 1, "gens": 3}),
 ]
 
 

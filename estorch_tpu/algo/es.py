@@ -108,6 +108,7 @@ class ES:
         decomposed: bool = False,
         noise_kernel: bool = False,
         streamed: bool = False,
+        low_rank: int = 0,
     ):
         self.population_size = population_size
         self.sigma = sigma
@@ -124,6 +125,7 @@ class ES:
         self._decomposed = bool(decomposed)
         self._noise_kernel = bool(noise_kernel)
         self._streamed = bool(streamed)
+        self._low_rank = int(low_rank)
 
         self._policy_arg = policy
         self._policy_kwargs = dict(policy_kwargs or {})
@@ -158,6 +160,10 @@ class ES:
             if streamed:
                 raise ValueError(
                     "streamed is a device-path option (ops/pallas_noise.py)"
+                )
+            if low_rank:
+                raise ValueError(
+                    "low_rank is a device-path option (ops/lowrank.py)"
                 )
             self.backend = "host"
             self._init_host(
@@ -235,11 +241,29 @@ class ES:
                     module, shared, table_data, offs, c, obs, layer_offs
                 )
 
+        lr_apply, lr_spec = None, None
+        if self._low_rank:
+            from ..models.decomposed import mlp_lowrank_apply, supports_decomposed
+            from ..ops.lowrank import make_lowrank_spec
+
+            if not supports_decomposed(self.module):
+                raise ValueError(
+                    "low_rank currently supports MLPPolicy without VBN "
+                    f"(ops/lowrank.py); got {type(self.module).__name__}"
+                )
+            lr_spec = make_lowrank_spec(self._spec.unravel(flat), self._low_rank)
+            module = self.module
+
+            def lr_apply(shared, lrn, c, obs):
+                return mlp_lowrank_apply(module, shared, lrn, c, obs)
+
         self.engine = ESEngine(
             self.env, self._policy_apply, self._spec, self.table,
             self.optimizer, self.config, self.mesh,
             decomposed_apply=dec_apply,
             streamed_apply=str_apply,
+            lowrank_apply=lr_apply,
+            lowrank_spec=lr_spec,
         )
         self.state = self.engine.init_state(flat, state_key)
         self._post_engine_init()
@@ -294,6 +318,7 @@ class ES:
             decomposed=self._decomposed,
             noise_kernel=self._noise_kernel,
             streamed=self._streamed,
+            low_rank=self._low_rank,
         )
         return flat, state_key
 

@@ -69,3 +69,35 @@ def mlp_decomposed_apply(
     if not module.discrete:
         x = jnp.tanh(x) * module.action_scale
     return x
+
+
+def mlp_lowrank_apply(
+    module, shared_params: Any, lr_noise: dict, scale, obs: jnp.ndarray
+) -> jnp.ndarray:
+    """Exact MLPPolicy forward with weights (shared + scale·A Bᵀ/√r), never
+    materializing any dense noise matrix.
+
+    ``lr_noise`` is {name: (A, B, bias_noise)} from LowRankSpec.unpack
+    (ops/lowrank.py); ``scale`` is σ·sign.  The noise term costs
+    O((m+n)·r) per step instead of O(m·n):
+        x @ (W + c·A Bᵀ/√r) = x@W + (c/√r)·((x@A) @ Bᵀ)
+    """
+    names = _ordered_dense_names(shared_params)
+    x = obs
+    for name in names:
+        w = shared_params[name]["kernel"]
+        b = shared_params[name]["bias"]
+        a, bt, nb = lr_noise[name]
+        if bt is None:
+            # dense-fallback layer (rank ≥ min(m, n)): a IS the full E
+            noise_term = scale * (x @ a)
+        else:
+            r = a.shape[-1]
+            c = scale / jnp.sqrt(jnp.asarray(r, x.dtype))
+            noise_term = c * ((x @ a) @ bt.T)
+        x = (x @ w) + noise_term + b + scale * nb
+        if name != "head":
+            x = module.activation(x)
+    if not module.discrete:
+        x = jnp.tanh(x) * module.action_scale
+    return x

@@ -1,0 +1,164 @@
+"""Low-rank perturbations: per-layer E = A·Bᵀ/√r noise (ES at hyperscale).
+
+The classic estimator perturbs every weight independently: ε_i is a full
+(dim,) table slice, so noise memory/bandwidth per member is O(dim) and the
+per-step forward must touch an O(m·n) noise matrix per layer.  The low-rank
+family (PAPERS.md "Evolution Strategies at the Hyperscale") replaces each
+layer's kernel noise with
+
+    E = A @ Bᵀ / √r,     A ~ N(0,1)^(m×r),  B ~ N(0,1)^(n×r)
+
+whose entries remain zero-mean unit-variance (E[AᵢₖBⱼₖ]=0, Var=r·(1/r)=1),
+while the per-member noise state shrinks from Σ m·n to Σ (m+n)·r — at
+Humanoid-MLP size (376→256→256→17, r=1) that is ~166k → ~2.4k floats, the
+difference between HBM-resident populations of 10k and 700k members — and
+the forward's noise term drops from O(m·n) to O((m+n)·r) per step:
+
+    x @ (W + c·A Bᵀ/√r) = x@W + (c/√r)·((x@A) @ Bᵀ)
+
+Layers where factoring would not actually save noise floats
+((m+n)·r ≥ m·n — e.g. a 16×1 continuous head at any rank, or a small
+square layer at high rank) fall back to exact dense Gaussian noise: the
+fallback is exact AND no larger.  Bias noise is always dense (biases are
+already O(n)).
+
+The rank-weighted update never materializes any member's E_i:
+
+    ΔW = Σ_i w_i A_i Bᵀ_i / √r = einsum('imr,inr->mn', w·A, B)/√r
+
+one MXU contraction per layer over the whole population.  This is an
+APPROXIMATION of isotropic-Gaussian ES (the search distribution is no
+longer Gaussian in weight space); the hyperscale paper's result is that
+the estimator's performance matches full ES as layer dims grow.
+
+Sampling rides the same shared-noise-table machinery as the full-rank path
+(ops/noise.py): one table offset per member/pair, A‖B‖dense‖bias noise
+unpacked from a single contiguous (noise_dim,) slice — workers never
+exchange noise, exactly as the reference's seed-passing protocol intends
+(SURVEY.md §2.8).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class LowRankSpec:
+    """Static layout of one member's low-rank noise vector.
+
+    ``lr_layers``: tuple of (name, m, n, a_off, b_off) — kernel noise
+    factors A (m, r) and B (n, r) at those offsets into the noise vector.
+    ``dense_layers``: tuple of (name, m, n, off) — layers where factoring
+    would not save ((m+n)·rank ≥ m·n): exact dense kernel noise.
+    ``biases``: tuple of (name, n, off) — dense bias noise.
+    """
+
+    rank: int
+    noise_dim: int
+    lr_layers: tuple
+    dense_layers: tuple
+    biases: tuple
+
+    def unpack(self, noise_vec: jax.Array) -> dict:
+        """(noise_dim,) slice → {name: (A, B, bias)} / {name: (E, None, bias)}.
+
+        A 3-tuple per layer: low-rank layers carry (A, B, bias_noise); dense
+        -fallback layers carry (E, None, bias_noise).  ``None`` is a pytree
+        structural marker, so the dict vmaps/casts cleanly.
+        """
+        r = self.rank
+        out = {}
+        for name, m, n, a_off, b_off in self.lr_layers:
+            a = jax.lax.dynamic_slice(noise_vec, (a_off,), (m * r,)).reshape(m, r)
+            b = jax.lax.dynamic_slice(noise_vec, (b_off,), (n * r,)).reshape(n, r)
+            out[name] = [a, b, None]
+        for name, m, n, off in self.dense_layers:
+            e = jax.lax.dynamic_slice(noise_vec, (off,), (m * n,)).reshape(m, n)
+            out[name] = [e, None, None]
+        for name, n, off in self.biases:
+            nb = jax.lax.dynamic_slice(noise_vec, (off,), (n,))
+            out[name][2] = nb
+        return {k: tuple(v) for k, v in out.items()}
+
+
+def make_lowrank_spec(params: Any, rank: int) -> LowRankSpec:
+    """Layout from an MLP-shaped param tree ({name: {kernel, bias}})."""
+    from ..models.decomposed import _ordered_dense_names
+
+    if rank < 1:
+        raise ValueError(f"low_rank must be >= 1, got {rank}")
+    names = _ordered_dense_names(params)
+    lr_layers, dense_layers, biases = [], [], []
+    off = 0
+    for name in names:
+        m, n = params[name]["kernel"].shape
+        # low-rank only where it actually SAVES: (m+n)·r < m·n (this also
+        # implies r < min(m, n), since mn/(m+n) < min(m, n)); otherwise the
+        # factors would cost more noise floats than exact dense Gaussian —
+        # an approximation strictly worse than the thing it approximates
+        if rank * (m + n) < m * n:
+            lr_layers.append((name, m, n, off, off + m * rank))
+            off += (m + n) * rank
+        else:
+            dense_layers.append((name, m, n, off))
+            off += m * n
+    for name in names:
+        (n,) = params[name]["bias"].shape
+        biases.append((name, n, off))
+        off += n
+    return LowRankSpec(
+        rank=rank, noise_dim=off, lr_layers=tuple(lr_layers),
+        dense_layers=tuple(dense_layers), biases=tuple(biases),
+    )
+
+
+def dense_kernel(spec_rank: int, a, b):
+    """One layer's dense E from its unpacked factors (oracle/snapshot path)."""
+    if b is None:
+        return a  # dense-fallback layer: a IS E
+    return (a @ b.T) / jnp.sqrt(jnp.float32(spec_rank))
+
+
+def lowrank_noise_tree(lr_spec: LowRankSpec, noise_vec: jax.Array) -> dict:
+    """Materialize the DENSE noise pytree {name: {kernel, bias}} one member's
+    slice represents — snapshot/debug path (member_params), not the hot path.
+    """
+    unpacked = lr_spec.unpack(noise_vec)
+    return {
+        name: {"kernel": dense_kernel(lr_spec.rank, a, b), "bias": nb}
+        for name, (a, b, nb) in unpacked.items()
+    }
+
+
+def lowrank_weighted_sum(
+    lr_spec: LowRankSpec, noise_mat: jax.Array, weights: jax.Array
+) -> dict:
+    """Σ_i w_i · dense(noise_i) without materializing any member's dense E.
+
+    ``noise_mat``: (k, noise_dim) stacked member/pair slices;
+    ``weights``: (k,) — rank weights (mirrored: already pair-folded w⁺−w⁻,
+    exact because a pair shares ONE slice, so ±E share (A, B) and fold like
+    full-rank noise).  Returns the dense {name: {kernel, bias}} pytree of
+    the weighted sum.
+    """
+    r = lr_spec.rank
+    k = noise_mat.shape[0]
+    scale = 1.0 / jnp.sqrt(jnp.float32(r))
+    out = {}
+    for name, m, n, a_off, b_off in lr_spec.lr_layers:
+        a = jax.lax.dynamic_slice(noise_mat, (0, a_off), (k, m * r)).reshape(k, m, r)
+        b = jax.lax.dynamic_slice(noise_mat, (0, b_off), (k, n * r)).reshape(k, n, r)
+        kernel = jnp.einsum("kmr,knr->mn", a * weights[:, None, None], b) * scale
+        out[name] = {"kernel": kernel}
+    for name, m, n, off in lr_spec.dense_layers:
+        e = jax.lax.dynamic_slice(noise_mat, (0, off), (k, m * n))
+        out[name] = {"kernel": (weights @ e).reshape(m, n)}
+    for name, n, off in lr_spec.biases:
+        nb = jax.lax.dynamic_slice(noise_mat, (0, off), (k, n))
+        out[name]["bias"] = weights @ nb
+    return out

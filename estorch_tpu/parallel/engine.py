@@ -74,6 +74,12 @@ class EngineConfig:
     # (ops/pallas_noise.py): ε rows DMA'd from the HBM table through
     # double-buffered VMEM and FMA'd in place — no (chunk, dim)
     # materialization. Interpret-mode off-TPU, Mosaic on-chip.
+    low_rank: int = 0  # >0: per-layer kernel noise E = A·Bᵀ/√r with r =
+    # low_rank (ops/lowrank.py, PAPERS.md "ES at the Hyperscale"): member
+    # noise state shrinks O(dim) → O(Σ(m+n)·r), the forward's noise term
+    # O(m·n) → O((m+n)·r) per step, and the update is one einsum per layer
+    # over the population.  Approximates isotropic ES (exact for biases);
+    # mutually exclusive with decomposed/streamed/noise_kernel.
     streamed: bool = False  # Pallas streamed FORWARD: the decomposed
     # population forward with every layer's ε tiles DMA'd from the table —
     # no member's noise tree is ever materialized, so resident noise bytes
@@ -171,8 +177,28 @@ class ESEngine:
         mesh: Mesh,
         decomposed_apply=None,
         streamed_apply=None,
+        lowrank_apply=None,
+        lowrank_spec=None,
     ):
         self.env = env
+        if config.low_rank:
+            if config.decomposed or config.streamed or config.noise_kernel:
+                raise ValueError(
+                    "low_rank replaces the full-rank noise pathway; it is "
+                    "mutually exclusive with decomposed/streamed/noise_kernel"
+                )
+            if lowrank_spec is None or (lowrank_apply is None and env is not None):
+                raise ValueError(
+                    "EngineConfig.low_rank needs lowrank_apply + lowrank_spec "
+                    "(ops/lowrank.py; ES builds them for MLPPolicy)"
+                )
+        self.lr_spec = lowrank_spec if config.low_rank else None
+        # the per-member noise vector the table serves: full-rank ε is (dim,),
+        # low-rank is the packed (A‖B‖bias) factors — everything that samples
+        # offsets or slices noise uses THIS, not spec.dim
+        self.noise_dim = (
+            self.lr_spec.noise_dim if config.low_rank else spec.dim
+        )
         if config.decomposed and decomposed_apply is None and env is not None:
             raise ValueError(
                 "EngineConfig.decomposed=True needs a decomposed_apply "
@@ -257,6 +283,17 @@ class ESEngine:
 
             self._rollout_batched = make_batched_rollout(env, config.horizon)
 
+        self._rollout_lowrank = None
+        if config.low_rank:
+            def lr_packed_apply(packed, obs):
+                shared, lrn, c = packed
+                return lowrank_apply(shared, lrn, c, obs)
+
+            if self._bf16:
+                lr_packed_apply = _bf16_io_apply(lr_packed_apply)
+
+            self._rollout_lowrank = make_rollout(env, lr_packed_apply, config.horizon)
+
         self._rollout_decomposed = None
         if config.decomposed:
             def packed_apply(packed, obs):
@@ -327,7 +364,7 @@ class ESEngine:
             if self.config.mirrored
             else self.config.population_size
         )
-        return sample_pair_offsets(okey, n, self.table.size, self.spec.dim)
+        return sample_pair_offsets(okey, n, self.table.size, self.noise_dim)
 
     def _member_cast(self, tree):
         """bf16 path: cast a member's param tree once, where it is built."""
@@ -349,7 +386,7 @@ class ESEngine:
         d = jax.lax.axis_index(POP_AXIS)
         if cfg.mirrored:
             all_pair_offsets = sample_pair_offsets(
-                okey, cfg.population_size // 2, self.table.size, self.spec.dim
+                okey, cfg.population_size // 2, self.table.size, self.noise_dim
             )
             pair_offs = jax.lax.dynamic_slice(
                 all_pair_offsets, (d * self.pairs_local,), (self.pairs_local,)
@@ -363,7 +400,7 @@ class ESEngine:
             member_keys = jnp.repeat(local_pair_keys, 2, axis=0)
             return pair_offs, member_offs, signs, member_keys
         all_offsets = sample_pair_offsets(
-            okey, cfg.population_size, self.table.size, self.spec.dim
+            okey, cfg.population_size, self.table.size, self.noise_dim
         )
         member_offs = jax.lax.dynamic_slice(
             all_offsets, (d * self.members_local,), (self.members_local,)
@@ -384,7 +421,7 @@ class ESEngine:
             return self._eval_local_streamed(
                 state, member_offs, signs, member_keys, n_chunks
             )
-        if cfg.decomposed:
+        if cfg.decomposed or cfg.low_rank:
             # shared center tree: unraveled (and, for bf16, cast) ONCE,
             # enters the member vmap as an un-batched constant — its matmuls
             # fuse across the population
@@ -394,6 +431,17 @@ class ESEngine:
             offs_c, signs_c, keys_c = xs
 
             def member_eval(off, sign, key):
+                if cfg.low_rank:
+                    # packed (A||B||bias) factors — dim is the LR noise_dim,
+                    # and no dense noise matrix ever exists on this path
+                    lrn = self.lr_spec.unpack(self.table.slice(off, self.noise_dim))
+                    rollout = self._rollout_lowrank
+                    params = (
+                        shared_tree,
+                        self._member_cast(lrn),
+                        self._member_cast(state.sigma * sign),
+                    )
+                    return self._member_rollout(rollout, params, key)
                 eps = self.table.slice(off, dim)
                 if cfg.decomposed:
                     rollout = self._rollout_decomposed
@@ -408,22 +456,27 @@ class ESEngine:
                     # once-per-member cast (bf16 path): the rollout scan
                     # below runs on dtype-pure params, no per-step casts
                     params = self._member_cast(self.spec.unravel(theta))
-                if cfg.episodes_per_member > 1:
-                    ep_keys = jax.random.split(key, cfg.episodes_per_member)
-                    res = jax.vmap(rollout, in_axes=(None, 0))(params, ep_keys)
-                    # fitness = mean return; BC = first episode's; steps summed
-                    return (
-                        res.total_reward.mean(),
-                        jax.tree_util.tree_map(lambda x: x[0], res.bc),
-                        res.steps.sum(),
-                    )
-                res = rollout(params, key)
-                return res.total_reward, res.bc, res.steps
+                return self._member_rollout(rollout, params, key)
 
             f, bc, st = jax.vmap(member_eval)(offs_c, signs_c, keys_c)
             return 0, (f, bc, st)
 
         return self._scan_chunks(chunk_body, member_offs, signs, member_keys, n_chunks)
+
+    def _member_rollout(self, rollout, params, key):
+        """One member's fitness/bc/steps, honoring episodes_per_member."""
+        cfg = self.config
+        if cfg.episodes_per_member > 1:
+            ep_keys = jax.random.split(key, cfg.episodes_per_member)
+            res = jax.vmap(rollout, in_axes=(None, 0))(params, ep_keys)
+            # fitness = mean return; BC = first episode's; steps summed
+            return (
+                res.total_reward.mean(),
+                jax.tree_util.tree_map(lambda x: x[0], res.bc),
+                res.steps.sum(),
+            )
+        res = rollout(params, key)
+        return res.total_reward, res.bc, res.steps
 
     def _scan_chunks(self, chunk_body, member_offs, signs, member_keys, n_chunks):
         """Dispatch the local shard through ``chunk_body`` in eval_chunk
@@ -481,7 +534,21 @@ class ESEngine:
         w_local = jax.lax.dynamic_slice(
             weights, (d * self.members_local,), (self.members_local,)
         )
-        if cfg.noise_kernel:
+        if cfg.low_rank:
+            # one einsum per layer over the stacked factor slices — no dense
+            # E_i is ever materialized (ops/lowrank.py)
+            from ..ops.gradient import fold_mirrored_weights as _fold_lr
+            from ..ops.lowrank import lowrank_weighted_sum
+
+            row_w = _fold_lr(w_local) if cfg.mirrored else w_local
+            noise_local = jax.vmap(
+                lambda o: self.table.slice(o, self.noise_dim)
+            )(reduction_offs)
+            tree = lowrank_weighted_sum(self.lr_spec, noise_local, row_w)
+            grad_local = self.spec.flatten(tree) / (
+                cfg.population_size * state.sigma
+            )
+        elif cfg.noise_kernel:
             # Pallas streamed reduction: each ε row is DMA'd once and FMA'd
             # into a VMEM accumulator — no materialized noise blocks
             from ..ops.gradient import fold_mirrored_weights as _fold
@@ -615,15 +682,22 @@ class ESEngine:
         okey, _ = _gen_keys(state)
         if self.config.mirrored:
             all_pair_offsets = sample_pair_offsets(
-                okey, self.config.population_size // 2, self.table.size, self.spec.dim
+                okey, self.config.population_size // 2, self.table.size, self.noise_dim
             )
             off = all_pair_offsets[member_index // 2]
             sign = 1.0 if member_index % 2 == 0 else -1.0
         else:
             all_offsets = sample_pair_offsets(
-                okey, self.config.population_size, self.table.size, self.spec.dim
+                okey, self.config.population_size, self.table.size, self.noise_dim
             )
             off = all_offsets[member_index]
             sign = 1.0
+        if self.config.low_rank:
+            from ..ops.lowrank import lowrank_noise_tree
+
+            dense = lowrank_noise_tree(
+                self.lr_spec, self.table.slice(off, self.noise_dim)
+            )
+            return state.params_flat + state.sigma * sign * self.spec.flatten(dense)
         eps = self.table.slice(off, self.spec.dim)
         return state.params_flat + state.sigma * sign * eps

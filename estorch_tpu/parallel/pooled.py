@@ -73,6 +73,11 @@ class PooledEngine:
                 "decomposed is a device-path option; the pooled path "
                 "materializes per-member thetas for its batched forward"
             )
+        if config.low_rank:
+            raise ValueError(
+                "low_rank is a device-path option (ops/lowrank.py); the "
+                "pooled path materializes per-member thetas"
+            )
         # update-only device engine: shares offsets/psum/optax with the
         # fully-on-device path; its ctor also applies the compute_dtype wrap,
         # which we reuse below instead of wrapping a second time
