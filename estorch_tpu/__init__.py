@@ -15,12 +15,13 @@ classes are re-exported here as they land:
 __version__ = "0.1.0"
 
 from . import envs, models, ops, parallel, utils  # noqa: F401
-from .algo import ES, NS_ES, NSR_ES, NSRA_ES, NoveltyArchive
+from .algo import ES, IW_ES, NS_ES, NSR_ES, NSRA_ES, NoveltyArchive
 from .envs.agent import JaxAgent, PooledAgent
 from .models import MLPPolicy, NatureCNN, VirtualBatchNorm
 
 __all__ = [
     "ES",
+    "IW_ES",
     "NS_ES",
     "NSR_ES",
     "NSRA_ES",

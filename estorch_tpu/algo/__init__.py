@@ -1,5 +1,6 @@
 from .archive import NoveltyArchive
 from .es import ES
+from .iwes import IW_ES
 from .nses import NS_ES, NSR_ES, NSRA_ES
 
-__all__ = ["ES", "NS_ES", "NSR_ES", "NSRA_ES", "NoveltyArchive"]
+__all__ = ["ES", "IW_ES", "NS_ES", "NSR_ES", "NSRA_ES", "NoveltyArchive"]

@@ -1,0 +1,202 @@
+"""IW-ES — importance-weighted reuse of the previous generation's rollouts.
+
+PAPERS.md "Importance Weighted Evolution Strategies" (1811.04624): after the
+center moves θ_t → θ_{t+1}, the generation-t members θ_i = θ_t + σ_t s_i ε_i
+are still valid Monte-Carlo samples for the gradient at θ_{t+1} — under the
+new search distribution they are the perturbations
+
+    ε'_i = (θ_i − θ_{t+1}) / σ_{t+1} = d + c·s_i ε_i,
+    d = (θ_t − θ_{t+1})/σ_{t+1},   c = σ_t/σ_{t+1}
+
+with importance ratio
+
+    λ_i = N(θ_i; θ_{t+1}, σ²_{t+1}) / N(θ_i; θ_t, σ²_t)
+        = c^dim · exp((‖ε_i‖² − ‖ε'_i‖²)/2).
+
+Each generation this class evaluates the fresh population as usual, then
+forms the update from BOTH sets — fresh members with their ranks, reused
+members with rank × self-normalized λ — which doubles the effective sample
+count per rollout budget.  The classic failure mode (a big center move
+collapses the ratios) is guarded by the effective sample size
+ESS = (Σλ)²/Σλ²: when ESS/n_old < ``ess_min`` the stale set is dropped and
+the generation proceeds as vanilla ES.  σ annealing makes c^dim vanish at
+large dim, so annealed runs naturally fall back to no-reuse — the guard
+handles it, no special case.
+
+Nothing about the reused set is re-evaluated and no old noise is stored:
+old ε_i regenerate from the shared table via the PREVIOUS state's offsets
+(the same derivation every device already performs —
+engine.all_pair_offsets), old fitness is a host-side (n,) float array, and
+the two device passes the reuse needs (per-sample ε·d / ‖ε‖², and the
+Σ wλε update term) are sharded psum/all_gather programs
+(parallel/engine.py::noise_stats / apply_weights_reuse).
+
+Device path only; low_rank is not supported (packed factor noise has no
+dense ε for the ratio), and the host/pooled backends raise as usual.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.gradient import fold_mirrored_weights
+from ..utils.fault import rank_weights_with_failures
+from .es import ES
+
+
+class IW_ES(ES):
+    """ES with importance-weighted reuse of the previous generation."""
+
+    def __init__(self, *args, ess_min: float = 0.5, **kwargs):
+        if not 0.0 < ess_min <= 1.0:
+            raise ValueError(f"ess_min must be in (0, 1], got {ess_min}")
+        self.ess_min = float(ess_min)
+        super().__init__(*args, **kwargs)
+        if self.backend != "device":
+            raise ValueError(
+                "IW_ES is a device-path algorithm (the reuse terms are "
+                f"sharded table reductions); got backend={self.backend!r}"
+            )
+        if self._low_rank:
+            raise ValueError(
+                "IW_ES does not support low_rank (no dense ε for the ratio)"
+            )
+        if self._streamed or self._noise_kernel:
+            raise ValueError(
+                "IW_ES supports the standard/decomposed forwards; "
+                "streamed/noise_kernel are untested with reuse"
+            )
+        self._prev: tuple | None = None  # (state, fitness np.ndarray)
+
+    def train(
+        self,
+        n_steps: int,
+        n_proc: int = 1,
+        log_fn: Callable[[dict], None] | None = None,
+        verbose: bool = True,
+    ):
+        self._setup_n_proc(n_proc)
+        if self.compile_time_s is None:
+            self.compile_time_s = self.engine.compile_split(self.state)
+            self.compile_time_s += self._warm_reuse_programs()
+        n = self.population_size
+        for _ in range(n_steps):
+            t0 = time.perf_counter()
+            st = self.state
+            ev = self.engine.evaluate(st)
+            fitness = np.asarray(ev.fitness)
+            # base-class parity BEFORE anything mutates: a dead env (fewer
+            # than 2 valid FRESH members) must hard-fail with state intact —
+            # reuse must not let stale samples train through a dead generation
+            if int(np.isfinite(fitness).sum()) < 2:
+                raise RuntimeError(
+                    f"only {int(np.isfinite(fitness).sum())}/{n} population "
+                    "members produced valid fitness — cannot form an update; "
+                    "check env/rollout health"
+                )
+
+            reused, ess = False, 0.0
+            if self._prev is not None:
+                prev_st, prev_fit = self._prev
+                lam, d_vec, c, old_offsets = self._ratios(prev_st, st)
+                ess = float(lam.sum() ** 2 / (lam**2).sum()) if lam.sum() > 0 else 0.0
+                reused = ess >= self.ess_min * n
+                if reused:
+                    new_st, gnorm = self._reuse_update(
+                        st, fitness, prev_fit, lam, d_vec, c, old_offsets
+                    )
+            if not reused:
+                weights = jnp.asarray(rank_weights_with_failures(fitness))
+                new_st, gnorm = self.engine.apply_weights(st, weights)
+
+            self.state = new_st
+            self._prev = (st, fitness)
+            jnp.asarray(new_st.params_flat).block_until_ready()
+            dt = time.perf_counter() - t0
+
+            record = self._base_record(
+                st, fitness, int(ev.steps), float(np.asarray(gnorm)), dt
+            )
+            record.update(
+                reused_prev=reused,
+                ess=round(ess, 2),
+                effective_samples=n + (n if reused else 0),
+            )
+            self._emit_record(record, log_fn, verbose)
+        return self
+
+    # ------------------------------------------------------------ internals
+
+    def _warm_reuse_programs(self) -> float:
+        """Trace+compile noise_stats and apply_weights_reuse with the real
+        shapes OUTSIDE the timed loop (the codebase invariant: the primary
+        metric env_steps_per_sec never includes XLA compile time)."""
+        t0 = time.perf_counter()
+        st = self.state
+        offsets = self.engine.all_pair_offsets(st)
+        zeros_d = jnp.zeros_like(st.params_flat)
+        self.engine.noise_stats(offsets, zeros_d)
+        n_rows = int(offsets.shape[0])
+        dummy_w = jnp.zeros((self.population_size,), jnp.float32)
+        dummy_old = jnp.zeros((n_rows,), jnp.float32)
+        out, _ = self.engine.apply_weights_reuse(
+            st, dummy_w, offsets, dummy_old, zeros_d, 0.0
+        )
+        jnp.asarray(out.params_flat).block_until_ready()
+        return time.perf_counter() - t0
+
+    def _ratios(self, prev_st, st):
+        """Per-old-member importance ratios λ under the CURRENT state."""
+        sigma_old = float(np.asarray(prev_st.sigma))
+        sigma_new = float(np.asarray(st.sigma))
+        c = sigma_old / sigma_new
+        d_vec = (prev_st.params_flat - st.params_flat) / sigma_new
+        offsets = self.engine.all_pair_offsets(prev_st)
+        dots, norms = self.engine.noise_stats(offsets, d_vec)
+        dots, norms = np.asarray(dots), np.asarray(norms)
+        d2 = float(jnp.vdot(d_vec, d_vec))
+        if self._mirrored:
+            # members 2k/2k+1 share pair row k with signs ±1
+            dots = np.repeat(dots, 2) * np.tile([1.0, -1.0], dots.shape[0])
+            norms = np.repeat(norms, 2)
+        eps_new_sq = d2 + 2.0 * c * dots + c * c * norms
+        log_lam = self._spec.dim * np.log(c) + 0.5 * (norms - eps_new_sq)
+        # log-sum-exp style stabilization: λ only ever enters self-normalized
+        # (λ̃ and ESS are shift-invariant in log space)
+        log_lam -= log_lam.max()
+        return np.exp(log_lam), d_vec, c, offsets
+
+    def _reuse_update(self, st, fitness, prev_fit, lam, d_vec, c, old_offsets):
+        """One combined-estimator update (fresh ranks + λ-weighted old ranks).
+
+        Scaling contract with engine.apply_weights_reuse: fresh weights are
+        rescaled by n/n_tot so the engine's 1/(n·σ) denominator becomes
+        1/(n_tot·σ); the old-side coefficients arrive fully scaled.
+        """
+        n = self.population_size
+        n_tot = 2 * n
+        sigma_new = float(np.asarray(st.sigma))
+
+        combined = np.concatenate([fitness, prev_fit])
+        w_all = rank_weights_with_failures(combined)
+        w_fresh, w_old = w_all[:n], w_all[n:]
+
+        lam_tilde = lam * (n / max(lam.sum(), 1e-30))  # self-normalized, mean 1
+        w_old_eff = w_old * lam_tilde
+
+        # old ε-term: Σ w λ̃ (d + c·s·ε) → the s·ε part folds per pair
+        if self._mirrored:
+            folded = fold_mirrored_weights(jnp.asarray(w_old_eff))
+        else:
+            folded = jnp.asarray(w_old_eff)
+        old_w = folded * (c / (n_tot * sigma_new))
+        coeff_d = float(w_old_eff.sum() / (n_tot * sigma_new))
+
+        weights = jnp.asarray(w_fresh * (n / n_tot))
+        return self.engine.apply_weights_reuse(
+            st, weights, old_offsets, old_w, d_vec, coeff_d
+        )

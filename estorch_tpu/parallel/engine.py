@@ -523,8 +523,8 @@ class ESEngine:
         steps = jax.lax.psum(steps_local.sum(), POP_AXIS)
         return fitness, bc, steps
 
-    def _update_from_weights(self, state: ESState, weights, reduction_offs):
-        """Optax step from per-member rank weights. Identical on all devices.
+    def _local_grad(self, state: ESState, weights, reduction_offs):
+        """This device's pre-psum partial of the rank-weighted estimator.
 
         ``reduction_offs`` is per-PAIR (mirrored; folded estimator) or
         per-MEMBER (unmirrored; direct weighted sum).
@@ -570,7 +570,18 @@ class ESEngine:
                 self.table, reduction_offs, w_local,
                 dim=self.spec.dim, chunk=cfg.grad_chunk,
             ) / (cfg.population_size * state.sigma)
+        return grad_local
+
+    def _update_from_weights(self, state: ESState, weights, reduction_offs):
+        """Optax step from per-member rank weights. Identical on all devices."""
+        grad_local = self._local_grad(state, weights, reduction_offs)
         grad_ascent = jax.lax.psum(grad_local, POP_AXIS)
+        return self._finish_update(state, grad_ascent)
+
+    def _finish_update(self, state: ESState, grad_ascent):
+        """Weight decay + optax step + σ annealing from a replicated ascent
+        direction (identical on every device by construction)."""
+        cfg = self.config
         if cfg.weight_decay > 0.0:
             grad_ascent = grad_ascent - cfg.weight_decay * state.params_flat
         updates, new_opt_state = self.optimizer.update(
@@ -670,6 +681,119 @@ class ESEngine:
     def apply_weights(self, state: ESState, weights: jax.Array):
         """Update from host-computed per-member weights (novelty family)."""
         return self._apply_weights(state, weights)
+
+    # ---- importance-weighted sample reuse (algo/iwes.py) ----
+
+    def _require_dense_noise(self, what: str):
+        if self.config.low_rank:
+            raise ValueError(
+                f"{what} needs the dense (dim,) noise representation; "
+                "low_rank packs factors instead (ops/lowrank.py) — IW reuse "
+                "does not support low_rank yet"
+            )
+
+    def noise_stats(self, offsets: jax.Array, d_vec: jax.Array):
+        """(ε·d, |ε|²) for every table row in ``offsets`` — the per-sample
+        statistics the importance ratio λ needs (algo/iwes.py).  Sharded:
+        each device computes its contiguous block, results all_gather'd."""
+        self._require_dense_noise("noise_stats")
+        if not hasattr(self, "_noise_stats_progs"):
+            self._noise_stats_progs = {}
+        cache_n = int(offsets.shape[0])
+        if cache_n not in self._noise_stats_progs:
+            n = cache_n
+            k_local = n // self.n_devices
+            if k_local * self.n_devices != n:
+                raise ValueError(
+                    f"offsets ({n}) must divide evenly over {self.n_devices} "
+                    "devices"
+                )
+            chunk = _choose_eval_chunk(self.config.grad_chunk, k_local)
+
+            def body(offs, d_vec):
+                dev = jax.lax.axis_index(POP_AXIS)
+                o_local = jax.lax.dynamic_slice(offs, (dev * k_local,), (k_local,))
+
+                def chunk_stats(_, o_c):
+                    eps = jax.vmap(lambda o: self.table.slice(o, self.spec.dim))(o_c)
+                    return 0, (eps @ d_vec, jnp.sum(eps * eps, axis=-1))
+
+                if k_local == chunk:
+                    _, (dots, norms) = chunk_stats(0, o_local)
+                else:
+                    _, (dots, norms) = jax.lax.scan(
+                        chunk_stats, 0, o_local.reshape(-1, chunk)
+                    )
+                    dots = dots.reshape(k_local)
+                    norms = norms.reshape(k_local)
+                return (
+                    jax.lax.all_gather(dots, POP_AXIS).reshape(-1),
+                    jax.lax.all_gather(norms, POP_AXIS).reshape(-1),
+                )
+
+            self._noise_stats_progs[cache_n] = jax.jit(
+                jax.shard_map(
+                    body, mesh=self.mesh, in_specs=(P(), P()),
+                    out_specs=(P(), P()), check_vma=False,
+                )
+            )
+        return self._noise_stats_progs[cache_n](offsets, d_vec)
+
+    def apply_weights_reuse(
+        self, state: ESState, weights: jax.Array, old_offsets: jax.Array,
+        old_w: jax.Array, d_vec: jax.Array, coeff_d,
+    ):
+        """Update from fresh rank weights PLUS a reused-sample term.
+
+        The combined-estimator scaling contract (algo/iwes.py): ``weights``
+        are pre-scaled so the engine's internal 1/(population·σ) yields
+        1/(n_total·σ); ``old_w`` (per old PAIR when mirrored, per old member
+        otherwise) and ``coeff_d`` arrive FULLY pre-scaled, so the reuse
+        terms are added raw:  ∇̂ += Σ old_w·ε_old + coeff_d·d_vec.
+        """
+        self._require_dense_noise("apply_weights_reuse")
+        if not hasattr(self, "_apply_weights_reuse_progs"):
+            self._apply_weights_reuse_progs = {}
+        cache_n = int(old_offsets.shape[0])
+        if cache_n not in self._apply_weights_reuse_progs:
+            n_old = cache_n
+            k_local = n_old // self.n_devices
+            if k_local * self.n_devices != n_old:
+                raise ValueError(
+                    f"old_offsets ({n_old}) must divide evenly over "
+                    f"{self.n_devices} devices"
+                )
+
+            def body(state, weights, old_offs, old_w, d_vec, coeff_d):
+                red_offs, _, _, _ = self._local_offsets_signs_keys(state)
+                grad_local = self._local_grad(state, weights, red_offs)
+                dev = jax.lax.axis_index(POP_AXIS)
+                o_local = jax.lax.dynamic_slice(
+                    old_offs, (dev * k_local,), (k_local,)
+                )
+                w_local = jax.lax.dynamic_slice(
+                    old_w, (dev * k_local,), (k_local,)
+                )
+                grad_local = grad_local + rank_weighted_noise_sum(
+                    self.table, o_local, w_local,
+                    dim=self.spec.dim, chunk=self.config.grad_chunk,
+                )
+                grad_ascent = jax.lax.psum(grad_local, POP_AXIS)
+                grad_ascent = grad_ascent + coeff_d * d_vec
+                return self._finish_update(state, grad_ascent)
+
+            self._apply_weights_reuse_progs[cache_n] = jax.jit(
+                jax.shard_map(
+                    body, mesh=self.mesh,
+                    in_specs=(P(), P(), P(), P(), P(), P()),
+                    out_specs=(P(), P()),
+                    check_vma=False,
+                )
+            )
+        return self._apply_weights_reuse_progs[cache_n](
+            state, weights, old_offsets, old_w, d_vec,
+            jnp.asarray(coeff_d, jnp.float32),
+        )
 
     def evaluate_center(self, state: ESState):
         """One episode with the unperturbed center params → RolloutResult."""
