@@ -116,9 +116,12 @@ struct PendulumEnv {
 // conv-rollout stress stand-in for the Atari config (BASELINE config 5) in
 // an image without ALE.  The agent drives the LEFT paddle with 3 actions
 // (stay/up/down); the right paddle is a simple ball tracker.  Reward +1
-// when the opponent misses, -1 when the agent misses; episode ends on the
-// first point.  Observation: normalized float32 pixels in [0, 1] (ball and
-// paddles drawn white on black), flattened row-major 84*84.
+// when the opponent misses, -1 when the agent misses; after each point the
+// ball re-serves and play continues — the episode ends when either side
+// reaches kWinScore points (ALE Pong's play-to-21 match structure), so
+// returns span multiple rallies like the real game.  Observation:
+// normalized float32 pixels in [0, 1] (ball and paddles drawn white on
+// black), flattened row-major 84*84.
 struct Pong84Env {
   static constexpr int kSize = 84;
   static constexpr int kObsDim = kSize * kSize;
@@ -128,18 +131,27 @@ struct Pong84Env {
   static constexpr int kPaddleHalf = 6;      // paddle half-height in px
   static constexpr float kBallSpeed = 1.6f;
 
+  static constexpr int kWinScore = 21;  // ALE Pong match length
+
   float ball_x, ball_y, vel_x, vel_y;  // pixel coordinates
   float left_y, right_y;               // paddle centers
+  int left_score, right_score;
 
-  void reset(std::mt19937& rng) {
+  void serve(std::mt19937& rng) {
     std::uniform_real_distribution<float> dy(20.0f, 64.0f);
     std::uniform_real_distribution<float> dv(-0.8f, 0.8f);
     ball_x = kSize / 2.0f;
     ball_y = dy(rng);
     vel_x = (rng() & 1) ? kBallSpeed : -kBallSpeed;
     vel_y = dv(rng);
+  }
+
+  void reset(std::mt19937& rng) {
+    serve(rng);
     left_y = kSize / 2.0f;
     right_y = kSize / 2.0f;
+    left_score = 0;
+    right_score = 0;
   }
 
   bool step(const float* action, float* reward, std::mt19937& rng) {
@@ -170,7 +182,10 @@ struct Pong84Env {
         vel_y += spin(rng);
       } else {
         *reward = -1.0f;
-        return true;
+        right_score++;
+        if (right_score >= kWinScore) return true;
+        serve(rng);  // point over, next rally
+        return false;
       }
     }
     if (ball_x >= kSize - 4.0f) {
@@ -179,7 +194,10 @@ struct Pong84Env {
         ball_x = kSize - 4.0f;
       } else {
         *reward = 1.0f;
-        return true;
+        left_score++;
+        if (left_score >= kWinScore) return true;
+        serve(rng);
+        return false;
       }
     }
     return false;

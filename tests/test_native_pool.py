@@ -340,12 +340,39 @@ class TestPong84ConvPath:
         assert pool.discrete and pool.n_actions == 3
         # pixels are binary {0, 1}
         assert set(np.unique(obs)).issubset({0.0, 1.0})
-        # a still agent eventually concedes points (negative rewards)
+        # a still agent eventually concedes points (negative rewards), and
+        # play CONTINUES past a point (multi-rally episodes, ALE-style)
         total = np.zeros(4)
-        for _ in range(400):
-            _, r, _ = pool.step(np.zeros((4, 1), np.float32))
+        conceded = np.zeros(4)
+        dones = np.zeros(4, bool)
+        for _ in range(2000):
+            _, r, d = pool.step(np.zeros((4, 1), np.float32))
             total += r
-        assert np.all(total <= 0) and np.any(total < 0)
+            conceded += (r < 0)
+            dones |= d
+        assert np.all(total <= 0) and np.any(total < -1.0)
+        # first-to-21 match: no env may report done before conceding 21
+        # (a still agent can still WIN points off tracker spin, so count
+        # conceded, not net)
+        for i in range(4):
+            if dones[i]:
+                assert conceded[i] >= 21
+        pool.close()
+
+    def test_pong84_match_runs_to_21(self, native_available):
+        """done fires exactly at the 21st CONCEDED point (the still agent
+        may also score a few off tracker spin — those don't end matches)."""
+        pool = NativeEnvPool("pong84", 1, n_threads=1, seed=3)
+        pool.reset()
+        conceded, steps = 0, 0
+        done = False
+        while not done and steps < 60_000:
+            _, r, d = pool.step(np.zeros((1, 1), np.float32))
+            conceded += int(r[0] < 0.0)
+            done = bool(d[0])
+            steps += 1
+        assert done, "match never ended"
+        assert conceded == 21
         pool.close()
 
     def test_naturecnn_es_on_pong84(self, native_available):
