@@ -33,6 +33,9 @@ the two device passes the reuse needs (per-sample ε·d / ‖ε‖², and the
 
 Device path only; low_rank is not supported (packed factor noise has no
 dense ε for the ratio), and the host/pooled backends raise as usual.
+Checkpoint/resume: the one-generation reuse buffer is deliberately NOT part
+of run state — the first post-resume generation runs vanilla, then reuse
+resumes (utils/checkpoint.py stays bit-exact for everything that matters).
 """
 
 from __future__ import annotations

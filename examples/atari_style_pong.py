@@ -1,0 +1,44 @@
+"""The Atari workflow end-to-end without ALE: NatureCNN + preprocessing.
+
+BASELINE config 5's machinery on the bundled C++ pixel pong: 84×84 frames
+through the full ALE-standard preprocessing stack (4-frame stacking →
+NatureCNN's designed 84×84×4 input, action repeat, sticky actions —
+envs/atari_wrappers.py), population envs stepped by native threads while
+the device runs one batched conv forward per env step, first-to-21
+matches.  Swap ``env_name`` for a real ALE id the moment ``ale_py`` is
+installable — nothing else changes.
+
+Sized for an accelerator (population conv forwards are the whole cost);
+on CPU pass smaller overrides, e.g. main(population_size=16, horizon=60).
+
+Run: python examples/atari_style_pong.py
+"""
+
+import optax
+
+from estorch_tpu import ES, NatureCNN, PooledAgent
+
+
+def main(population_size=64, horizon=400, n_steps=3):
+    es = ES(
+        policy=NatureCNN,
+        agent=PooledAgent,
+        optimizer=optax.adam,
+        population_size=population_size,
+        sigma=0.02,
+        policy_kwargs={"action_dim": 3, "use_vbn": True},
+        agent_kwargs={"env_name": "pong84", "horizon": horizon,
+                      "frame_stack": 4, "action_repeat": 2,
+                      "sticky_prob": 0.25},
+        optimizer_kwargs={"learning_rate": 1e-2},
+        table_size=1 << 22,
+    )
+    print(f"policy input {es.engine.pool.obs_shape}, "
+          f"params {es._spec.dim:,}")
+    es.train(n_steps=n_steps)
+    print(f"\nbest reward: {es.best_reward:.1f}")
+    return es
+
+
+if __name__ == "__main__":
+    main()
