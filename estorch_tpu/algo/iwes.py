@@ -19,9 +19,11 @@ members with rank × self-normalized λ — which doubles the effective sample
 count per rollout budget.  The classic failure mode (a big center move
 collapses the ratios) is guarded by the effective sample size
 ESS = (Σλ)²/Σλ²: when ESS/n_old < ``ess_min`` the stale set is dropped and
-the generation proceeds as vanilla ES.  σ annealing makes c^dim vanish at
-large dim, so annealed runs naturally fall back to no-reuse — the guard
-handles it, no special case.
+the generation proceeds as vanilla ES.  (The c^dim prefactor is common to
+every member, so self-normalization cancels it — collapse comes from the
+SPREAD of the per-member exponents: big center moves, or c ≠ 1 amplifying
+the ‖ε‖² spread at large dim.  Annealed runs therefore still fall back to
+no-reuse naturally; the guard handles it, no special case.)
 
 Nothing about the reused set is re-evaluated and no old noise is stored:
 old ε_i regenerate from the shared table via the PREVIOUS state's offsets

@@ -342,15 +342,18 @@ class TestPong84ConvPath:
         assert set(np.unique(obs)).issubset({0.0, 1.0})
         # a still agent eventually concedes points (negative rewards), and
         # play CONTINUES past a point (multi-rally episodes, ALE-style)
-        total = np.zeros(4)
         conceded = np.zeros(4)
+        won = np.zeros(4)
         dones = np.zeros(4, bool)
         for _ in range(2000):
             _, r, d = pool.step(np.zeros((4, 1), np.float32))
-            total += r
             conceded += (r < 0)
+            won += (r > 0)
             dones |= d
-        assert np.all(total <= 0) and np.any(total < -1.0)
+        # structural (not statistical): a still agent concedes far more than
+        # the tracker does, and play continues past single points
+        assert conceded.sum() > won.sum()
+        assert np.any(conceded > 1)
         # first-to-21 match: no env may report done before conceding 21
         # (a still agent can still WIN points off tracker spin, so count
         # conceded, not net)
