@@ -151,3 +151,36 @@ def test_bf16_compute_dtype_backends(backend):
     if backend.startswith("pooled"):
         es.engine.pool.close()
         es.engine.center_pool.close()
+
+
+def test_iwes_in_algo_matrix_on_device():
+    """IW_ES honors the same record/state contract as the other algorithms
+    on its (only) backend."""
+    from estorch_tpu import IW_ES
+
+    kw = dict(BACKENDS["device"])
+    es = IW_ES(population_size=16, sigma=0.05, seed=0, table_size=1 << 14, **kw)
+    es.train(2, verbose=False)
+    assert len(es.history) == 2
+    for rec in es.history:
+        assert np.isfinite(rec["reward_mean"])
+        assert np.isfinite(rec["grad_norm"])
+        assert "reused_prev" in rec and "ess" in rec
+    assert es.generation == 2
+
+
+@pytest.mark.parametrize("mode", ["decomposed", "low_rank", "streamed"])
+def test_engine_modes_run_all_algorithms(mode):
+    """Every device forward mode composes with the novelty family (they all
+    share _eval_local), not just vanilla ES."""
+    over = {"decomposed": dict(decomposed=True),
+            "low_rank": dict(low_rank=1),
+            "streamed": dict(streamed=True)}[mode]
+    from estorch_tpu import NSR_ES
+
+    kw = dict(BACKENDS["device"])
+    es = NSR_ES(population_size=16, sigma=0.05, seed=0, table_size=1 << 14,
+                meta_population_size=2, k=3, **kw, **over)
+    es.train(2, verbose=False)
+    assert len(es.history) == 2
+    assert np.isfinite(es.history[-1]["reward_mean"])
