@@ -25,6 +25,9 @@ core count for a per-core figure if comparing to the 720-core runs).
 Stage protocol (each stage is a child process so a tunnel wedge in one
 measurement cannot take down the bench — round-1 lesson):
     bench.py --stage-one '<json cfg>'   measure one config, print one JSON
+                                        (add --cpu to force the CPU mesh —
+                                        harness validation / relative mode
+                                        numbers when the chip is absent)
     bench.py --stage-ab                 run the curated A/B subset (see
                                         AB_MATRIX; not a full cross — e.g.
                                         streamed is f32-only by design),
@@ -146,15 +149,17 @@ def measure_reference_style_baseline(budget_s=6.0) -> float:
     return steps / (time.perf_counter() - t0)
 
 
-def run_stage(cfg, timeout_s=480):
+def run_stage(cfg, timeout_s=480, force_cpu=False):
     """One config in a child with a hard timeout — the tunnel can wedge at
     init OR mid-run, and bench must still emit its JSON line.  Returns the
     child's result dict or None; diagnostics go to OUR stderr (the JSON-line
     contract owns stdout only)."""
     try:
+        argv = [sys.executable, __file__, "--stage-one", json.dumps(cfg)]
+        if force_cpu:
+            argv.append("--cpu")
         r = subprocess.run(
-            [sys.executable, __file__, "--stage-one", json.dumps(cfg)],
-            timeout=timeout_s, capture_output=True, text=True,
+            argv, timeout=timeout_s, capture_output=True, text=True,
         )
     except subprocess.TimeoutExpired:
         print(f"bench: stage timed out after {timeout_s}s (tunnel wedge?) "
@@ -201,10 +206,23 @@ AB_MATRIX = [
 ]
 
 
-def stage_ab():
+def stage_ab(force_cpu=False):
+    seen = {}
     for label, base, over in AB_MATRIX:
         cfg = {**base, **over}
-        res = run_stage(cfg, timeout_s=600)
+        if force_cpu:
+            # CPU can't run emulated bf16 at bench sizes in sane time, and
+            # relative mode comparisons only make sense at one dtype there —
+            # rows that coerce to an already-measured cfg alias its result
+            cfg = {**cfg, "dtype": "float32", "gens": 2}
+        key = json.dumps(cfg, sort_keys=True)
+        if key in seen:
+            print(json.dumps({"label": label, "alias_of": seen[key],
+                              "cfg": cfg}), flush=True)
+            continue
+        seen[key] = label
+        res = run_stage(cfg, timeout_s=1200 if force_cpu else 600,
+                        force_cpu=force_cpu)
         line = {"label": label, **(res or {"rate": None, "cfg": cfg})}
         print(json.dumps(line), flush=True)
 
@@ -254,9 +272,9 @@ def main():
 if __name__ == "__main__":
     if "--stage-one" in sys.argv:
         cfg = json.loads(sys.argv[sys.argv.index("--stage-one") + 1])
-        out = measure_one(cfg)
+        out = measure_one(cfg, force_cpu="--cpu" in sys.argv)
         print(json.dumps(out))
     elif "--stage-ab" in sys.argv:
-        stage_ab()
+        stage_ab(force_cpu="--cpu" in sys.argv)
     else:
         main()

@@ -14,9 +14,10 @@ with importance ratio
         = c^dim · exp((‖ε_i‖² − ‖ε'_i‖²)/2).
 
 Each generation this class evaluates the fresh population as usual, then
-forms the update from BOTH sets — fresh members with their ranks, reused
-members with rank × self-normalized λ — which doubles the effective sample
-count per rollout budget.  The classic failure mode (a big center move
+forms the update from fresh members with their ranks PLUS up to
+``reuse_window`` previous generations' members with rank × self-normalized
+λ (each buffered generation admitted independently by its own ESS) — up to
+(1+W)× the effective sample count per rollout budget.  The classic failure mode (a big center move
 collapses the ratios) is guarded by the effective sample size
 ESS = (Σλ)²/Σλ²: when ESS/n_old < ``ess_min`` the stale set is dropped and
 the generation proceeds as vanilla ES.  (The c^dim prefactor is common to
@@ -35,13 +36,14 @@ the two device passes the reuse needs (per-sample ε·d / ‖ε‖², and the
 
 Device path only; low_rank is not supported (packed factor noise has no
 dense ε for the ratio), and the host/pooled backends raise as usual.
-Checkpoint/resume: the one-generation reuse buffer is deliberately NOT part
-of run state — the first post-resume generation runs vanilla, then reuse
-resumes (utils/checkpoint.py stays bit-exact for everything that matters).
+Checkpoint/resume: the reuse ring is deliberately NOT part of run state —
+post-resume generations run vanilla until the ring refills (utils/
+checkpoint.py stays bit-exact for everything that matters).
 """
 
 from __future__ import annotations
 
+import collections
 import time
 from typing import Callable
 
@@ -56,10 +58,14 @@ from .es import ES
 class IW_ES(ES):
     """ES with importance-weighted reuse of the previous generation."""
 
-    def __init__(self, *args, ess_min: float = 0.5, **kwargs):
+    def __init__(self, *args, ess_min: float = 0.5, reuse_window: int = 1,
+                 **kwargs):
         if not 0.0 < ess_min <= 1.0:
             raise ValueError(f"ess_min must be in (0, 1], got {ess_min}")
+        if reuse_window < 1:
+            raise ValueError(f"reuse_window must be >= 1, got {reuse_window}")
         self.ess_min = float(ess_min)
+        self.reuse_window = int(reuse_window)
         super().__init__(*args, **kwargs)
         if self.backend != "device":
             raise ValueError(
@@ -75,7 +81,12 @@ class IW_ES(ES):
                 "IW_ES supports the standard/decomposed forwards; "
                 "streamed/noise_kernel are untested with reuse"
             )
-        self._prev: tuple | None = None  # (state, fitness np.ndarray)
+        # newest-last ring of minimal per-generation reuse records:
+        # (params_flat, sigma, pair_offsets, fitness).  Deliberately NOT the
+        # whole ESState — that would pin reuse_window copies of the optax
+        # moments (~3·W·dim floats) on device for nothing; offsets are
+        # computed ONCE here since they are a pure function of the state
+        self._prev = collections.deque(maxlen=self.reuse_window)
 
     def train(
         self,
@@ -104,22 +115,29 @@ class IW_ES(ES):
                     "check env/rollout health"
                 )
 
-            reused, ess = False, 0.0
-            if self._prev is not None:
-                prev_st, prev_fit = self._prev
-                lam, d_vec, c, old_offsets = self._ratios(prev_st, st)
-                ess = float(lam.sum() ** 2 / (lam**2).sum()) if lam.sum() > 0 else 0.0
-                reused = ess >= self.ess_min * n
-                if reused:
-                    new_st, gnorm = self._reuse_update(
-                        st, fitness, prev_fit, lam, d_vec, c, old_offsets
-                    )
-            if not reused:
+            # admit each buffered generation independently by its own ESS
+            accepted, best_ess = [], 0.0
+            for entry in self._prev:
+                lam, d_vec, c, offs = self._ratios(entry, st)
+                ess = (
+                    float(lam.sum() ** 2 / (lam**2).sum())
+                    if lam.sum() > 0 else 0.0
+                )
+                best_ess = max(best_ess, ess)
+                if ess >= self.ess_min * n:
+                    accepted.append((entry[3], lam, d_vec, c, offs))
+            reused = bool(accepted)
+            if reused:
+                new_st, gnorm = self._reuse_update(st, fitness, accepted)
+            else:
                 weights = jnp.asarray(rank_weights_with_failures(fitness))
                 new_st, gnorm = self.engine.apply_weights(st, weights)
 
             self.state = new_st
-            self._prev = (st, fitness)
+            self._prev.append((
+                st.params_flat, float(np.asarray(st.sigma)),
+                self.engine.all_pair_offsets(st), fitness,
+            ))
             jnp.asarray(new_st.params_flat).block_until_ready()
             dt = time.perf_counter() - t0
 
@@ -128,8 +146,9 @@ class IW_ES(ES):
             )
             record.update(
                 reused_prev=reused,
-                ess=round(ess, 2),
-                effective_samples=n + (n if reused else 0),
+                reused_gens=len(accepted),
+                ess=round(best_ess, 2),
+                effective_samples=n * (1 + len(accepted)),
             )
             self._emit_record(record, log_fn, verbose)
         return self
@@ -137,9 +156,11 @@ class IW_ES(ES):
     # ------------------------------------------------------------ internals
 
     def _warm_reuse_programs(self) -> float:
-        """Trace+compile noise_stats and apply_weights_reuse with the real
-        shapes OUTSIDE the timed loop (the codebase invariant: the primary
-        metric env_steps_per_sec never includes XLA compile time)."""
+        """Trace+compile noise_stats and every reuse-window shape of
+        apply_weights_reuse OUTSIDE the timed loop (the codebase invariant:
+        the primary metric env_steps_per_sec never includes compile time).
+        The concatenated old set can be any of 1..reuse_window generations
+        long, so each length is a distinct XLA program — warm them all."""
         t0 = time.perf_counter()
         st = self.state
         offsets = self.engine.all_pair_offsets(st)
@@ -147,20 +168,25 @@ class IW_ES(ES):
         self.engine.noise_stats(offsets, zeros_d)
         n_rows = int(offsets.shape[0])
         dummy_w = jnp.zeros((self.population_size,), jnp.float32)
-        dummy_old = jnp.zeros((n_rows,), jnp.float32)
-        out, _ = self.engine.apply_weights_reuse(
-            st, dummy_w, offsets, dummy_old, zeros_d, 0.0
-        )
-        jnp.asarray(out.params_flat).block_until_ready()
+        for w in range(1, self.reuse_window + 1):
+            out, _ = self.engine.apply_weights_reuse(
+                st, dummy_w,
+                jnp.tile(offsets, w), jnp.zeros((n_rows * w,), jnp.float32),
+                jnp.tile(zeros_d[None, :], (w, 1)),
+                jnp.zeros((w,), jnp.float32),
+            )
+            jnp.asarray(out.params_flat).block_until_ready()
         return time.perf_counter() - t0
 
-    def _ratios(self, prev_st, st):
-        """Per-old-member importance ratios λ under the CURRENT state."""
-        sigma_old = float(np.asarray(prev_st.sigma))
+    def _ratios(self, entry, st):
+        """Per-old-member importance ratios λ under the CURRENT state.
+
+        ``entry`` is a ring record (params_flat, sigma, pair_offsets,
+        fitness) — see train()."""
+        prev_params, sigma_old, offsets, _ = entry
         sigma_new = float(np.asarray(st.sigma))
         c = sigma_old / sigma_new
-        d_vec = (prev_st.params_flat - st.params_flat) / sigma_new
-        offsets = self.engine.all_pair_offsets(prev_st)
+        d_vec = (prev_params - st.params_flat) / sigma_new
         dots, norms = self.engine.noise_stats(offsets, d_vec)
         dots, norms = np.asarray(dots), np.asarray(norms)
         d2 = float(jnp.vdot(d_vec, d_vec))
@@ -175,33 +201,40 @@ class IW_ES(ES):
         log_lam -= log_lam.max()
         return np.exp(log_lam), d_vec, c, offsets
 
-    def _reuse_update(self, st, fitness, prev_fit, lam, d_vec, c, old_offsets):
-        """One combined-estimator update (fresh ranks + λ-weighted old ranks).
+    def _reuse_update(self, st, fitness, accepted):
+        """One combined-estimator update: fresh ranks + λ-weighted old ranks
+        from every accepted generation.
 
         Scaling contract with engine.apply_weights_reuse: fresh weights are
         rescaled by n/n_tot so the engine's 1/(n·σ) denominator becomes
         1/(n_tot·σ); the old-side coefficients arrive fully scaled.
         """
         n = self.population_size
-        n_tot = 2 * n
+        n_tot = n * (1 + len(accepted))
         sigma_new = float(np.asarray(st.sigma))
 
-        combined = np.concatenate([fitness, prev_fit])
+        combined = np.concatenate([fitness] + [a[0] for a in accepted])
         w_all = rank_weights_with_failures(combined)
-        w_fresh, w_old = w_all[:n], w_all[n:]
+        w_fresh = w_all[:n]
 
-        lam_tilde = lam * (n / max(lam.sum(), 1e-30))  # self-normalized, mean 1
-        w_old_eff = w_old * lam_tilde
-
-        # old ε-term: Σ w λ̃ (d + c·s·ε) → the s·ε part folds per pair
-        if self._mirrored:
-            folded = fold_mirrored_weights(jnp.asarray(w_old_eff))
-        else:
-            folded = jnp.asarray(w_old_eff)
-        old_w = folded * (c / (n_tot * sigma_new))
-        coeff_d = float(w_old_eff.sum() / (n_tot * sigma_new))
+        old_w_parts, offs_parts, d_rows, coeff_rows = [], [], [], []
+        for g, (prev_fit, lam, d_vec, c, offs) in enumerate(accepted):
+            w_old = w_all[n * (g + 1): n * (g + 2)]
+            lam_tilde = lam * (n / max(lam.sum(), 1e-30))  # mean-1 normalized
+            w_old_eff = w_old * lam_tilde
+            # old ε-term: Σ w λ̃ (d + c·s·ε) → the s·ε part folds per pair
+            if self._mirrored:
+                folded = fold_mirrored_weights(jnp.asarray(w_old_eff))
+            else:
+                folded = jnp.asarray(w_old_eff)
+            old_w_parts.append(folded * (c / (n_tot * sigma_new)))
+            offs_parts.append(offs)
+            d_rows.append(d_vec)
+            coeff_rows.append(w_old_eff.sum() / (n_tot * sigma_new))
 
         weights = jnp.asarray(w_fresh * (n / n_tot))
         return self.engine.apply_weights_reuse(
-            st, weights, old_offsets, old_w, d_vec, coeff_d
+            st, weights,
+            jnp.concatenate(offs_parts), jnp.concatenate(old_w_parts),
+            jnp.stack(d_rows), jnp.asarray(coeff_rows, jnp.float32),
         )

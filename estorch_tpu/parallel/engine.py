@@ -741,22 +741,28 @@ class ESEngine:
 
     def apply_weights_reuse(
         self, state: ESState, weights: jax.Array, old_offsets: jax.Array,
-        old_w: jax.Array, d_vec: jax.Array, coeff_d,
+        old_w: jax.Array, d_stack: jax.Array, coeff_d,
     ):
-        """Update from fresh rank weights PLUS a reused-sample term.
+        """Update from fresh rank weights PLUS reused-sample terms.
 
-        The combined-estimator scaling contract (algo/iwes.py): ``weights``
-        are pre-scaled so the engine's internal 1/(population·σ) yields
-        1/(n_total·σ); ``old_w`` (per old PAIR when mirrored, per old member
-        otherwise) and ``coeff_d`` arrive FULLY pre-scaled, so the reuse
-        terms are added raw:  ∇̂ += Σ old_w·ε_old + coeff_d·d_vec.
+        Supports a multi-generation reuse window: ``old_offsets``/``old_w``
+        are the CONCATENATION over reused generations (per old PAIR when
+        mirrored, per old member otherwise), ``d_stack`` is (n_gens, dim)
+        of per-generation drift vectors and ``coeff_d`` their (n_gens,)
+        coefficients.  The combined-estimator scaling contract
+        (algo/iwes.py): ``weights`` are pre-scaled so the engine's internal
+        1/(population·σ) yields 1/(n_total·σ); ``old_w`` and ``coeff_d``
+        arrive FULLY pre-scaled, so the reuse terms are added raw:
+        ∇̂ += Σ old_w·ε_old + coeff_d @ d_stack.
         """
         self._require_dense_noise("apply_weights_reuse")
+        d_stack = jnp.atleast_2d(d_stack)
+        coeff_d = jnp.atleast_1d(jnp.asarray(coeff_d, jnp.float32))
         if not hasattr(self, "_apply_weights_reuse_progs"):
             self._apply_weights_reuse_progs = {}
-        cache_n = int(old_offsets.shape[0])
-        if cache_n not in self._apply_weights_reuse_progs:
-            n_old = cache_n
+        cache_key = (int(old_offsets.shape[0]), int(d_stack.shape[0]))
+        if cache_key not in self._apply_weights_reuse_progs:
+            n_old = cache_key[0]
             k_local = n_old // self.n_devices
             if k_local * self.n_devices != n_old:
                 raise ValueError(
@@ -764,7 +770,7 @@ class ESEngine:
                     f"{self.n_devices} devices"
                 )
 
-            def body(state, weights, old_offs, old_w, d_vec, coeff_d):
+            def body(state, weights, old_offs, old_w, d_st, cd):
                 red_offs, _, _, _ = self._local_offsets_signs_keys(state)
                 grad_local = self._local_grad(state, weights, red_offs)
                 dev = jax.lax.axis_index(POP_AXIS)
@@ -779,10 +785,10 @@ class ESEngine:
                     dim=self.spec.dim, chunk=self.config.grad_chunk,
                 )
                 grad_ascent = jax.lax.psum(grad_local, POP_AXIS)
-                grad_ascent = grad_ascent + coeff_d * d_vec
+                grad_ascent = grad_ascent + cd @ d_st
                 return self._finish_update(state, grad_ascent)
 
-            self._apply_weights_reuse_progs[cache_n] = jax.jit(
+            self._apply_weights_reuse_progs[cache_key] = jax.jit(
                 jax.shard_map(
                     body, mesh=self.mesh,
                     in_specs=(P(), P(), P(), P(), P(), P()),
@@ -790,9 +796,8 @@ class ESEngine:
                     check_vma=False,
                 )
             )
-        return self._apply_weights_reuse_progs[cache_n](
-            state, weights, old_offsets, old_w, d_vec,
-            jnp.asarray(coeff_d, jnp.float32),
+        return self._apply_weights_reuse_progs[cache_key](
+            state, weights, old_offsets, old_w, d_stack, coeff_d,
         )
 
     def evaluate_center(self, state: ESState):

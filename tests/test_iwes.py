@@ -37,10 +37,13 @@ class TestRatios:
         """λ from engine noise_stats must equal the direct Gaussian density
         ratio computed from each materialized old member."""
         es = _make()
-        es.train(2, verbose=False)
-        prev_st, _ = es._prev
+        es.train(1, verbose=False)
+        prev_st = es.state  # snapshot a REAL state (the ring keeps only a
+        es.train(1, verbose=False)  # minimal record; member_params needs it)
         st = es.state
-        lam, d_vec, c, old_offsets = es._ratios(prev_st, st)
+        entry = (prev_st.params_flat, float(np.asarray(prev_st.sigma)),
+                 es.engine.all_pair_offsets(prev_st), None)
+        lam, d_vec, c, old_offsets = es._ratios(entry, st)
 
         dim = es._spec.dim
         s_old = float(np.asarray(prev_st.sigma))
@@ -63,7 +66,10 @@ class TestRatios:
         """θ_new == θ_old and equal σ → every λ identical → ESS == n."""
         es = _make()
         es.train(1, verbose=False)  # populate state only
-        lam, d_vec, c, _ = es._ratios(es.state, es.state)
+        st = es.state
+        entry = (st.params_flat, float(np.asarray(st.sigma)),
+                 es.engine.all_pair_offsets(st), None)
+        lam, d_vec, c, _ = es._ratios(entry, st)
         np.testing.assert_allclose(lam, lam[0])
         ess = lam.sum() ** 2 / (lam**2).sum()
         assert ess == pytest.approx(es.population_size)
@@ -74,15 +80,19 @@ class TestUpdate:
         """engine.apply_weights_reuse == hand-built combined estimator on
         materialized noise, run through the same optax transform."""
         es = _make(n_pop=16)
-        es.train(2, verbose=False)
+        es.train(1, verbose=False)
+        prev_st = es.state
+        prev_fit = np.asarray(es.engine.evaluate(prev_st).fitness)
+        es.train(1, verbose=False)
         st = es.state
-        prev_st, prev_fit = es._prev
 
         ev = es.engine.evaluate(st)
         fitness = np.asarray(ev.fitness)
-        lam, d_vec, c, old_offsets = es._ratios(prev_st, st)
+        entry = (prev_st.params_flat, float(np.asarray(prev_st.sigma)),
+                 es.engine.all_pair_offsets(prev_st), prev_fit)
+        lam, d_vec, c, old_offsets = es._ratios(entry, st)
         new_st, gnorm = es._reuse_update(
-            st, fitness, prev_fit, lam, d_vec, c, old_offsets
+            st, fitness, [(prev_fit, lam, d_vec, c, old_offsets)]
         )
 
         # ---- oracle ----
@@ -133,6 +143,30 @@ class TestUpdate:
         es2.train(6, verbose=False)
         assert any(r["reused_prev"] for r in es2.history)
         assert all(r["ess"] >= 0.0 for r in es2.history)
+
+    def test_multi_generation_window(self):
+        """reuse_window=3: the ring fills, multiple generations are admitted
+        once moves settle, and effective_samples scales with reused_gens."""
+        es = _make(reuse_window=3)
+        es.train(12, verbose=False)
+        gens = [r["reused_gens"] for r in es.history]
+        assert max(gens) >= 2, gens  # at least one update used 2+ old gens
+        for r in es.history:
+            assert r["effective_samples"] == 16 * (1 + r["reused_gens"])
+        assert np.isfinite(es.history[-1]["reward_mean"])
+
+    def test_window_mesh_invariance(self):
+        from estorch_tpu.parallel.mesh import population_mesh
+
+        es8 = _make(reuse_window=2)
+        es1 = _make(reuse_window=2, mesh=population_mesh(jax.devices()[:1]))
+        es8.train(4, verbose=False)
+        es1.train(4, verbose=False)
+        np.testing.assert_allclose(
+            np.asarray(es8.state.params_flat),
+            np.asarray(es1.state.params_flat),
+            rtol=0, atol=1e-6,
+        )
 
     def test_records_have_iw_fields(self):
         es = _make()
