@@ -87,6 +87,9 @@ class IW_ES(ES):
         # moments (~3·W·dim floats) on device for nothing; offsets are
         # computed ONCE here since they are a pure function of the state
         self._prev = collections.deque(maxlen=self.reuse_window)
+        self._dry_gens = 0  # consecutive full-ring generations with no reuse
+        self._dry_best_ess = 0.0  # best ESS seen anywhere in the dry streak
+        self._warned_never_reusing = False
 
     def train(
         self,
@@ -128,8 +131,14 @@ class IW_ES(ES):
                     accepted.append((entry[3], lam, d_vec, c, offs))
             reused = bool(accepted)
             if reused:
+                self._dry_gens = 0
+                self._dry_best_ess = 0.0
                 new_st, gnorm = self._reuse_update(st, fitness, accepted)
             else:
+                if len(self._prev) == self.reuse_window:
+                    self._dry_gens += 1
+                    self._dry_best_ess = max(self._dry_best_ess, best_ess)
+                    self._maybe_warn_never_reusing()
                 weights = jnp.asarray(rank_weights_with_failures(fitness))
                 new_st, gnorm = self.engine.apply_weights(st, weights)
 
@@ -154,6 +163,36 @@ class IW_ES(ES):
         return self
 
     # ------------------------------------------------------------ internals
+
+    DRY_WARN_AFTER = 20
+
+    def _maybe_warn_never_reusing(self) -> None:
+        """One-time diagnostic when the ESS guard rejects every generation.
+
+        The log-ratio spread is d·ε ~ N(0, ‖Δθ/σ‖²), so reuse survives only
+        when the per-generation center move is small: with a coordinate-wise
+        optimizer (Adam) that means lr ≲ σ/√dim.  Users who pick a
+        known-good vanilla-ES lr are usually 10× above that and silently get
+        vanilla ES at IW-ES prices — say so once, with the fix."""
+        if self._warned_never_reusing or self._dry_gens < self.DRY_WARN_AFTER:
+            return
+        self._warned_never_reusing = True
+        import warnings
+
+        sigma = float(np.asarray(self.state.sigma))
+        warnings.warn(
+            f"IW_ES: no generation passed the ESS guard in the last "
+            f"{self._dry_gens} generations (best ESS over the streak "
+            f"{self._dry_best_ess:.1f} < ess_min*n = "
+            f"{self.ess_min * self.population_size:.1f}); every "
+            "update ran as vanilla ES while paying the ratio-computation "
+            "overhead. The center is moving too far per generation for "
+            "reuse: shrink the step so that lr ≲ sigma/sqrt(dim) "
+            f"(≈ {sigma / max(self._spec.dim, 1) ** 0.5:.1e} here), or raise "
+            "sigma, or drop back to plain ES.",
+            RuntimeWarning,
+            stacklevel=3,
+        )
 
     def _warm_reuse_programs(self) -> float:
         """Trace+compile noise_stats and every reuse-window shape of

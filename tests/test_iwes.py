@@ -144,6 +144,30 @@ class TestUpdate:
         assert any(r["reused_prev"] for r in es2.history)
         assert all(r["ess"] >= 0.0 for r in es2.history)
 
+    def test_never_reusing_warns_once_with_heuristic(self):
+        """20+ consecutive ESS rejections → one RuntimeWarning naming the
+        lr ≲ σ/√dim fix; reuse-friendly runs stay silent."""
+        import warnings
+
+        es = _make(optimizer_kwargs={"learning_rate": 5.0})
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            es.train(IW_ES.DRY_WARN_AFTER + 3, verbose=False)
+        msgs = [w for w in caught if issubclass(w.category, RuntimeWarning)
+                and "ESS guard" in str(w.message)]
+        assert len(msgs) == 1, [str(w.message) for w in caught]
+        assert "sigma/sqrt(dim)" in str(msgs[0].message)
+        assert not any(r["reused_prev"] for r in es.history)
+
+        es2 = _make()  # tame lr: reuses, so no warning even over many gens
+        with warnings.catch_warnings(record=True) as caught2:
+            warnings.simplefilter("always")
+            es2.train(IW_ES.DRY_WARN_AFTER + 3, verbose=False)
+        assert not [w for w in caught2
+                    if issubclass(w.category, RuntimeWarning)
+                    and "ESS guard" in str(w.message)]
+        assert any(r["reused_prev"] for r in es2.history)
+
     def test_multi_generation_window(self):
         """reuse_window=3: the ring fills, multiple generations are admitted
         once moves settle, and effective_samples scales with reused_gens."""
