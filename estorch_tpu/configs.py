@@ -151,6 +151,31 @@ def hopper2d_device(**over):
     return ES(**kw)
 
 
+def walker2d_device(**over):
+    """Device-native locomotion, planar biped (Walker2d-class): two-legged
+    balance + gait with falling termination — the in-tree stepping stone
+    toward the Humanoid north star."""
+    import optax
+
+    from . import ES, JaxAgent, MLPPolicy
+    from .envs import Walker2D
+
+    env = Walker2D()
+    kw = dict(
+        policy=MLPPolicy,
+        agent=JaxAgent,
+        optimizer=optax.adam,
+        population_size=1024,
+        sigma=0.08,
+        policy_kwargs={"action_dim": env.action_dim, "hidden": (64, 64),
+                       "discrete": False, "action_scale": 1.0},
+        agent_kwargs={"env": env, "horizon": 400},
+        optimizer_kwargs={"learning_rate": 2e-2},
+    )
+    kw.update(over)
+    return ES(**kw)
+
+
 def cheetah2d_device(**over):
     """Device-native locomotion, 7-body planar runner (HalfCheetah-class):
     the on-chip stand-in for BASELINE config 2 until mjx is installable."""
@@ -316,6 +341,7 @@ CONFIGS: dict[str, Callable] = {
     "cartpole_smoke": cartpole_smoke,
     "swimmer2d_device": swimmer2d_device,
     "hopper2d_device": hopper2d_device,
+    "walker2d_device": walker2d_device,
     "cheetah2d_device": cheetah2d_device,
     "halfcheetah_vbn": halfcheetah_vbn,
     "humanoid_mirrored": humanoid_mirrored,

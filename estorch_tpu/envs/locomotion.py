@@ -19,7 +19,7 @@ compiles into one ``lax.scan`` and a population of episodes into one
 ``vmap`` over it, exactly like the classic-control envs (envs/base.py).
 
 Honesty of difficulty: the tasks reward forward velocity with control
-costs, terminate on falling (hopper), and are deceptive enough that random
+costs, terminate on falling (hopper, walker), and are deceptive enough that random
 policies score ~0; they are NOT step-for-step MuJoCo ports (different
 integrator, soft joints) and make no parity claim — reward scales are
 task-local.  MuJoCo-the-library stays supported on the host/pooled paths
@@ -225,19 +225,47 @@ def _init_state(chain: _Chain, key):
 
 
 class _PlanarBase:
-    """Shared JaxEnv plumbing over a _Chain; subclasses define chain,
+    """Shared JaxEnv plumbing over a _Chain; subclasses define the chain and
+    set the obs/reward knobs below (or override `_obs`/`_reward_done`
+    outright, as the swimmer's observation does).
 
-    observation, reward, and termination."""
+    Class-level knobs (plain attributes, not dataclass fields):
+      upright_offset — torso rest angle, subtracted in obs and used as the
+                       lean reference for termination
+      alive_bonus / ctrl_cost — reward shaping
+      min_height / max_lean — falling termination; min_height None → the
+                       env never terminates (swimmer, cheetah)
+    """
 
     chain: _Chain
     discrete: bool = False
     action_bound: float = 1.0
+    upright_offset: float = 0.0
+    alive_bonus: float = 0.0
+    ctrl_cost: float = 1e-3
+    min_height = None
+    max_lean = None
 
     def _obs(self, state):
-        raise NotImplementedError
+        """Standard runner observation: torso height + lean, joint angles,
+        torso velocity/spin, joint rates (the MuJoCo runner layout)."""
+        return jnp.concatenate([
+            jnp.array([state["pos"][0, 1],
+                       state["theta"][0] - self.upright_offset]),
+            _joint_angles(self.chain, state),
+            state["vel"][0] * 0.3,
+            jnp.array([state["omega"][0] * 0.1]),
+            _joint_rates(self.chain, state) * 0.1,
+        ])
 
     def _reward_done(self, prev, state, action):
-        raise NotImplementedError
+        vx = (state["pos"][0, 0] - prev["pos"][0, 0]) / self.control_dt
+        reward = self.alive_bonus + vx - self.ctrl_cost * jnp.sum(action**2)
+        if self.min_height is None:
+            return reward, jnp.bool_(False)
+        lean = jnp.abs(state["theta"][0] - self.upright_offset)
+        done = (state["pos"][0, 1] < self.min_height) | (lean > self.max_lean)
+        return reward, done
 
     def _finalize_chain(self, chain: _Chain):
         """Snap init positions to the joint graph and install the chain."""
@@ -325,6 +353,8 @@ class Swimmer2D(_PlanarBase):
         object.__setattr__(self, "obs_dim", 2 * (n - 1) + n + 2)
         object.__setattr__(self, "action_dim", n - 1)
 
+    ctrl_cost = 1e-4
+
     def _obs(self, state):
         return jnp.concatenate([
             _joint_angles(self.chain, state),
@@ -332,11 +362,6 @@ class Swimmer2D(_PlanarBase):
             state["theta"],  # absolute link angles (heading)
             state["vel"][0] * 0.5,  # head velocity
         ])
-
-    def _reward_done(self, prev, state, action):
-        vx = (state["pos"][0, 0] - prev["pos"][0, 0]) / self.control_dt
-        reward = vx - 1e-4 * jnp.sum(action**2)
-        return reward, jnp.bool_(False)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -374,23 +399,61 @@ class Hopper2D(_PlanarBase):
         )
         self._finalize_chain(chain)
 
-    def _obs(self, state):
-        torso = state["pos"][0]
-        return jnp.concatenate([
-            jnp.array([torso[1], state["theta"][0] - jnp.pi / 2]),
-            _joint_angles(self.chain, state),
-            state["vel"][0] * 0.3,
-            jnp.array([state["omega"][0] * 0.1]),
-            _joint_rates(self.chain, state) * 0.1,
-        ])
+    upright_offset = jnp.pi / 2
+    alive_bonus = 1.0
+    min_height = 0.6
+    max_lean = 0.7
 
-    def _reward_done(self, prev, state, action):
-        vx = (state["pos"][0, 0] - prev["pos"][0, 0]) / self.control_dt
-        reward = 1.0 + vx - 1e-3 * jnp.sum(action**2)
-        height = state["pos"][0, 1]
-        upright = jnp.abs(state["theta"][0] - jnp.pi / 2)
-        done = (height < 0.6) | (upright > 0.7)
-        return reward, done
+
+@dataclasses.dataclass(frozen=True)
+class Walker2D(_PlanarBase):
+    """Planar biped walker (MuJoCo Walker2d-class): torso + two hopper legs.
+
+    The nearest in-tree step toward the Humanoid north star: the policy
+    must BALANCE on two legs (terminates when the torso falls, unlike the
+    cheetah whose torso rides on four attachment points) and coordinate an
+    alternating gait.  7 bodies, 6 actuated joints.  Reward: alive bonus +
+    forward velocity − control cost (the MuJoCo shaping).  Legs start with
+    slightly asymmetric knee/hip bends so the symmetric do-nothing policy
+    is unstable enough to explore away from.
+    """
+
+    obs_dim: int = 17
+    action_dim: int = 6
+    default_horizon: int = 500
+    bc_dim: int = 2
+
+    def __post_init__(self):
+        # bodies: 0 torso (upright); 1-3 left thigh/shin/foot; 4-6 right.
+        # Both hips share the torso's lower anchor, like Walker2d's pelvis.
+        chain = _Chain(
+            mass=(3.5, 1.0, 1.0, 0.6, 1.0, 1.0, 0.6),
+            half_len=(0.2, 0.2, 0.25, 0.13, 0.2, 0.25, 0.13),
+            init_pos=((0.0, 1.05),) + ((0.0, 0.0),) * 6,
+            init_angle=(
+                jnp.pi / 2,
+                jnp.pi / 2 + 0.08, jnp.pi / 2 - 0.16, 0.0,
+                jnp.pi / 2 - 0.08, jnp.pi / 2 - 0.02, 0.0,
+            ),
+            parent=(0, 1, 2, 0, 4, 5),
+            child=(1, 2, 3, 4, 5, 6),
+            parent_end=(-1.0, -1.0, -1.0, -1.0, -1.0, -1.0),
+            child_end=(1.0, 1.0, -1.0, 1.0, 1.0, -1.0),
+            rest_angle=(0.0, 0.0, -jnp.pi / 2, 0.0, 0.0, -jnp.pi / 2),
+            limit_lo=(-1.0, -1.5, -0.6, -1.0, -1.5, -0.6),
+            limit_hi=(1.0, 0.1, 0.6, 1.0, 0.1, 0.6),
+            gear=(800.0, 800.0, 500.0, 800.0, 800.0, 500.0),
+            gravity=-9.81,
+            ground=True,
+            dt=0.002,
+            frame_skip=8,
+        )
+        self._finalize_chain(chain)
+
+    upright_offset = jnp.pi / 2
+    alive_bonus = 1.0
+    min_height = 0.7
+    max_lean = 1.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -438,16 +501,4 @@ class Cheetah2D(_PlanarBase):
         )
         self._finalize_chain(chain)
 
-    def _obs(self, state):
-        return jnp.concatenate([
-            jnp.array([state["pos"][0, 1], state["theta"][0]]),
-            _joint_angles(self.chain, state),
-            state["vel"][0] * 0.3,
-            jnp.array([state["omega"][0] * 0.1]),
-            _joint_rates(self.chain, state) * 0.1,
-        ])
-
-    def _reward_done(self, prev, state, action):
-        vx = (state["pos"][0, 0] - prev["pos"][0, 0]) / self.control_dt
-        reward = vx - 0.05 * jnp.sum(action**2)
-        return reward, jnp.bool_(False)
+    ctrl_cost = 0.05

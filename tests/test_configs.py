@@ -12,6 +12,7 @@ class TestConfigs:
             "cartpole_smoke",
             "swimmer2d_device",
             "hopper2d_device",
+            "walker2d_device",
             "cheetah2d_device",
             "halfcheetah_vbn",
             "humanoid_mirrored",
@@ -32,11 +33,13 @@ class TestConfigs:
             cheetah2d_device,
             hopper2d_device,
             swimmer2d_device,
+            walker2d_device,
         )
 
-        # hopper included deliberately: it is the one locomotion env with a
-        # termination path (falling) through the rollout done-mask
-        for recipe in (swimmer2d_device, hopper2d_device, cheetah2d_device):
+        # hopper/walker included deliberately: they are the locomotion envs
+        # with a termination path (falling) through the rollout done-mask
+        for recipe in (swimmer2d_device, hopper2d_device, walker2d_device,
+                       cheetah2d_device):
             es = recipe(population_size=16, table_size=1 << 16)
             es.train(1, verbose=False)
             assert es.backend == "device"

@@ -11,10 +11,11 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from estorch_tpu.envs import Cheetah2D, Hopper2D, Swimmer2D, make_rollout
+from estorch_tpu.envs import (Cheetah2D, Hopper2D, Swimmer2D, Walker2D,
+                              make_rollout)
 from estorch_tpu.envs.locomotion import _anchor_world
 
-ENVS = [Swimmer2D, Hopper2D, Cheetah2D]
+ENVS = [Swimmer2D, Hopper2D, Walker2D, Cheetah2D]
 
 
 @pytest.mark.parametrize("Env", ENVS)
@@ -120,6 +121,31 @@ class TestSemantics:
             a = 0.9 * jnp.sin(phase + jnp.arange(env.action_dim) * 2.0)
             state, obs, r, d = step(state, a)
         assert abs(float(state["pos"][0, 0])) > 0.5
+
+    def test_walker_terminates_on_fall_and_lean(self):
+        env = Walker2D()
+        state, _ = env.reset(jax.random.key(0))
+        dropped = dict(state, pos=state["pos"].at[0, 1].set(0.4))
+        _, _, _, done = env.step(dropped, jnp.zeros(env.action_dim))
+        assert bool(done)
+        # the stiff joints pull a teleported torso back toward the legs
+        # within one control step, so overshoot the 1.0 threshold
+        leaned = dict(state, theta=state["theta"].at[0].add(1.6))
+        _, _, _, done = env.step(leaned, jnp.zeros(env.action_dim))
+        assert bool(done)
+
+    def test_walker_stands_briefly_unactuated(self):
+        """The asymmetric-but-planted init must not fall within the first
+        few control steps with zero torque — a policy gets a fair chance to
+        act before gravity decides (falling WILL happen eventually; the
+        alive bonus exists because standing is nontrivial)."""
+        env = Walker2D()
+        state, _ = env.reset(jax.random.key(0))
+        step = jax.jit(env.step)
+        for _ in range(5):
+            state, obs, r, done = step(state, jnp.zeros(env.action_dim))
+            assert np.all(np.isfinite(np.asarray(obs)))
+        assert not bool(done)
 
     def test_cheetah_settles_without_penetration(self):
         """Zero action: an unactuated torque-controlled cheetah slumps (as
