@@ -10,8 +10,10 @@ scanned step is a real env step; no done-mask inflation) with a 64x64 MLP,
 population 4096, horizon 200: ~819k env steps per generation.
 
 extras: a Humanoid-sized-policy point (SyntheticEnv obs 376 → 256×256 → 17,
-the __graft_entry__ flagship shape) and a pop-10240 point, each with an MFU
-estimate.  "mfu" is always policy-forward FLOPs against the v5e bf16 peak
+the __graft_entry__ flagship shape), a pop-10240 point, and a
+physics-on-chip locomotion point (Cheetah2D — never terminates, so its
+step counts carry the same honesty property; its MFU counts policy-forward
+FLOPs only, not the physics).  "mfu" is always policy-forward FLOPs against the v5e bf16 peak
 (197 TFLOP/s) regardless of config dtype — one fixed denominator keeps
 cross-dtype A/B numbers comparable — and is null off-TPU (a CPU rate
 against a TPU peak means nothing).
@@ -51,15 +53,24 @@ BIG = {"env": "synthetic", "hidden": [256, 256], "population": 4096,
 POP10K = {"env": "synthetic", "hidden": [256, 256], "population": 10240,
           "horizon": 200, "eval_chunk": 1024}  # bound materialized member
 # weights: whole-shard at 10240x166k floats would gamble with 16 GB HBM
+LOCO = {"env": "cheetah2d", "hidden": [64, 64], "population": 1024,
+        "horizon": 200}  # physics-on-chip point (cheetah2d_device recipe)
 
 
 def _env_and_policy(cfg):
-    from estorch_tpu.envs import Pendulum, SyntheticEnv
+    from estorch_tpu.envs import Cheetah2D, Pendulum, SyntheticEnv
 
     if cfg["env"] == "pendulum":
         env = Pendulum()
         pk = {"action_dim": 1, "hidden": tuple(cfg["hidden"]),
               "discrete": False, "action_scale": 2.0}
+    elif cfg["env"] == "cheetah2d":
+        # device-native physics INSIDE the generation program; cheetah never
+        # terminates, so every scanned step is a real env step (same honesty
+        # property the Pendulum headline relies on)
+        env = Cheetah2D()
+        pk = {"action_dim": env.action_dim, "hidden": tuple(cfg["hidden"]),
+              "discrete": False, "action_scale": 1.0}
     else:
         env = SyntheticEnv()
         pk = {"action_dim": env.action_dim, "hidden": tuple(cfg["hidden"]),
@@ -204,6 +215,8 @@ AB_MATRIX = [
      {"dtype": "bfloat16", "decomposed": True, "gens": 3}),
     ("pop10k/lowrank1/bf16", POP10K,
      {"dtype": "bfloat16", "low_rank": 1, "gens": 3}),
+    ("loco/standard/bf16", LOCO, {"dtype": "bfloat16", "gens": 3}),
+    ("loco/standard/f32", LOCO, {"dtype": "float32", "gens": 3}),
 ]
 
 
@@ -247,7 +260,8 @@ def main():
     mfu = result["mfu"]
     extras = {"mfu_headline": round(mfu, 6) if mfu is not None else None}
     if on_tpu:
-        for name, base in (("big_policy", BIG), ("pop10k", POP10K)):
+        for name, base in (("big_policy", BIG), ("pop10k", POP10K),
+                           ("locomotion", LOCO)):
             r = run_stage({**base, "gens": 3}, timeout_s=600)
             extras[name] = (
                 {"rate": round(r["rate"], 1),
