@@ -124,6 +124,20 @@ def measure_one(cfg, force_cpu=False):
     n_chips = es.mesh.devices.size
     rate = steps / dt / n_chips
     platform = es.mesh.devices.flat[0].platform
+
+    # memory evidence rides along with every point: device peak HBM (TPU
+    # PJRT memory_stats; absent on the CPU backend) and host peak RSS —
+    # the noise-table/chunking sizing claims need numbers, not prose
+    peak_hbm = None
+    if platform == "tpu":
+        stats = es.mesh.devices.flat[0].memory_stats() or {}
+        peak = stats.get("peak_bytes_in_use")
+        peak_hbm = round(peak / 2**30, 3) if peak else None
+    import resource
+
+    peak_rss = round(
+        resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 2**20, 3
+    )  # ru_maxrss is KiB on Linux
     return {
         "rate": rate,
         "platform": platform,
@@ -131,6 +145,8 @@ def measure_one(cfg, force_cpu=False):
         # fixed bf16-peak denominator (see module docstring); null off-TPU
         "mfu": (rate * policy_flops_per_member_step(cfg) / V5E_BF16_PEAK
                 if platform == "tpu" else None),
+        "peak_hbm_gb": peak_hbm,
+        "peak_rss_gb": peak_rss,
         "cfg": cfg,
     }
 
@@ -187,6 +203,7 @@ def run_stage(cfg, timeout_s=480, force_cpu=False):
         out = json.loads(last)
         float(out["rate"]), str(out["platform"]), str(out["dtype"])
         _ = out["mfu"]  # may be null off-TPU, but the key must exist
+        _ = out["peak_hbm_gb"], out["peak_rss_gb"]  # memory evidence keys
         return out
     except (IndexError, KeyError, TypeError, ValueError):
         print(f"bench: stage output unparseable cfg={cfg}; stdout tail:\n"
@@ -266,7 +283,8 @@ def main():
             extras[name] = (
                 {"rate": round(r["rate"], 1),
                  "mfu": round(r["mfu"], 6) if r["mfu"] is not None else None,
-                 "dtype": r["dtype"]}
+                 "dtype": r["dtype"],
+                 "peak_hbm_gb": r.get("peak_hbm_gb")}
                 if r else None
             )
 
