@@ -156,6 +156,28 @@ class TestNoveltyTraining:
         for key in ("meta_index", "novelty_mean", "archive_size", "center_reward"):
             assert key in rec
 
+    def test_nsr_es_on_locomotion_bc(self):
+        """Novelty family composes with the device-native locomotion envs:
+        the BC is the env's own behavior() (final torso x, y), so archive
+        entries are 2-D displacement points, and training runs end-to-end
+        inside the compiled generation."""
+        from estorch_tpu.envs import Hopper2D
+
+        env = Hopper2D()
+        es = NSR_ES(
+            MLPPolicy, JaxAgent, optax.adam,
+            population_size=16, sigma=0.1, seed=1,
+            policy_kwargs={"action_dim": env.action_dim, "hidden": (8,),
+                           "discrete": False, "action_scale": 1.0},
+            agent_kwargs={"env": env, "horizon": 40},
+            optimizer_kwargs={"learning_rate": 1e-2},
+            table_size=1 << 16, meta_population_size=2, k=3,
+        )
+        es.train(2, verbose=False)
+        assert es.archive.bc_dim == env.bc_dim == 2
+        assert len(es.archive) == 2 + 2
+        assert np.isfinite(es.history[-1]["reward_mean"])
+
     def test_nsr_es_trains(self):
         es = self._train(NSR_ES)
         assert len(es.history) == 3
