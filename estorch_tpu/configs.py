@@ -103,101 +103,70 @@ def cartpole_smoke(**over):
     return ES(**kw)
 
 
-def swimmer2d_device(**over):
-    """Device-native locomotion: pure-JAX planar swimmer, whole generation
-    compiled on-chip (envs/locomotion.py — the MJX-fallback path)."""
+def _planar_device(env, population, hidden, horizon, lr, over,
+                   sigma=0.08):
+    """Shared recipe body for the device-native locomotion configs: MLP
+    policy on the JaxAgent path, physics compiled into the generation."""
     import optax
 
     from . import ES, JaxAgent, MLPPolicy
-    from .envs import Swimmer2D
 
-    env = Swimmer2D()
     kw = dict(
         policy=MLPPolicy,
         agent=JaxAgent,
         optimizer=optax.adam,
-        population_size=512,
-        sigma=0.08,
-        policy_kwargs={"action_dim": env.action_dim, "hidden": (32, 32),
+        population_size=population,
+        sigma=sigma,
+        policy_kwargs={"action_dim": env.action_dim, "hidden": hidden,
                        "discrete": False, "action_scale": 1.0},
-        agent_kwargs={"env": env, "horizon": 300},
-        optimizer_kwargs={"learning_rate": 3e-2},
+        agent_kwargs={"env": env, "horizon": horizon},
+        optimizer_kwargs={"learning_rate": lr},
     )
     kw.update(over)
     return ES(**kw)
+
+
+def swimmer2d_device(**over):
+    """Device-native locomotion: pure-JAX planar swimmer, whole generation
+    compiled on-chip (envs/locomotion.py — the MJX-fallback path)."""
+    from .envs import Swimmer2D
+
+    return _planar_device(Swimmer2D(), 512, (32, 32), 300, 3e-2, over)
 
 
 def hopper2d_device(**over):
     """Device-native locomotion with contact + falling termination: pure-JAX
     planar hopper (envs/locomotion.py), Hopper-class difficulty."""
-    import optax
-
-    from . import ES, JaxAgent, MLPPolicy
     from .envs import Hopper2D
 
-    env = Hopper2D()
-    kw = dict(
-        policy=MLPPolicy,
-        agent=JaxAgent,
-        optimizer=optax.adam,
-        population_size=1024,
-        sigma=0.08,
-        policy_kwargs={"action_dim": env.action_dim, "hidden": (64, 64),
-                       "discrete": False, "action_scale": 1.0},
-        agent_kwargs={"env": env, "horizon": 400},
-        optimizer_kwargs={"learning_rate": 2e-2},
-    )
-    kw.update(over)
-    return ES(**kw)
+    return _planar_device(Hopper2D(), 1024, (64, 64), 400, 2e-2, over)
 
 
 def walker2d_device(**over):
     """Device-native locomotion, planar biped (Walker2d-class): two-legged
     balance + gait with falling termination — the in-tree stepping stone
     toward the Humanoid north star."""
-    import optax
-
-    from . import ES, JaxAgent, MLPPolicy
     from .envs import Walker2D
 
-    env = Walker2D()
-    kw = dict(
-        policy=MLPPolicy,
-        agent=JaxAgent,
-        optimizer=optax.adam,
-        population_size=1024,
-        sigma=0.08,
-        policy_kwargs={"action_dim": env.action_dim, "hidden": (64, 64),
-                       "discrete": False, "action_scale": 1.0},
-        agent_kwargs={"env": env, "horizon": 400},
-        optimizer_kwargs={"learning_rate": 2e-2},
-    )
-    kw.update(over)
-    return ES(**kw)
+    return _planar_device(Walker2D(), 1024, (64, 64), 400, 2e-2, over)
+
+
+def humanoid2d_device(**over):
+    """Device-native locomotion, planar humanoid (11 bodies, 10 joints):
+    the hardest in-tree task — balance a jointed column on two legs with
+    free-swinging arm counterweights — and the device-native stand-in for
+    the MuJoCo-Humanoid configs (BASELINE config 3 stays on host/pooled)."""
+    from .envs import Humanoid2D
+
+    return _planar_device(Humanoid2D(), 1024, (64, 64), 400, 2e-2, over)
 
 
 def cheetah2d_device(**over):
     """Device-native locomotion, 7-body planar runner (HalfCheetah-class):
     the on-chip stand-in for BASELINE config 2 until mjx is installable."""
-    import optax
-
-    from . import ES, JaxAgent, MLPPolicy
     from .envs import Cheetah2D
 
-    env = Cheetah2D()
-    kw = dict(
-        policy=MLPPolicy,
-        agent=JaxAgent,
-        optimizer=optax.adam,
-        population_size=1024,
-        sigma=0.08,
-        policy_kwargs={"action_dim": env.action_dim, "hidden": (64, 64),
-                       "discrete": False, "action_scale": 1.0},
-        agent_kwargs={"env": env, "horizon": 500},
-        optimizer_kwargs={"learning_rate": 2e-2},
-    )
-    kw.update(over)
-    return ES(**kw)
+    return _planar_device(Cheetah2D(), 1024, (64, 64), 500, 2e-2, over)
 
 
 def halfcheetah_vbn(**over):
@@ -342,6 +311,7 @@ CONFIGS: dict[str, Callable] = {
     "swimmer2d_device": swimmer2d_device,
     "hopper2d_device": hopper2d_device,
     "walker2d_device": walker2d_device,
+    "humanoid2d_device": humanoid2d_device,
     "cheetah2d_device": cheetah2d_device,
     "halfcheetah_vbn": halfcheetah_vbn,
     "humanoid_mirrored": humanoid_mirrored,

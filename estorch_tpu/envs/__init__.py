@@ -3,7 +3,8 @@ from .base import EnvSpec, JaxEnv
 from .cartpole import CartPole
 from .mountain_car import MountainCarContinuous
 from .mountain_car_discrete import MountainCar
-from .locomotion import Cheetah2D, Hopper2D, Swimmer2D, Walker2D
+from .locomotion import (Cheetah2D, Hopper2D, Humanoid2D, Swimmer2D,
+                         Walker2D)
 from .pendulum import Pendulum
 from .rollout import RolloutResult, make_population_rollout, make_rollout, select_action
 from .synthetic import SyntheticEnv
@@ -15,6 +16,7 @@ __all__ = [
     "CartPole",
     "Cheetah2D",
     "Hopper2D",
+    "Humanoid2D",
     "Swimmer2D",
     "Walker2D",
     "MountainCar",

@@ -19,7 +19,8 @@ compiles into one ``lax.scan`` and a population of episodes into one
 ``vmap`` over it, exactly like the classic-control envs (envs/base.py).
 
 Honesty of difficulty: the tasks reward forward velocity with control
-costs, terminate on falling (hopper, walker), and are deceptive enough that random
+costs, terminate on falling (hopper, walker, humanoid), and are deceptive
+enough that random
 policies score ~0; they are NOT step-for-step MuJoCo ports (different
 integrator, soft joints) and make no parity claim — reward scales are
 task-local.  MuJoCo-the-library stays supported on the host/pooled paths
@@ -453,6 +454,67 @@ class Walker2D(_PlanarBase):
     upright_offset = jnp.pi / 2
     alive_bonus = 1.0
     min_height = 0.7
+    max_lean = 1.0
+
+
+@dataclasses.dataclass(frozen=True)
+class Humanoid2D(_PlanarBase):
+    """Planar humanoid (Humanoid-class stand-in): 11 bodies, 10 joints.
+
+    Pelvis root with two walker legs (thigh–shin–foot), an abdomen joint
+    to the torso, a neck to the head, and two arms hanging from the
+    shoulders — the arms are free counterweights the policy can swing for
+    balance, which is what separates humanoid balance from the walker's.
+    The hardest in-tree task and the device-native stand-in for the
+    reference users' Humanoid configs (BASELINE config 3 runs MuJoCo
+    Humanoid on the host/pooled paths; this one compiles the physics into
+    the generation program).  Terminates when the pelvis drops or the
+    body leans past ~57°.  Reward: alive + forward velocity − control
+    cost.
+    """
+
+    obs_dim: int = 25
+    action_dim: int = 10
+    default_horizon: int = 500
+    bc_dim: int = 2
+
+    def __post_init__(self):
+        # bodies: 0 pelvis, 1 torso, 2 head, 3 larm, 4 rarm,
+        #         5 lthigh, 6 lshin, 7 lfoot, 8 rthigh, 9 rshin, 10 rfoot
+        chain = _Chain(
+            mass=(3.0, 3.0, 0.8, 0.8, 0.8, 1.0, 1.0, 0.6, 1.0, 1.0, 0.6),
+            half_len=(0.15, 0.2, 0.08, 0.18, 0.18,
+                      0.2, 0.25, 0.13, 0.2, 0.25, 0.13),
+            init_pos=((0.0, 1.0),) + ((0.0, 0.0),) * 10,
+            init_angle=(
+                jnp.pi / 2, jnp.pi / 2, jnp.pi / 2,            # column
+                jnp.pi / 2 + 0.1, jnp.pi / 2 - 0.1,            # arms
+                jnp.pi / 2 + 0.08, jnp.pi / 2 - 0.16, 0.0,     # left leg
+                jnp.pi / 2 - 0.08, jnp.pi / 2 - 0.02, 0.0,     # right leg
+            ),
+            #        abdomen neck  lshld rshld lhip  lknee lankl rhip rknee rankl
+            parent=(0, 1, 1, 1, 0, 5, 6, 0, 8, 9),
+            child=(1, 2, 3, 4, 5, 6, 7, 8, 9, 10),
+            parent_end=(1.0, 1.0, 1.0, 1.0, -1.0,
+                        -1.0, -1.0, -1.0, -1.0, -1.0),
+            child_end=(-1.0, -1.0, 1.0, 1.0, 1.0, 1.0, -1.0, 1.0, 1.0, -1.0),
+            rest_angle=(0.0, 0.0, 0.0, 0.0, 0.0, 0.0, -jnp.pi / 2,
+                        0.0, 0.0, -jnp.pi / 2),
+            limit_lo=(-0.5, -0.5, -1.5, -1.5, -1.0, -1.5, -0.6,
+                      -1.0, -1.5, -0.6),
+            limit_hi=(0.5, 0.5, 1.5, 1.5, 1.0, 0.1, 0.6, 1.0, 0.1, 0.6),
+            gear=(400.0, 100.0, 200.0, 200.0, 800.0, 800.0, 500.0,
+                  800.0, 800.0, 500.0),
+            gravity=-9.81,
+            ground=True,
+            dt=0.002,
+            frame_skip=8,
+        )
+        self._finalize_chain(chain)
+
+    upright_offset = jnp.pi / 2
+    alive_bonus = 1.0
+    min_height = 0.75
     max_lean = 1.0
 
 

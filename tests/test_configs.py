@@ -13,6 +13,7 @@ class TestConfigs:
             "swimmer2d_device",
             "hopper2d_device",
             "walker2d_device",
+            "humanoid2d_device",
             "cheetah2d_device",
             "halfcheetah_vbn",
             "humanoid_mirrored",
@@ -32,6 +33,7 @@ class TestConfigs:
         from estorch_tpu.configs import (
             cheetah2d_device,
             hopper2d_device,
+            humanoid2d_device,
             swimmer2d_device,
             walker2d_device,
         )
@@ -39,7 +41,7 @@ class TestConfigs:
         # hopper/walker included deliberately: they are the locomotion envs
         # with a termination path (falling) through the rollout done-mask
         for recipe in (swimmer2d_device, hopper2d_device, walker2d_device,
-                       cheetah2d_device):
+                       humanoid2d_device, cheetah2d_device):
             es = recipe(population_size=16, table_size=1 << 16)
             es.train(1, verbose=False)
             assert es.backend == "device"

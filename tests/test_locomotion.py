@@ -11,11 +11,11 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from estorch_tpu.envs import (Cheetah2D, Hopper2D, Swimmer2D, Walker2D,
-                              make_rollout)
+from estorch_tpu.envs import (Cheetah2D, Hopper2D, Humanoid2D, Swimmer2D,
+                              Walker2D, make_rollout)
 from estorch_tpu.envs.locomotion import _anchor_world
 
-ENVS = [Swimmer2D, Hopper2D, Walker2D, Cheetah2D]
+ENVS = [Swimmer2D, Hopper2D, Walker2D, Humanoid2D, Cheetah2D]
 
 
 @pytest.mark.parametrize("Env", ENVS)
@@ -146,6 +146,21 @@ class TestSemantics:
             state, obs, r, done = step(state, jnp.zeros(env.action_dim))
             assert np.all(np.isfinite(np.asarray(obs)))
         assert not bool(done)
+
+    def test_humanoid_stands_briefly_and_terminates_on_fall(self):
+        """Same fair-chance contract as the walker, plus the drop check —
+        the tallest chain must still start planted and upright."""
+        env = Humanoid2D()
+        state, _ = env.reset(jax.random.key(0))
+        step = jax.jit(env.step)
+        s = state
+        for _ in range(5):
+            s, obs, r, done = step(s, jnp.zeros(env.action_dim))
+            assert np.all(np.isfinite(np.asarray(obs)))
+        assert not bool(done)
+        dropped = dict(state, pos=state["pos"].at[0, 1].set(0.4))
+        _, _, _, done = env.step(dropped, jnp.zeros(env.action_dim))
+        assert bool(done)
 
     def test_cheetah_settles_without_penetration(self):
         """Zero action: an unactuated torque-controlled cheetah slumps (as
