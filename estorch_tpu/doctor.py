@@ -1,0 +1,127 @@
+"""Environment doctor: diagnose the accelerator and runtime before training.
+
+The device backend behind JAX can WEDGE (observed repeatedly with the
+tunneled single-chip setup this framework is developed against): every
+device-touching call — sometimes including bare ``jax.devices()`` — hangs
+indefinitely, with no exception to catch.  A user whose training script
+"does nothing" has no way to tell a slow first compile from a dead
+accelerator.  This module probes the backend from a SUBPROCESS with a hard
+timeout (the only reliable wedge detector: an in-process call cannot be
+timed out once it enters the runtime), then reports everything else that
+commonly decides whether a config can run: the C++ env pool, optional
+sim/rollout dependencies, and the virtual-CPU-mesh fallback.
+
+Reference has no counterpart (estorch is pure CPU python); this is the
+aux-subsystem "failure detection" obligation (SURVEY.md §5) applied to the
+accelerator itself.
+
+Use:  python -m estorch_tpu.doctor [--timeout S]
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import subprocess
+import sys
+
+_PROBE = """
+import jax
+ds = jax.devices()
+print("PROBE_OK", ds[0].platform, len(ds))
+"""
+
+
+def probe_device(timeout_s: float = 45.0) -> dict:
+    """Probe the default JAX backend in a child process with a hard timeout.
+
+    Returns {"status": "healthy"|"wedged"|"error", ...detail}.  "wedged"
+    means the child neither finished nor failed within ``timeout_s`` —
+    the signature of a hung device runtime (vs a clean init error, which
+    returns fast with stderr).
+    """
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", _PROBE],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return {"status": "wedged", "timeout_s": timeout_s}
+    for line in r.stdout.splitlines():
+        if line.startswith("PROBE_OK"):
+            _, platform, n = line.split()
+            return {"status": "healthy", "platform": platform,
+                    "n_devices": int(n)}
+    return {"status": "error", "returncode": r.returncode,
+            "stderr_tail": r.stderr[-500:]}
+
+
+def check_native_pool() -> dict:
+    """Is the C++ env pool built/loadable, or will pools fall back to NumPy?"""
+    try:
+        from .envs import native_pool
+
+        lib = native_pool._load_library()
+        return {"cpp_pool": lib is not None}
+    except Exception as e:  # diagnostic tool: never crash the report
+        return {"cpp_pool": False, "error": repr(e)}
+
+
+def check_optional_deps() -> dict:
+    """Presence of the optional simulators/ROM stacks configs gate on."""
+    out = {}
+    for mod, why in (
+        ("mujoco", "host/pooled MuJoCo configs"),
+        ("mujoco.mjx", "device-native MuJoCo physics (in-tree fallback: envs/locomotion.py)"),
+        ("ale_py", "real Atari (atari_frostbite); pong84 needs nothing"),
+        ("gymnasium", "host/pooled gym envs"),
+    ):
+        try:
+            found = importlib.util.find_spec(mod) is not None
+        except ModuleNotFoundError:
+            # find_spec("pkg.sub") IMPORTS pkg first and raises when even
+            # the parent is missing — never crash the report (this is the
+            # exact machine the doctor exists to diagnose)
+            found = False
+        out[mod] = {"available": found, "needed_for": why}
+    return out
+
+
+def report(timeout_s: float = 45.0) -> dict:
+    dev = probe_device(timeout_s)
+    rep = {
+        "device": dev,
+        "native": check_native_pool(),
+        "optional": check_optional_deps(),
+    }
+    if dev["status"] == "wedged":
+        rep["hint"] = (
+            "device runtime is hung (not merely compiling): run on the "
+            "virtual CPU mesh instead — jax.config.update('jax_platforms', "
+            "'cpu') + jax.config.update('jax_num_cpu_devices', 8) BEFORE "
+            "first device use (env vars may be ignored if a site hook pins "
+            "the platform) — or retry later; wedges have been observed to "
+            "outlive whole sessions"
+        )
+    elif dev["status"] == "error":
+        rep["hint"] = (
+            "backend failed fast (see stderr_tail) — a clean init error, "
+            "not a wedge; the CPU fallback above also applies"
+        )
+    return rep
+
+
+def main(argv=None):
+    import argparse
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--timeout", type=float, default=45.0,
+                   help="device probe timeout in seconds")
+    args = p.parse_args(argv)
+    rep = report(args.timeout)
+    print(json.dumps(rep, indent=2))
+    return 0 if rep["device"]["status"] == "healthy" else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,80 @@
+"""Environment doctor (estorch_tpu/doctor.py).
+
+The device probe itself runs a REAL subprocess against whatever backend
+this machine has — in CI that may be healthy CPU or a wedged tunnel — so
+the tests pin the classifier's behavior on controlled child processes and
+the report's shape, not the machine's health.
+"""
+
+import json
+import sys
+
+from estorch_tpu import doctor
+
+
+class TestProbeClassifier:
+    def test_healthy_parse(self, monkeypatch):
+        """A child that prints PROBE_OK is classified healthy with fields."""
+        monkeypatch.setattr(doctor, "_PROBE", "print('PROBE_OK cpu 8')")
+        out = doctor.probe_device(timeout_s=60)
+        assert out == {"status": "healthy", "platform": "cpu",
+                       "n_devices": 8}
+
+    def test_wedge_detected_by_timeout(self, monkeypatch):
+        """A child that hangs past the timeout is classified wedged."""
+        monkeypatch.setattr(doctor, "_PROBE",
+                            "import time; time.sleep(60)")
+        out = doctor.probe_device(timeout_s=2)
+        assert out["status"] == "wedged"
+        assert out["timeout_s"] == 2
+
+    def test_fast_failure_is_error_not_wedge(self, monkeypatch):
+        """A child that raises quickly is an init error with stderr tail."""
+        monkeypatch.setattr(doctor, "_PROBE",
+                            "raise RuntimeError('backend exploded')")
+        out = doctor.probe_device(timeout_s=60)
+        assert out["status"] == "error"
+        assert "backend exploded" in out["stderr_tail"]
+
+
+class TestOptionalDeps:
+    def test_missing_parent_package_never_crashes(self, monkeypatch):
+        """find_spec('pkg.sub') raises ModuleNotFoundError when pkg itself
+        is absent; the report must say unavailable, not traceback."""
+        import importlib.util as ilu
+
+        real = ilu.find_spec
+
+        def raising(name, *a, **k):
+            if name.startswith("mujoco"):
+                raise ModuleNotFoundError("No module named 'mujoco'")
+            return real(name, *a, **k)
+
+        monkeypatch.setattr(ilu, "find_spec", raising)
+        out = doctor.check_optional_deps()
+        assert out["mujoco.mjx"]["available"] is False
+        assert out["mujoco"]["available"] is False
+        assert out["gymnasium"]["available"] is True
+
+
+class TestReport:
+    def test_report_shape_and_hints(self, monkeypatch):
+        monkeypatch.setattr(doctor, "probe_device",
+                            lambda timeout_s: {"status": "wedged",
+                                               "timeout_s": timeout_s})
+        rep = doctor.report()
+        assert rep["device"]["status"] == "wedged"
+        assert "cpu" in rep["hint"]
+        assert isinstance(rep["native"]["cpp_pool"], bool)
+        assert rep["optional"]["gymnasium"]["available"] is True
+
+    def test_cli_json_and_exit_code(self, monkeypatch, capsys):
+        monkeypatch.setattr(doctor, "probe_device",
+                            lambda timeout_s: {"status": "healthy",
+                                               "platform": "cpu",
+                                               "n_devices": 8})
+        rc = doctor.main(["--timeout", "5"])
+        rep = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert rep["device"]["platform"] == "cpu"
+        assert "hint" not in rep
