@@ -40,20 +40,38 @@ def probe_device(timeout_s: float = 45.0) -> dict:
     the signature of a hung device runtime (vs a clean init error, which
     returns fast with stderr).
     """
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c", _PROBE],
-            capture_output=True, text=True, timeout=timeout_s,
-        )
-    except subprocess.TimeoutExpired:
-        return {"status": "wedged", "timeout_s": timeout_s}
-    for line in r.stdout.splitlines():
+    import tempfile
+
+    # capture into FILES, not pipes: whatever the child wrote before
+    # hanging must survive the kill (PIPE partials are lost on timeout),
+    # and a file needs no reader thread that could itself block
+    with tempfile.TemporaryFile("w+") as fo, \
+            tempfile.TemporaryFile("w+") as fe:
+        proc = subprocess.Popen([sys.executable, "-c", _PROBE],
+                                stdout=fo, stderr=fe, text=True)
+        try:
+            proc.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            try:
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                # child stuck in uninterruptible sleep (D state — a wedged
+                # device driver can do this): SIGKILL cannot reap it, and
+                # the doctor must not hang on the very wedge it detects
+                pass
+            fe.seek(0)
+            return {"status": "wedged", "timeout_s": timeout_s,
+                    "stderr_tail": fe.read()[-500:]}
+        fo.seek(0), fe.seek(0)
+        out, err = fo.read(), fe.read()
+    for line in out.splitlines():
         if line.startswith("PROBE_OK"):
             _, platform, n = line.split()
             return {"status": "healthy", "platform": platform,
                     "n_devices": int(n)}
-    return {"status": "error", "returncode": r.returncode,
-            "stderr_tail": r.stderr[-500:]}
+    return {"status": "error", "returncode": proc.returncode,
+            "stderr_tail": err[-500:]}
 
 
 def check_native_pool() -> dict:
@@ -78,10 +96,11 @@ def check_optional_deps() -> dict:
     ):
         try:
             found = importlib.util.find_spec(mod) is not None
-        except ModuleNotFoundError:
-            # find_spec("pkg.sub") IMPORTS pkg first and raises when even
-            # the parent is missing — never crash the report (this is the
-            # exact machine the doctor exists to diagnose)
+        except Exception:
+            # find_spec("pkg.sub") IMPORTS pkg first: a missing parent
+            # raises ModuleNotFoundError, a broken native install can
+            # raise ImportError/OSError — never crash the report (this is
+            # the exact machine the doctor exists to diagnose)
             found = False
         out[mod] = {"available": found, "needed_for": why}
     return out

@@ -20,13 +20,22 @@ class TestProbeClassifier:
         assert out == {"status": "healthy", "platform": "cpu",
                        "n_devices": 8}
 
-    def test_wedge_detected_by_timeout(self, monkeypatch):
-        """A child that hangs past the timeout is classified wedged."""
-        monkeypatch.setattr(doctor, "_PROBE",
-                            "import time; time.sleep(60)")
-        out = doctor.probe_device(timeout_s=2)
+    def test_wedge_detected_by_timeout_with_stderr_clue(self, monkeypatch):
+        """A child that hangs past the timeout is classified wedged, and
+        whatever it wrote to stderr before hanging survives in the report
+        (the only clue about WHERE the runtime hung)."""
+        monkeypatch.setattr(doctor, "_PROBE", (
+            "import sys, time\n"
+            "sys.stderr.write('initializing device plugin...')\n"
+            "sys.stderr.flush()\n"
+            "time.sleep(60)\n"
+        ))
+        # interpreter startup alone can take ~5s here (site hooks import
+        # the device plugin); give the child time to reach its writes
+        out = doctor.probe_device(timeout_s=12)
         assert out["status"] == "wedged"
-        assert out["timeout_s"] == 2
+        assert out["timeout_s"] == 12
+        assert "initializing device plugin" in out["stderr_tail"]
 
     def test_fast_failure_is_error_not_wedge(self, monkeypatch):
         """A child that raises quickly is an init error with stderr tail."""

@@ -144,6 +144,24 @@ class TestUpdate:
         assert any(r["reused_prev"] for r in es2.history)
         assert all(r["ess"] >= 0.0 for r in es2.history)
 
+    def test_decomposed_forward_is_equivalent(self):
+        """IW_ES advertises the decomposed forward (ctor accepts it, only
+        streamed/noise_kernel are rejected); since the decomposition is an
+        exact identity at f32, the whole reuse trajectory must match the
+        standard forward bit-for-bit — offsets, fitness, ESS decisions,
+        and the combined update."""
+        es_std = _make()
+        es_dec = _make(decomposed=True)
+        es_std.train(5, verbose=False)
+        es_dec.train(5, verbose=False)
+        assert ([r["reused_prev"] for r in es_std.history]
+                == [r["reused_prev"] for r in es_dec.history])
+        np.testing.assert_allclose(
+            np.asarray(es_std.state.params_flat),
+            np.asarray(es_dec.state.params_flat),
+            rtol=0, atol=1e-6,
+        )
+
     def test_never_reusing_warns_once_with_heuristic(self):
         """20+ consecutive ESS rejections → one RuntimeWarning naming the
         lr ≲ σ/√dim fix; reuse-friendly runs stay silent."""
