@@ -241,20 +241,27 @@ def stage_ab(force_cpu=False):
     seen = {}
     for label, base, over in AB_MATRIX:
         cfg = {**base, **over}
+        label_spec = None
         if force_cpu:
             # CPU can't run emulated bf16 at bench sizes in sane time, and
             # relative mode comparisons only make sense at one dtype there —
-            # rows that coerce to an already-measured cfg alias its result
+            # rows that coerce to an already-measured cfg alias its result.
+            # The label must say what was MEASURED (f32), not what the
+            # matrix row specs for on-chip runs; label_spec keeps the
+            # original for joining against future TPU rows
             cfg = {**cfg, "dtype": "float32", "gens": 2}
+            if "bf16" in label:
+                label_spec, label = label, label.replace("bf16", "f32")
         key = json.dumps(cfg, sort_keys=True)
         if key in seen:
-            print(json.dumps({"label": label, "alias_of": seen[key],
-                              "cfg": cfg}), flush=True)
-            continue
-        seen[key] = label
-        res = run_stage(cfg, timeout_s=1200 if force_cpu else 600,
-                        force_cpu=force_cpu)
-        line = {"label": label, **(res or {"rate": None, "cfg": cfg})}
+            line = {"label": label, "alias_of": seen[key], "cfg": cfg}
+        else:
+            seen[key] = label
+            res = run_stage(cfg, timeout_s=1200 if force_cpu else 600,
+                            force_cpu=force_cpu)
+            line = {"label": label, **(res or {"rate": None, "cfg": cfg})}
+        if label_spec:
+            line["label_spec"] = label_spec
         print(json.dumps(line), flush=True)
 
 

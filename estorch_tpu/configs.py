@@ -190,6 +190,21 @@ def halfcheetah_vbn(**over):
     return es
 
 
+def humanoid2d_pop10k(**over):
+    """Config-3 scale on the DEVICE path: Humanoid2D at population 10240
+    with rank-1 perturbations and a Humanoid-sized policy (256×256).
+
+    The engine-mode choice is evidence-driven (bench_ab_cpu.jsonl): at
+    pop-10240 × 166k-params, `low_rank=1` measured 9.5× the full-rank
+    throughput with 3× less memory — the member noise state drops from
+    O(dim) to O(Σ(m+n)r).  eval_chunk bounds materialized member weights
+    the same way the bench's pop-10k point does."""
+    from .envs import Humanoid2D
+
+    return _planar_device(Humanoid2D(), 10240, (256, 256), 400, 2e-2,
+                          {"low_rank": 1, "eval_chunk": 1024, **over})
+
+
 def humanoid_mirrored(**over):
     """BASELINE config 3 — Humanoid mirrored-sampling ES, population 10k."""
     import torch
@@ -312,6 +327,7 @@ CONFIGS: dict[str, Callable] = {
     "hopper2d_device": hopper2d_device,
     "walker2d_device": walker2d_device,
     "humanoid2d_device": humanoid2d_device,
+    "humanoid2d_pop10k": humanoid2d_pop10k,
     "cheetah2d_device": cheetah2d_device,
     "halfcheetah_vbn": halfcheetah_vbn,
     "humanoid_mirrored": humanoid_mirrored,

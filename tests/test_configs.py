@@ -14,6 +14,7 @@ class TestConfigs:
             "hopper2d_device",
             "walker2d_device",
             "humanoid2d_device",
+            "humanoid2d_pop10k",
             "cheetah2d_device",
             "halfcheetah_vbn",
             "humanoid_mirrored",
