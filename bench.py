@@ -58,17 +58,20 @@ LOCO = {"env": "cheetah2d", "hidden": [64, 64], "population": 1024,
 
 
 def _env_and_policy(cfg):
-    from estorch_tpu.envs import Cheetah2D, Pendulum, SyntheticEnv
+    from estorch_tpu.envs import (Cheetah2D, Humanoid2D, Pendulum,
+                                  SyntheticEnv)
 
     if cfg["env"] == "pendulum":
         env = Pendulum()
         pk = {"action_dim": 1, "hidden": tuple(cfg["hidden"]),
               "discrete": False, "action_scale": 2.0}
-    elif cfg["env"] == "cheetah2d":
-        # device-native physics INSIDE the generation program; cheetah never
-        # terminates, so every scanned step is a real env step (same honesty
-        # property the Pendulum headline relies on)
-        env = Cheetah2D()
+    elif cfg["env"] in ("cheetah2d", "humanoid2d"):
+        # device-native physics INSIDE the generation program; the cheetah
+        # never terminates, so every scanned step is a real env step (same
+        # honesty property the Pendulum headline relies on).  The humanoid
+        # terminates on falls — its steps/s reflects the done-mask like a
+        # real training run
+        env = Cheetah2D() if cfg["env"] == "cheetah2d" else Humanoid2D()
         pk = {"action_dim": env.action_dim, "hidden": tuple(cfg["hidden"]),
               "discrete": False, "action_scale": 1.0}
     else:
@@ -234,6 +237,13 @@ AB_MATRIX = [
      {"dtype": "bfloat16", "low_rank": 1, "gens": 3}),
     ("loco/standard/bf16", LOCO, {"dtype": "bfloat16", "gens": 3}),
     ("loco/standard/f32", LOCO, {"dtype": "float32", "gens": 3}),
+    # config-3 scale with physics: the humanoid2d_pop10k recipe's shape at
+    # horizon 100 (not the recipe's 400 — a bench row, not a training run;
+    # scan length and alive-step fraction differ accordingly)
+    ("loco10k/lowrank1/bf16",
+     {"env": "humanoid2d", "hidden": [256, 256], "population": 10240,
+      "horizon": 100, "eval_chunk": 1024},
+     {"dtype": "bfloat16", "low_rank": 1, "gens": 3}),
 ]
 
 
