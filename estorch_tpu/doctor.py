@@ -113,19 +113,22 @@ def report(timeout_s: float = 45.0) -> dict:
         "native": check_native_pool(),
         "optional": check_optional_deps(),
     }
+    cpu_recipe = (
+        "run on the virtual CPU mesh instead — jax.config.update("
+        "'jax_platforms', 'cpu') + jax.config.update('jax_num_cpu_devices', "
+        "8) BEFORE first device use (env vars may be ignored if a site hook "
+        "pins the platform)"
+    )
     if dev["status"] == "wedged":
         rep["hint"] = (
-            "device runtime is hung (not merely compiling): run on the "
-            "virtual CPU mesh instead — jax.config.update('jax_platforms', "
-            "'cpu') + jax.config.update('jax_num_cpu_devices', 8) BEFORE "
-            "first device use (env vars may be ignored if a site hook pins "
-            "the platform) — or retry later; wedges have been observed to "
-            "outlive whole sessions"
+            "device runtime is hung (not merely compiling): " + cpu_recipe +
+            " — or retry later; wedges have been observed to outlive whole "
+            "sessions"
         )
     elif dev["status"] == "error":
         rep["hint"] = (
             "backend failed fast (see stderr_tail) — a clean init error, "
-            "not a wedge; the CPU fallback above also applies"
+            "not a wedge; " + cpu_recipe
         )
     return rep
 

@@ -1,10 +1,11 @@
-from .backend import force_cpu_backend
+from .backend import enable_compilation_cache, force_cpu_backend
 from .checkpoint import PeriodicCheckpointer, restore_checkpoint, save_checkpoint
 from .fault import mask_and_renormalize, rank_weights_with_failures, valid_mask
 from .metrics import JsonlWriter, MultiWriter, TensorBoardWriter
 from .profiler import annotate, timed_generations, trace
 
 __all__ = [
+    "enable_compilation_cache",
     "force_cpu_backend",
     "PeriodicCheckpointer",
     "restore_checkpoint",

@@ -1,0 +1,69 @@
+"""Worker entrypoint for the REAL multi-process validation test.
+
+Launched by tests/test_multiprocess.py as ``python _mp_worker.py <pid>
+<nprocs> <port> <outdir>``.  Each worker is one JAX process with 4 local
+CPU devices; ``jax.distributed`` connects them over Gloo/TCP — the same
+runtime layering a TPU pod uses over DCN (SURVEY.md §2 'Distributed
+communication backend'), so collectives here genuinely cross process
+boundaries instead of staying inside one XLA client.
+
+Must force the CPU platform BEFORE any device use: this image's
+sitecustomize pins the axon TPU plugin, which can wedge indefinitely.
+"""
+
+import pathlib
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 4)
+
+
+def main() -> None:
+    pid, nprocs, port = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+    outdir = pathlib.Path(sys.argv[4])
+
+    import estorch_tpu.parallel.multihost as mh
+
+    assert mh.initialize(f"localhost:{port}", num_processes=nprocs,
+                         process_id=pid), "distributed init did not happen"
+    info = mh.process_info()
+    assert info["process_count"] == nprocs
+    assert info["global_devices"] == nprocs * 4
+
+    import numpy as np
+    import optax
+
+    from estorch_tpu import ES, JaxAgent, MLPPolicy
+    from estorch_tpu.envs import CartPole
+
+    es = ES(
+        policy=MLPPolicy,
+        agent=JaxAgent,
+        optimizer=optax.adam,
+        population_size=16,
+        sigma=0.1,
+        policy_kwargs={"action_dim": 2, "hidden": (8,), "discrete": True},
+        agent_kwargs={"env": CartPole(), "horizon": 64},
+        optimizer_kwargs={"learning_rate": 1e-2},
+        seed=7,
+        mesh=mh.global_population_mesh(),
+    )
+    es.train(2, verbose=False)
+
+    # leader_only must elect exactly one writer
+    wrote = mh.leader_only(lambda: True)()
+
+    np.savez(
+        outdir / f"proc{pid}.npz",
+        params=np.asarray(es.state.params_flat, np.float64),
+        fitness=np.asarray(es.history[-1]["reward_mean"], np.float64),
+        best=np.float64(es.best_reward),
+        is_leader_writer=np.bool_(bool(wrote)),
+    )
+    print(f"proc {pid}: OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -339,3 +339,60 @@ class TestProfiler:
                 es.train(1, verbose=False)
         written = list((tmp_path / "prof").rglob("*"))
         assert any(p.is_file() for p in written), "no trace files emitted"
+
+
+class TestCompilationCache:
+    def test_enable_compilation_cache_persists_executables(self, tmp_path):
+        """enable_compilation_cache points XLA's persistent cache at the
+        directory and compiled programs actually land there (the 20-40s
+        fresh-process compile is what the cache exists to kill)."""
+        import jax
+        import jax.numpy as jnp
+
+        from estorch_tpu.utils import enable_compilation_cache
+
+        cache_dir = str(tmp_path / "xla")
+        got = enable_compilation_cache(cache_dir, min_compile_time_s=0.0)
+        assert got == cache_dir
+        try:
+            @jax.jit
+            def f(x):
+                return (x @ x.T).sum()
+
+            f(jnp.ones((64, 64))).block_until_ready()
+            import os
+
+            entries = os.listdir(cache_dir)
+            assert entries, "no cache entries written"
+        finally:
+            # restore defaults so later tests don't write into tmp_path —
+            # the config alone is not enough: JAX pins the cache object on
+            # first use, so it must be reset too
+            jax.config.update("jax_compilation_cache_dir", None)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 1.0
+            )
+            from estorch_tpu.utils.backend import _reset_live_cache
+
+            _reset_live_cache()
+
+    def test_default_dir_created(self, monkeypatch, tmp_path):
+        import jax
+
+        from estorch_tpu.utils import enable_compilation_cache
+
+        monkeypatch.setenv("HOME", str(tmp_path))
+        try:
+            d = enable_compilation_cache()
+            assert d.startswith(str(tmp_path))
+            import os
+
+            assert os.path.isdir(d)
+        finally:
+            jax.config.update("jax_compilation_cache_dir", None)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 1.0
+            )
+            from estorch_tpu.utils.backend import _reset_live_cache
+
+            _reset_live_cache()
