@@ -264,6 +264,7 @@ class ES:
             streamed_apply=str_apply,
             lowrank_apply=lr_apply,
             lowrank_spec=lr_spec,
+            carry_init=self.module.carry_init if self._recurrent else None,
         )
         self.state = self.engine.init_state(flat, state_key)
         self._post_engine_init()
@@ -277,24 +278,41 @@ class ES:
         init from a real observation, frozen-collection split, VBN reference
         capture, param spec, noise table, optax, mesh, EngineConfig."""
         self.module = _instantiate(policy, policy_kwargs, "policy")
+        self._recurrent = bool(getattr(self.module, "is_recurrent", False))
         init_key, state_key, vbn_key = jax.random.split(
             jax.random.PRNGKey(self.seed), 3
         )
         self._obs0 = obs0
-        variables = self.module.init(init_key, obs0)
+        if self._recurrent:
+            variables = self.module.init(init_key, obs0, self.module.carry_init())
+        else:
+            variables = self.module.init(init_key, obs0)
         params = variables["params"]
         self._frozen = {k: v for k, v in variables.items() if k != "params"}
 
         # VirtualBatchNorm: freeze reference-batch statistics once
         if "vbn_stats" in variables:
+            if self._recurrent:
+                raise ValueError(
+                    "VirtualBatchNorm + recurrent policies is unsupported: "
+                    "the reference-batch capture applies the module "
+                    "statelessly (models/vbn.py)"
+                )
             self._frozen["vbn_stats"] = capture_reference_stats(
                 self.module, variables, vbn_ref_fn(vbn_key)
             )
 
         frozen = self._frozen
 
-        def policy_apply(p, obs):
-            return self.module.apply({"params": p, **frozen}, obs)
+        if self._recurrent:
+
+            def policy_apply(p, obs, h):
+                return self.module.apply({"params": p, **frozen}, obs, h)
+
+        else:
+
+            def policy_apply(p, obs):
+                return self.module.apply({"params": p, **frozen}, obs)
 
         self._policy_apply = policy_apply
         flat, self._spec = make_param_spec(params)
@@ -358,6 +376,12 @@ class ES:
             self.agent.horizon, vbn_ref, table_size, eval_chunk, grad_chunk,
             weight_decay, mesh, device,
         )
+        if self._recurrent:
+            raise ValueError(
+                "recurrent policies are device-path only (JaxAgent): the "
+                "pooled batched forward does not thread a hidden carry "
+                "across host env steps yet"
+            )
         self.engine = PooledEngine(
             self.agent.env_name, self._policy_apply, self._spec, self.table,
             self.optimizer, self.config, self.mesh,
@@ -653,7 +677,10 @@ class ES:
             if fn is None:
                 from ..envs.rollout import make_rollout
 
-                single = make_rollout(self.env, self._policy_apply, self.config.horizon)
+                single = make_rollout(
+                    self.env, self._policy_apply, self.config.horizon,
+                    carry_init=self.module.carry_init if self._recurrent else None,
+                )
                 # one cached callable: jit re-specializes per n_episodes shape
                 fn = self._eval_policy_fn = jax.jit(jax.vmap(single, in_axes=(None, 0)))
             keys = jax.random.split(jax.random.PRNGKey(seed), n_episodes)
@@ -683,8 +710,12 @@ class ES:
             "episodes": int(n_episodes),
         }
 
-    def predict(self, obs, use_best: bool = False):
-        """Policy forward pass with current (or best) parameters."""
+    def predict(self, obs, use_best: bool = False, carry=None):
+        """Policy forward pass with current (or best) parameters.
+
+        Recurrent policies return ``(out, new_carry)``; pass the returned
+        carry back in on the next step (``carry=None`` starts an episode).
+        """
         if self.backend == "host":
             import torch
 
@@ -692,4 +723,8 @@ class ES:
             with torch.no_grad():
                 return policy(torch.as_tensor(np.asarray(obs), dtype=torch.float32))
         p = self.best_policy if use_best else self.policy
+        if getattr(self, "_recurrent", False):
+            if carry is None:
+                carry = self.module.carry_init()
+            return self._policy_apply(p, obs, carry)
         return self._policy_apply(p, obs)

@@ -7,7 +7,7 @@ from .locomotion import (Cheetah2D, Hopper2D, Humanoid2D, Swimmer2D,
                          Walker2D)
 from .pendulum import Pendulum
 from .rollout import RolloutResult, make_population_rollout, make_rollout, select_action
-from .synthetic import SyntheticEnv
+from .synthetic import RecallEnv, SyntheticEnv
 
 __all__ = [
     "Acrobot",
@@ -22,6 +22,7 @@ __all__ = [
     "MountainCar",
     "MountainCarContinuous",
     "Pendulum",
+    "RecallEnv",
     "SyntheticEnv",
     "RolloutResult",
     "make_population_rollout",

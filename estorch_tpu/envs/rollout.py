@@ -36,23 +36,37 @@ def select_action(policy_out: jax.Array, discrete: bool) -> jax.Array:
 
 def make_rollout(
     env: Any,
-    policy_apply: Callable[[Any, jax.Array], jax.Array],
+    policy_apply: Callable[..., jax.Array],
     horizon: int,
+    carry_init: Callable[[], Any] | None = None,
 ) -> Callable[[Any, jax.Array], RolloutResult]:
     """Build ``rollout(params, key) -> RolloutResult`` for one episode.
 
     ``policy_apply(params, obs) -> action logits/values``.  The returned
     function is pure and jit/vmap-safe; vmap it over ``(params, key)`` to
     evaluate a whole population slice in one program.
+
+    Recurrent policies (``carry_init`` given): ``policy_apply(params, obs,
+    h) -> (out, h')`` and the hidden carry is threaded through the episode
+    scan — reset to ``carry_init()`` at episode start, frozen (like env
+    state) after termination.  The reference has no recurrent machinery
+    (its ``agent.rollout`` owns the loop, SURVEY.md §3.3, so torch users
+    thread hidden state themselves); here the loop is a compiled scan, so
+    the framework must thread it.
     """
     discrete = bool(env.discrete)
+    stateful = carry_init is not None
 
     def rollout(params: Any, key: jax.Array) -> RolloutResult:
         state0, obs0 = env.reset(key)
+        h0 = carry_init() if stateful else None
 
         def step_fn(carry, _):
-            state, obs, done, total, steps = carry
-            out = policy_apply(params, obs)
+            state, obs, done, total, steps, h = carry
+            if stateful:
+                out, h_new = policy_apply(params, obs, h)
+            else:
+                out, h_new = policy_apply(params, obs), h
             action = select_action(out, discrete)
             nstate, nobs, reward, ndone = env.step(state, action)
             alive = jnp.logical_not(done)
@@ -63,8 +77,9 @@ def make_rollout(
             keep = lambda new, old: jnp.where(alive, new, old)
             state_next = jax.tree_util.tree_map(keep, nstate, state)
             obs_next = keep(nobs, obs)
+            h_next = jax.tree_util.tree_map(keep, h_new, h)
             done_next = done | ndone
-            return (state_next, obs_next, done_next, total, steps), None
+            return (state_next, obs_next, done_next, total, steps, h_next), None
 
         init = (
             state0,
@@ -72,8 +87,9 @@ def make_rollout(
             jnp.bool_(False),
             jnp.float32(0.0),
             jnp.int32(0),
+            h0,
         )
-        (state, obs, done, total, steps), _ = jax.lax.scan(
+        (state, obs, done, total, steps, _), _ = jax.lax.scan(
             step_fn, init, None, length=horizon
         )
         bc = env.behavior(state, obs).astype(jnp.float32)
@@ -84,15 +100,17 @@ def make_rollout(
 
 def make_population_rollout(
     env: Any,
-    policy_apply: Callable[[Any, jax.Array], jax.Array],
+    policy_apply: Callable[..., jax.Array],
     horizon: int,
+    carry_init: Callable[[], Any] | None = None,
 ) -> Callable[[Any, jax.Array], RolloutResult]:
     """vmap of ``make_rollout`` over stacked params and per-member keys.
 
     ``params`` leaves have a leading population axis; ``keys`` is (n,).
     Returns batched RolloutResult arrays — (n,), (n, bc_dim), (n,).
+    ``carry_init`` as in :func:`make_rollout` (recurrent policies).
     """
-    single = make_rollout(env, policy_apply, horizon)
+    single = make_rollout(env, policy_apply, horizon, carry_init=carry_init)
     return jax.vmap(single, in_axes=(0, 0))
 
 

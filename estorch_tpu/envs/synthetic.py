@@ -57,3 +57,42 @@ class SyntheticEnv:
 
     def behavior(self, state, obs) -> jax.Array:
         return state[: self.bc_dim]
+
+
+@dataclasses.dataclass(frozen=True)
+class RecallEnv:
+    """Memory probe (POMDP): a ±1 signal is observable ONLY before the
+    first step; reward each step is ``clip(action)·signal``.
+
+    A memoryless policy sees the signal exactly once (the first policy
+    call) and zeros afterwards, so over the symmetric ±1 episode
+    distribution its expected return caps at ~1 (the first step); a policy
+    that latches the signal into recurrent state earns ~horizon.  The gap
+    is the cleanest possible test that hidden state actually flows through
+    the compiled rollout scan (envs/rollout.py ``carry_init`` path).
+
+    Never terminates; state = [signal, t].
+    """
+
+    obs_dim: int = 1
+    action_dim: int = 1
+    discrete: bool = False
+    default_horizon: int = 32
+    bc_dim: int = 1
+
+    def reset(self, key: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        sign = jnp.where(jax.random.bernoulli(key), 1.0, -1.0)
+        state = jnp.stack([sign, jnp.float32(0.0)])
+        return state, state[:1]
+
+    def step(self, state, action):
+        sign, t = state[0], state[1]
+        act = jnp.clip(jnp.atleast_1d(action), -1.0, 1.0)[0]
+        reward = act * sign
+        nstate = jnp.stack([sign, t + 1.0])
+        # the signal is gone from every post-reset observation
+        obs = jnp.zeros((1,))
+        return nstate, obs, reward, jnp.bool_(False)
+
+    def behavior(self, state, obs) -> jax.Array:
+        return state[:1]

@@ -1,4 +1,4 @@
-from .policies import MLPPolicy, NatureCNN
+from .policies import MLPPolicy, NatureCNN, RecurrentPolicy
 from .vbn import VirtualBatchNorm, capture_reference_stats
 
 
@@ -14,6 +14,7 @@ def __getattr__(name):
 __all__ = [
     "MLPPolicy",
     "NatureCNN",
+    "RecurrentPolicy",
     "VirtualBatchNorm",
     "TorchVirtualBatchNorm",
     "capture_reference_stats",

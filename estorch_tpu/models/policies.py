@@ -10,6 +10,12 @@ config implies the Nature DQN CNN.  We bundle TPU-idiomatic equivalents:
 - ``NatureCNN`` — the 84×84×4 Atari trunk (conv 32×8s4, 64×4s2, 64×3s1,
   dense 512) with an optional VirtualBatchNorm after each conv, which is the
   OpenAI-ES Atari setup the reference's VBN module exists for.
+- ``RecurrentPolicy`` — MLP trunk + GRU core for partially observable
+  tasks.  The reference has no recurrent machinery (the user-owned
+  ``agent.rollout`` loop threads hidden state by hand, SURVEY.md §3.3);
+  here the episode loop is a compiled ``lax.scan``, so the framework
+  threads the carry (envs/rollout.py) — marked by ``is_recurrent`` and the
+  ``carry_init``/two-return apply contract.
 
 All modules are shape-static and bf16-friendly; matmuls/convs land on the
 MXU when vmapped across the population.
@@ -50,6 +56,41 @@ class MLPPolicy(nn.Module):
         if not self.discrete:
             x = jnp.tanh(x) * self.action_scale
         return x
+
+
+class RecurrentPolicy(nn.Module):
+    """MLP trunk + GRU core + action head, for POMDPs.
+
+    Apply contract (recurrent): ``module.apply(vars, obs, carry) ->
+    (out, new_carry)``; ``carry_init()`` gives the episode-start carry.
+    The GRU is ordinary dense matmuls — vmapped across the population they
+    batch onto the MXU exactly like the feedforward policies.
+    """
+
+    action_dim: int
+    hidden: Sequence[int] = (64,)
+    gru_size: int = 64
+    discrete: bool = True
+    action_scale: float = 1.0
+    activation: Callable = nn.tanh
+
+    # marks the module for ES/rollout wiring (not a dataclass field)
+    is_recurrent = True
+
+    @nn.compact
+    def __call__(
+        self, x: jnp.ndarray, carry: jnp.ndarray
+    ) -> tuple[jnp.ndarray, jnp.ndarray]:
+        for i, h in enumerate(self.hidden):
+            x = self.activation(nn.Dense(h, name=f"dense_{i}")(x))
+        carry, x = nn.GRUCell(features=self.gru_size, name="gru")(carry, x)
+        x = nn.Dense(self.action_dim, name="head")(x)
+        if not self.discrete:
+            x = jnp.tanh(x) * self.action_scale
+        return x, carry
+
+    def carry_init(self) -> jnp.ndarray:
+        return jnp.zeros((self.gru_size,), jnp.float32)
 
 
 class NatureCNN(nn.Module):

@@ -114,38 +114,59 @@ def _cast_leaves(tree, dtype):
     return jax.tree_util.tree_map(lambda x: jnp.asarray(x, dtype), tree)
 
 
+def _check_bf16_params(p) -> None:
+    """Trace-time contract check (zero runtime cost): a caller that forgot
+    the once-per-member cast would otherwise silently run the rollout in
+    f32 (bf16 obs × f32 weights promotes) — losing the perf this path
+    exists for with no error anywhere."""
+    bad = sorted(
+        {
+            str(leaf.dtype)
+            for leaf in jax.tree_util.tree_leaves(p)
+            if jnp.issubdtype(leaf.dtype, jnp.floating)
+            and leaf.dtype != jnp.bfloat16
+        }
+    )
+    if bad:
+        raise TypeError(
+            f"bf16 compute path was handed {bad} params; cast the member "
+            "tree once where it is built (ESEngine._member_cast / pooled "
+            "materialize) before calling policy_apply"
+        )
+
+
+def _bf16_obs(obs):
+    """Floating observations cast to bf16 (integer pixel bytes pass through
+    so the policy's own normalization still fires)."""
+    if jnp.issubdtype(obs.dtype, jnp.floating):
+        return obs.astype(jnp.bfloat16)
+    return obs
+
+
 def _bf16_io_apply(base_apply):
     """Observation/output dtype shim for the bf16 compute path.  Params must
     ALREADY be bf16 — they are cast ONCE per member where they are built
     (``_eval_local`` / center eval), never inside the per-step rollout scan,
     so the steady-state episode loop is cast-free (round-1 VERDICT weak #6:
     the old wrapper re-cast the whole weight pytree every policy call and
-    relied on XLA CSE to hoist it).  Floating observations cast to bf16
-    (integer pixel bytes pass through so the policy's own normalization
-    still fires); output returns to float32."""
+    relied on XLA CSE to hoist it).  Output returns to float32."""
 
     def wrapped(p, obs):
-        # trace-time contract check (zero runtime cost): a caller that
-        # forgot the once-per-member cast would otherwise silently run the
-        # rollout in f32 (bf16 obs × f32 weights promotes) — losing the perf
-        # this path exists for with no error anywhere
-        bad = sorted(
-            {
-                str(leaf.dtype)
-                for leaf in jax.tree_util.tree_leaves(p)
-                if jnp.issubdtype(leaf.dtype, jnp.floating)
-                and leaf.dtype != jnp.bfloat16
-            }
-        )
-        if bad:
-            raise TypeError(
-                f"bf16 compute path was handed {bad} params; cast the member "
-                "tree once where it is built (ESEngine._member_cast / pooled "
-                "materialize) before calling policy_apply"
-            )
-        if jnp.issubdtype(obs.dtype, jnp.floating):
-            obs = obs.astype(jnp.bfloat16)
-        return base_apply(p, obs).astype(jnp.float32)
+        _check_bf16_params(p)
+        return base_apply(p, _bf16_obs(obs)).astype(jnp.float32)
+
+    return wrapped
+
+
+def _bf16_io_apply_stateful(base_apply):
+    """Recurrent twin of :func:`_bf16_io_apply`: the hidden carry stays
+    bf16 across the whole scan (the engine casts ``carry_init`` once), so
+    no per-step carry casts exist — only the obs in / action out shims."""
+
+    def wrapped(p, obs, h):
+        _check_bf16_params(p)
+        out, h_new = base_apply(p, _bf16_obs(obs), h)
+        return out.astype(jnp.float32), h_new
 
     return wrapped
 
@@ -179,8 +200,18 @@ class ESEngine:
         streamed_apply=None,
         lowrank_apply=None,
         lowrank_spec=None,
+        carry_init=None,
     ):
         self.env = env
+        if carry_init is not None and (
+            config.decomposed or config.streamed or config.low_rank
+        ):
+            # these paths restructure the FORWARD around the MLP layer
+            # identity (models/decomposed.py) and have no recurrent form yet
+            raise ValueError(
+                "recurrent policies run the standard forward; they are "
+                "mutually exclusive with decomposed/streamed/low_rank"
+            )
         if config.low_rank:
             if config.decomposed or config.streamed or config.noise_kernel:
                 raise ValueError(
@@ -243,7 +274,16 @@ class ESEngine:
             )
         self._bf16 = config.compute_dtype == "bfloat16"
         if self._bf16:
-            policy_apply = _bf16_io_apply(policy_apply)
+            if carry_init is not None:
+                policy_apply = _bf16_io_apply_stateful(policy_apply)
+                # cast the episode-start carry ONCE so the scan carry dtype
+                # is bf16 throughout (a f32 init would flip dtypes between
+                # scan iterations)
+                base_carry_init = carry_init
+                carry_init = lambda: _cast_leaves(base_carry_init(), jnp.bfloat16)
+            else:
+                policy_apply = _bf16_io_apply(policy_apply)
+        self._carry_init = carry_init
 
         self.policy_apply = policy_apply
         self.spec = spec
@@ -275,7 +315,9 @@ class ESEngine:
             return
         self.bc_dim = int(env.bc_dim)
 
-        self._rollout = make_rollout(env, policy_apply, config.horizon)
+        self._rollout = make_rollout(
+            env, policy_apply, config.horizon, carry_init=carry_init
+        )
 
         self._rollout_batched = None
         if config.streamed:

@@ -1,0 +1,41 @@
+"""Recurrent ES: solve a memory task no feedforward policy can.
+
+RecallEnv shows a ±1 signal ONLY before the first step; every step's
+reward is action·signal.  A memoryless policy earns ~1 per episode in
+expectation (the one step where it can see the signal); a recurrent
+policy that latches the signal into its GRU carry earns ~horizon.
+
+The hidden carry is threaded through the compiled rollout scan by the
+framework (envs/rollout.py) — the reference's user-owned rollout loop
+(SURVEY.md §3.3) has no equivalent machinery, torch users thread hidden
+state by hand.
+
+Run:  python examples/recurrent_memory.py
+"""
+
+import optax
+
+from estorch_tpu import ES, JaxAgent, RecurrentPolicy
+from estorch_tpu.envs import RecallEnv
+
+
+def main():
+    es = ES(
+        policy=RecurrentPolicy,
+        agent=JaxAgent,
+        optimizer=optax.adam,
+        population_size=256,
+        sigma=0.1,
+        policy_kwargs={"action_dim": 1, "hidden": (8,), "gru_size": 8,
+                       "discrete": False},
+        agent_kwargs={"env": RecallEnv(), "horizon": 16},
+        optimizer_kwargs={"learning_rate": 5e-2},
+        seed=0,
+    )
+    es.train(80, verbose=True)
+    print("center policy:", es.evaluate_policy(n_episodes=64))
+    print("ceiling = horizon = 16; memoryless cap ≈ 1")
+
+
+if __name__ == "__main__":
+    main()
