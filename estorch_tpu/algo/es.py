@@ -376,18 +376,13 @@ class ES:
             self.agent.horizon, vbn_ref, table_size, eval_chunk, grad_chunk,
             weight_decay, mesh, device,
         )
-        if self._recurrent:
-            raise ValueError(
-                "recurrent policies are device-path only (JaxAgent): the "
-                "pooled batched forward does not thread a hidden carry "
-                "across host env steps yet"
-            )
         self.engine = PooledEngine(
             self.agent.env_name, self._policy_apply, self._spec, self.table,
             self.optimizer, self.config, self.mesh,
             n_threads=self.agent.n_threads, seed=self.seed,
             double_buffer=getattr(self.agent, "double_buffer", False),
             prep=prep,
+            carry_init=self.module.carry_init if self._recurrent else None,
         )
         self.state = self.engine.init_state(flat, state_key)
 

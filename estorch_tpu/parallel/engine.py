@@ -190,7 +190,9 @@ class ESEngine:
     def __init__(
         self,
         env: Any,
-        policy_apply: Callable[[Any, jax.Array], jax.Array],
+        policy_apply: Callable[..., Any],  # (p, obs) -> out, or the
+        # recurrent (p, obs, carry) -> (out, carry') form when carry_init
+        # is given
         spec: ParamSpec,
         table: NoiseTable,
         optimizer: optax.GradientTransformation,

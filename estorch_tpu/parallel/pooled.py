@@ -53,6 +53,7 @@ class PooledEngine:
         seed: int = 0,
         double_buffer: bool = False,
         prep: dict | None = None,
+        carry_init=None,
     ):
         self.env_name = env_name
         self.prep = dict(prep) if prep else None
@@ -79,10 +80,15 @@ class PooledEngine:
                 "pooled path materializes per-member thetas"
             )
         # update-only device engine: shares offsets/psum/optax with the
-        # fully-on-device path; its ctor also applies the compute_dtype wrap,
+        # fully-on-device path; its ctor also applies the compute_dtype wrap
+        # (incl. the stateful bf16 shim + carry cast for recurrent policies),
         # which we reuse below instead of wrapping a second time
-        self.core = ESEngine(None, policy_apply, spec, table, optimizer, config, mesh)
+        self.core = ESEngine(None, policy_apply, spec, table, optimizer,
+                             config, mesh, carry_init=carry_init)
         policy_apply = self.core.policy_apply
+        carry_init = self.core._carry_init  # bf16 path: pre-cast variant
+        self.recurrent = carry_init is not None
+        self._carry_init = carry_init
         self.double_buffer = bool(double_buffer)
         def _pool(n_envs, threads, pool_seed):
             pool = make_pool(env_name, n_envs, n_threads=threads, seed=pool_seed)
@@ -138,25 +144,52 @@ class PooledEngine:
         def _params(flat):
             return spec.unravel(flat.astype(jnp.bfloat16) if bf16 else flat)
 
-        def batch_actions(thetas, obs):
-            """One env step's policy forward for the whole population."""
-            def one(theta, o):
-                out = policy_apply(spec.unravel(theta), o.reshape(obs_shape))
-                if discrete:
-                    return jnp.argmax(out, axis=-1).astype(jnp.float32)
-                return out.reshape(-1)
-            return jax.vmap(one)(thetas, obs)
-
-        self._batch_actions = jax.jit(batch_actions)  # re-specializes per
-        # batch shape, so the same callable serves full and half populations
-
-        def center_action(params_flat, obs):
-            out = policy_apply(_params(params_flat), obs.reshape(obs_shape))
+        def _act(out):
+            """Shared action rule: argmax logits (discrete) / flat values."""
             if discrete:
                 return jnp.argmax(out, axis=-1).astype(jnp.float32)
             return out.reshape(-1)
 
+        if self.recurrent:
+            # the hidden carry lives host-side across the generation's step
+            # loop: (population, …) stacked carries in, stacked carries out
+            def batch_actions(thetas, obs, carries):
+                def one(theta, o, h):
+                    out, h2 = policy_apply(
+                        spec.unravel(theta), o.reshape(obs_shape), h
+                    )
+                    return _act(out), h2
+                return jax.vmap(one)(thetas, obs, carries)
+
+            def center_action(params_flat, obs, h):
+                out, h2 = policy_apply(
+                    _params(params_flat), obs.reshape(obs_shape), h
+                )
+                return _act(out), h2
+        else:
+            def batch_actions(thetas, obs):
+                """One env step's policy forward for the whole population."""
+                def one(theta, o):
+                    return _act(
+                        policy_apply(spec.unravel(theta), o.reshape(obs_shape))
+                    )
+                return jax.vmap(one)(thetas, obs)
+
+            def center_action(params_flat, obs):
+                return _act(
+                    policy_apply(_params(params_flat), obs.reshape(obs_shape))
+                )
+
+        self._batch_actions = jax.jit(batch_actions)  # re-specializes per
+        # batch shape, so the same callable serves full and half populations
         self._center_action = jax.jit(center_action)
+
+    def _carries(self, n: int):
+        """Stacked episode-start carries for an n-member batch."""
+        one = self._carry_init()
+        return jax.tree_util.tree_map(
+            lambda x: jnp.broadcast_to(x, (n,) + x.shape), one
+        )
 
     # ------------------------------------------------------------ interface
 
@@ -176,7 +209,13 @@ class PooledEngine:
             else self.config.population_size
         )
         obs = jnp.zeros((warm_n, self.pool.obs_dim), jnp.float32)
-        self._batch_actions(thetas[:warm_n], obs).block_until_ready()
+        if self.recurrent:
+            acts, _ = self._batch_actions(
+                thetas[:warm_n], obs, self._carries(warm_n)
+            )
+            acts.block_until_ready()
+        else:
+            self._batch_actions(thetas[:warm_n], obs).block_until_ready()
         dummy_w = jnp.zeros((self.config.population_size,), jnp.float32)
         self.core._apply_weights.lower(state, dummy_w).compile()
         return _time.perf_counter() - t0
@@ -202,8 +241,15 @@ class PooledEngine:
         alive = np.ones(n, bool)
         final_obs = obs.copy()
         steps = 0
+        carry = self._carries(n) if self.recurrent else None
         for _ in range(horizon):
-            actions = np.asarray(self._batch_actions(thetas, jnp.asarray(obs)))
+            if self.recurrent:
+                acts_dev, carry = self._batch_actions(
+                    thetas, jnp.asarray(obs), carry
+                )
+                actions = np.asarray(acts_dev)
+            else:
+                actions = np.asarray(self._batch_actions(thetas, jnp.asarray(obs)))
             next_obs, rew, done = self.pool.step(actions)
             total += rew * alive
             steps += int(alive.sum())
@@ -240,11 +286,22 @@ class PooledEngine:
         alive = np.ones(n, bool)
         steps = 0
 
+        def dispatch(half):
+            if self.recurrent:
+                acts, half["carry"] = self._batch_actions(
+                    half["thetas"], jnp.asarray(half["obs"]), half["carry"]
+                )
+                half["fut"] = acts
+            else:
+                half["fut"] = self._batch_actions(
+                    half["thetas"], jnp.asarray(half["obs"])
+                )
+
         for half in halves:
             half["obs"] = half["pool"].reset()
-            half["fut"] = self._batch_actions(
-                half["thetas"], jnp.asarray(half["obs"])
-            )
+            if self.recurrent:
+                half["carry"] = self._carries(h)
+            dispatch(half)
         final_obs = np.concatenate([halves[0]["obs"], halves[1]["obs"]], axis=0)
 
         for _ in range(horizon):
@@ -264,9 +321,7 @@ class PooledEngine:
                     final_obs[sl][just_died] = half["obs"][just_died]
                 alive[sl] &= ~done
                 half["obs"] = next_obs
-                half["fut"] = self._batch_actions(
-                    half["thetas"], jnp.asarray(next_obs)
-                )
+                dispatch(half)
 
         for half in halves:
             sl = slice(half["lo"], half["lo"] + h)
@@ -278,8 +333,15 @@ class PooledEngine:
 
         obs = self.center_pool.reset()[0]
         total, steps = 0.0, 0
+        h = self._carry_init() if self.recurrent else None
         for _ in range(self.config.horizon):
-            a = np.asarray(self._center_action(state.params_flat, jnp.asarray(obs)))
+            if self.recurrent:
+                a_dev, h = self._center_action(
+                    state.params_flat, jnp.asarray(obs), h
+                )
+                a = np.asarray(a_dev)
+            else:
+                a = np.asarray(self._center_action(state.params_flat, jnp.asarray(obs)))
             nobs, rew, done = self.center_pool.step(a[None])
             total += float(rew[0])
             steps += 1
