@@ -297,8 +297,9 @@ def stage_ab(force_cpu=False):
 def main():
     # dtype deliberately unset: measure_one picks bf16 on TPU, f32 elsewhere.
     # Headline runs the STANDARD forward: the CPU A/B (bench_ab_cpu.jsonl,
-    # committed) measured decomposed 2.4x SLOWER off-chip, and flipping the
-    # headline before on-chip evidence would front-run the A/B's decision
+    # committed) measures decomposed ~10% behind standard off-chip, and
+    # flipping the headline before on-chip evidence would front-run the
+    # A/B's decision
     headline_cfg = dict(SMALL)
     result = run_stage(headline_cfg)
     if result is None:

@@ -1,0 +1,117 @@
+"""Process-topology scaling: the same ES program on 1×8, 2×4, 4×2
+(processes × local CPU devices), collectives crossing process boundaries
+via jax.distributed/Gloo — the DCN-analog layering of a TPU pod.
+
+Measures steady-state generation time per topology so the cross-process
+collective overhead is a number, not prose.  Run on an idle machine:
+
+    python examples/multiprocess_scaling.py
+
+Each topology runs in fresh child processes (the JAX distributed runtime
+is once-per-process).  Expect the multi-process topologies to pay a
+per-generation constant (Gloo TCP allreduce + fitness all_gather) on top
+of the single-process time; on one physical core the device counts are
+virtual, so the interesting number is that constant, not parallel
+speedup.
+"""
+
+import json
+import pathlib
+import socket
+import subprocess
+import sys
+import tempfile
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+WORKER = r"""
+import sys, time, json
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", int(sys.argv[4]))
+pid, nprocs, port = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+import estorch_tpu.parallel.multihost as mh
+if nprocs > 1:
+    ok = mh.initialize(f"localhost:{port}", num_processes=nprocs,
+                       process_id=pid)
+    if not ok:
+        raise RuntimeError("jax.distributed init did not happen")
+import optax
+from estorch_tpu import ES, JaxAgent, MLPPolicy
+from estorch_tpu.envs import Pendulum
+
+es = ES(policy=MLPPolicy, agent=JaxAgent, optimizer=optax.adam,
+        population_size=256, sigma=0.05,
+        policy_kwargs={"action_dim": 1, "hidden": (64, 64),
+                       "discrete": False, "action_scale": 2.0},
+        agent_kwargs={"env": Pendulum(), "horizon": 100},
+        optimizer_kwargs={"learning_rate": 1e-2}, seed=7,
+        mesh=mh.global_population_mesh())
+es.train(1, verbose=False)   # compile outside timing
+t0 = time.perf_counter()
+GENS = 5
+es.train(GENS, verbose=False)
+dt = (time.perf_counter() - t0) / GENS
+if pid == 0:
+    print(json.dumps({"s_per_gen": dt,
+                      "steps_per_gen": es.history[-1]["env_steps"]}))
+"""
+
+
+def free_port():
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def run_topology(nprocs: int, local_devices: int) -> dict:
+    port = free_port()
+    with tempfile.NamedTemporaryFile("w", suffix=".py", delete=False) as f:
+        f.write(WORKER)
+        path = f.name
+    procs = []
+    try:
+        procs = [
+            subprocess.Popen(
+                [sys.executable, path, str(pid), str(nprocs), str(port),
+                 str(local_devices)],
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+                env={"PYTHONPATH": str(REPO), "PATH": "/usr/bin:/bin"},
+            )
+            for pid in range(nprocs)
+        ]
+        outs = []
+        for p in procs:
+            try:
+                outs.append(p.communicate(timeout=900)[0])
+            except subprocess.TimeoutExpired:
+                raise RuntimeError(
+                    f"{nprocs}x{local_devices}: worker hung (>900s) — "
+                    "likely a Gloo rendezvous deadlock"
+                )
+        if any(p.returncode != 0 for p in procs):
+            raise RuntimeError(f"{nprocs}x{local_devices}: a worker failed")
+        line = [ln for ln in outs[0].splitlines() if ln.startswith("{")][-1]
+        return json.loads(line)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        pathlib.Path(path).unlink(missing_ok=True)
+
+
+def main():
+    results = {}
+    for nprocs, local in ((1, 8), (2, 4), (4, 2)):
+        r = run_topology(nprocs, local)
+        results[f"{nprocs}x{local}"] = r
+        print(f"{nprocs} proc x {local} dev: {r['s_per_gen']*1e3:.0f} ms/gen "
+              f"({r['steps_per_gen']} steps)", flush=True)
+    base = results["1x8"]["s_per_gen"]
+    for k, r in results.items():
+        print(f"{k}: overhead vs single-process "
+              f"{(r['s_per_gen'] - base)*1e3:+.0f} ms/gen")
+
+
+if __name__ == "__main__":
+    main()
