@@ -109,6 +109,9 @@ class ES:
         noise_kernel: bool = False,
         streamed: bool = False,
         low_rank: int = 0,
+        obs_norm: bool = False,
+        obs_clip: float = 5.0,
+        obs_probe_episodes: int = 1,
     ):
         self.population_size = population_size
         self.sigma = sigma
@@ -126,6 +129,9 @@ class ES:
         self._noise_kernel = bool(noise_kernel)
         self._streamed = bool(streamed)
         self._low_rank = int(low_rank)
+        self._obs_norm = bool(obs_norm)
+        self._obs_clip = float(obs_clip)
+        self._obs_probe_episodes = int(obs_probe_episodes)
 
         self._policy_arg = policy
         self._policy_kwargs = dict(policy_kwargs or {})
@@ -164,6 +170,12 @@ class ES:
             if low_rank:
                 raise ValueError(
                     "low_rank is a device-path option (ops/lowrank.py)"
+                )
+            if obs_norm:
+                raise ValueError(
+                    "obs_norm is a device-path option (running stats ride "
+                    "the compiled generation program); host agents own "
+                    "their rollouts and can normalize there"
                 )
             self.backend = "host"
             self._init_host(
@@ -298,6 +310,14 @@ class ES:
                     "the reference-batch capture applies the module "
                     "statelessly (models/vbn.py)"
                 )
+            if self._obs_norm:
+                raise ValueError(
+                    "VirtualBatchNorm + obs_norm is unsupported: the VBN "
+                    "reference batch is captured in RAW observation space "
+                    "at init, so its frozen stats would mis-calibrate "
+                    "against normalized rollout inputs — pick one input-"
+                    "normalization scheme"
+                )
             self._frozen["vbn_stats"] = capture_reference_stats(
                 self.module, variables, vbn_ref_fn(vbn_key)
             )
@@ -337,6 +357,9 @@ class ES:
             noise_kernel=self._noise_kernel,
             streamed=self._streamed,
             low_rank=self._low_rank,
+            obs_norm=self._obs_norm,
+            obs_clip=self._obs_clip,
+            obs_probe_episodes=self._obs_probe_episodes,
         )
         return flat, state_key
 
@@ -672,14 +695,35 @@ class ES:
             if fn is None:
                 from ..envs.rollout import make_rollout
 
+                apply_fn = self._policy_apply
+                if self._obs_norm:
+                    from ..parallel.engine import normalize_obs
+
+                    base_apply, clip = self._policy_apply, self._obs_clip
+                    if self._recurrent:
+                        def apply_fn(packed, obs, h):
+                            p, stats = packed
+                            return base_apply(
+                                p, normalize_obs(obs, stats, clip), h
+                            )
+                    else:
+                        def apply_fn(packed, obs):
+                            p, stats = packed
+                            return base_apply(p, normalize_obs(obs, stats, clip))
                 single = make_rollout(
-                    self.env, self._policy_apply, self.config.horizon,
+                    self.env, apply_fn, self.config.horizon,
                     carry_init=self.module.carry_init if self._recurrent else None,
                 )
                 # one cached callable: jit re-specializes per n_episodes shape
                 fn = self._eval_policy_fn = jax.jit(jax.vmap(single, in_axes=(None, 0)))
             keys = jax.random.split(jax.random.PRNGKey(seed), n_episodes)
-            res = fn(self._spec.unravel(flat), keys)
+            p = self._spec.unravel(flat)
+            if self._obs_norm:
+                # evaluate with the CURRENT running stats (also for use_best:
+                # the snapshot's own stats are part of training state, and
+                # the freshest moments are the best estimate of the env)
+                p = (p, base_state.obs_stats)
+            res = fn(p, keys)
             rewards = np.asarray(res.total_reward)
         else:
             # both engines' evaluate_center reads only state.params_flat, so
@@ -718,6 +762,11 @@ class ES:
             with torch.no_grad():
                 return policy(torch.as_tensor(np.asarray(obs), dtype=torch.float32))
         p = self.best_policy if use_best else self.policy
+        if getattr(self, "_obs_norm", False):
+            from ..parallel.engine import normalize_obs
+
+            obs = normalize_obs(jnp.asarray(obs), self.state.obs_stats,
+                                self._obs_clip)
         if getattr(self, "_recurrent", False):
             if carry is None:
                 carry = self.module.carry_init()

@@ -39,7 +39,8 @@ def make_rollout(
     policy_apply: Callable[..., jax.Array],
     horizon: int,
     carry_init: Callable[[], Any] | None = None,
-) -> Callable[[Any, jax.Array], RolloutResult]:
+    with_obs_moments: bool = False,
+) -> Callable[[Any, jax.Array], Any]:
     """Build ``rollout(params, key) -> RolloutResult`` for one episode.
 
     ``policy_apply(params, obs) -> action logits/values``.  The returned
@@ -53,24 +54,40 @@ def make_rollout(
     (its ``agent.rollout`` owns the loop, SURVEY.md §3.3, so torch users
     thread hidden state themselves); here the loop is a compiled scan, so
     the framework must thread it.
+
+    ``with_obs_moments=True``: the SAME scan additionally accumulates
+    alive-masked raw-observation moments over the observations the policy
+    acted on (including the reset frame) and the rollout returns
+    ``(RolloutResult, (count, obs_sum, obs_sumsq))`` — the obs_norm
+    probe's data source (parallel/engine.py), sharing one step body with
+    the plain rollout so the two can never desynchronize.
     """
     discrete = bool(env.discrete)
     stateful = carry_init is not None
 
-    def rollout(params: Any, key: jax.Array) -> RolloutResult:
+    def rollout(params: Any, key: jax.Array):
         state0, obs0 = env.reset(key)
         h0 = carry_init() if stateful else None
+        zeros = jnp.zeros_like(obs0, dtype=jnp.float32)
 
         def step_fn(carry, _):
-            state, obs, done, total, steps, h = carry
+            state, obs, done, total, steps, h, moments = carry
+            alive = jnp.logical_not(done)
+            alive_f = alive.astype(jnp.float32)
+            if with_obs_moments:
+                cnt, osum, osumsq = moments
+                of = obs.astype(jnp.float32)
+                moments = (
+                    cnt + alive_f,
+                    osum + alive_f * of,
+                    osumsq + alive_f * of * of,
+                )
             if stateful:
                 out, h_new = policy_apply(params, obs, h)
             else:
                 out, h_new = policy_apply(params, obs), h
             action = select_action(out, discrete)
             nstate, nobs, reward, ndone = env.step(state, action)
-            alive = jnp.logical_not(done)
-            alive_f = alive.astype(jnp.float32)
             total = total + reward * alive_f
             steps = steps + alive.astype(jnp.int32)
             # freeze state/obs after termination so BC reads the final frame
@@ -79,7 +96,9 @@ def make_rollout(
             obs_next = keep(nobs, obs)
             h_next = jax.tree_util.tree_map(keep, h_new, h)
             done_next = done | ndone
-            return (state_next, obs_next, done_next, total, steps, h_next), None
+            return (
+                state_next, obs_next, done_next, total, steps, h_next, moments
+            ), None
 
         init = (
             state0,
@@ -88,14 +107,42 @@ def make_rollout(
             jnp.float32(0.0),
             jnp.int32(0),
             h0,
+            (jnp.float32(0.0), zeros, zeros) if with_obs_moments else None,
         )
-        (state, obs, done, total, steps, _), _ = jax.lax.scan(
+        (state, obs, done, total, steps, _, moments), _ = jax.lax.scan(
             step_fn, init, None, length=horizon
         )
         bc = env.behavior(state, obs).astype(jnp.float32)
-        return RolloutResult(total_reward=total, bc=bc, steps=steps)
+        res = RolloutResult(total_reward=total, bc=bc, steps=steps)
+        return (res, moments) if with_obs_moments else res
 
     return rollout
+
+
+def make_obs_probe(
+    env: Any,
+    policy_apply: Callable[..., jax.Array],
+    horizon: int,
+    carry_init: Callable[[], Any] | None = None,
+) -> Callable[[Any, jax.Array], tuple[jax.Array, jax.Array, jax.Array]]:
+    """One episode's raw-observation moments: ``probe(params, key) ->
+    (count, obs_sum, obs_sumsq)``.
+
+    Thin wrapper over :func:`make_rollout` with ``with_obs_moments=True``
+    — the probe IS a center-policy episode (same step body, same
+    termination/freeze semantics); only the moments are kept.  When the
+    apply is the engine's normalization-packed form, normalization
+    happens inside it, so the moments stay in raw observation space (what
+    the running stats normalize).  Powers ``EngineConfig.obs_norm``.
+    """
+    rollout = make_rollout(env, policy_apply, horizon,
+                           carry_init=carry_init, with_obs_moments=True)
+
+    def probe(params: Any, key: jax.Array):
+        _, moments = rollout(params, key)
+        return moments
+
+    return probe
 
 
 def make_population_rollout(

@@ -36,7 +36,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..envs.rollout import make_rollout
+from ..envs.rollout import make_obs_probe, make_rollout
 from ..ops.gradient import es_gradient, rank_weighted_noise_sum
 from ..ops.noise import NoiseTable, member_offsets, pair_signs, sample_pair_offsets
 from ..ops.params import ParamSpec
@@ -86,6 +86,16 @@ class EngineConfig:
     # drop from O(population·dim) to O(2·tile). Implies a population-
     # batched rollout (one policy call per step for the whole local shard).
     # Needs a streamed_apply (ES builds it for MLPPolicy); f32 only.
+    obs_norm: bool = False  # running observation normalization (the
+    # OpenAI-ES MuJoCo staple the reference never had): every policy input
+    # is (obs - mean)·rsqrt(var) clipped to ±obs_clip, with the running
+    # raw-obs moments carried in ESState.obs_stats and refreshed each
+    # generation from obs_probe_episodes center-policy episodes — fully
+    # in-program, replicated on every device. Standard + recurrent
+    # forwards; mutually exclusive with decomposed/streamed/low_rank.
+    obs_clip: float = 5.0  # normalized-obs clip range
+    obs_probe_episodes: int = 1  # center episodes per generation feeding
+    # the running stats (more → faster stat convergence, more probe FLOPs)
 
 
 class ESState(NamedTuple):
@@ -96,6 +106,37 @@ class ESState(NamedTuple):
     key: jax.Array  # PRNG key, folded with generation for per-gen streams
     generation: jax.Array  # () int32
     sigma: jax.Array  # () float32 — current perturbation scale (annealable)
+    obs_stats: Any = None  # obs_norm only: (count, mean, m2) running
+    # raw-observation moments in Welford form — mean and m2/count stay O(1)
+    # magnitude forever, so no f32 cancellation or accumulator saturation
+    # however long the run (naive sum/sumsq would cancel catastrophically
+    # on dims with |mean| >> std, exactly the locomotion case obs_norm
+    # exists for)
+
+
+def normalize_obs(obs: jax.Array, obs_stats, clip: float) -> jax.Array:
+    """(obs − mean)·rsqrt(var), clipped — the obs_norm transform.
+
+    ``obs_stats`` is the (count, mean, m2) Welford triple (var = m2/count);
+    variance is floored at 1e-8 so fresh stats (var≈1 at init) and
+    constant dimensions stay finite."""
+    cnt, mean, m2 = obs_stats
+    var = jnp.maximum(m2 / cnt, 1e-8)
+    x = (obs.astype(jnp.float32) - mean) * jax.lax.rsqrt(var)
+    return jnp.clip(x, -clip, clip)
+
+
+def merge_obs_moments(obs_stats, cnt1, osum1, osumsq1):
+    """Chan parallel update: fold one generation's raw probe sums (small —
+    a few episodes' worth, safe in f32) into the running Welford triple."""
+    c0, mean0, m2_0 = obs_stats
+    mean1 = osum1 / cnt1
+    m2_1 = jnp.maximum(osumsq1 - osum1 * mean1, 0.0)
+    tot = c0 + cnt1
+    delta = mean1 - mean0
+    mean = mean0 + delta * (cnt1 / tot)
+    m2 = m2_0 + m2_1 + delta * delta * (c0 * cnt1 / tot)
+    return tot, mean, m2
 
 
 class EvalResult(NamedTuple):
@@ -214,6 +255,17 @@ class ESEngine:
                 "recurrent policies run the standard forward; they are "
                 "mutually exclusive with decomposed/streamed/low_rank"
             )
+        if config.obs_norm:
+            if config.decomposed or config.streamed or config.low_rank:
+                raise ValueError(
+                    "obs_norm runs the standard forward; it is mutually "
+                    "exclusive with decomposed/streamed/low_rank"
+                )
+            if env is None:
+                raise ValueError(
+                    "obs_norm needs device-native rollouts to carry the "
+                    "running stats in-program; it is a device-path option"
+                )
         if config.low_rank:
             if config.decomposed or config.streamed or config.noise_kernel:
                 raise ValueError(
@@ -307,6 +359,8 @@ class ESEngine:
             self.members_local = config.population_size // self.n_devices
         self.eval_chunk = _choose_eval_chunk(config.eval_chunk, self.members_local)
 
+        self._obs_norm = config.obs_norm  # always False when env is None
+        # (the guard above rejects obs_norm for update-only engines)
         if env is None:
             # update-only mode: the evaluation happens elsewhere (e.g. the
             # pooled host-env path, parallel/pooled.py) and only the
@@ -317,8 +371,30 @@ class ESEngine:
             return
         self.bc_dim = int(env.bc_dim)
 
+        # obs_norm: every rollout's apply takes (params, obs_stats) packed —
+        # the running stats ride the SAME traced state the params do, so the
+        # whole generation (members + probe + center eval) normalizes with
+        # one consistent snapshot
+        rollout_apply = policy_apply
+        if config.obs_norm:
+            clip = float(config.obs_clip)
+            base_apply = policy_apply
+            if carry_init is not None:
+                def rollout_apply(packed, obs, h):
+                    p, stats = packed
+                    return base_apply(p, normalize_obs(obs, stats, clip), h)
+            else:
+                def rollout_apply(packed, obs):
+                    p, stats = packed
+                    return base_apply(p, normalize_obs(obs, stats, clip))
+
         self._rollout = make_rollout(
-            env, policy_apply, config.horizon, carry_init=carry_init
+            env, rollout_apply, config.horizon, carry_init=carry_init
+        )
+        self._obs_probe = (
+            make_obs_probe(env, rollout_apply, config.horizon,
+                           carry_init=carry_init)
+            if config.obs_norm else None
         )
 
         self._rollout_batched = None
@@ -380,6 +456,8 @@ class ESEngine:
             _, rkey = _gen_keys(state)
             ckey = jax.random.fold_in(rkey, 2**31 - 1)  # stream disjoint from members
             params = self._member_cast(self.spec.unravel(state.params_flat))
+            if self._obs_norm:
+                params = (params, state.obs_stats)
             return self._rollout(params, ckey)
 
         # evaluates the unperturbed center policy (reference's `es.policy`):
@@ -500,6 +578,10 @@ class ESEngine:
                     # once-per-member cast (bf16 path): the rollout scan
                     # below runs on dtype-pure params, no per-step casts
                     params = self._member_cast(self.spec.unravel(theta))
+                    if self._obs_norm:
+                        # every member this generation normalizes with the
+                        # SAME stats snapshot (vmap broadcasts the pack)
+                        params = (params, state.obs_stats)
                 return self._member_rollout(rollout, params, key)
 
             f, bc, st = jax.vmap(member_eval)(offs_c, signs_c, keys_c)
@@ -635,14 +717,35 @@ class ESEngine:
         new_sigma = state.sigma
         if cfg.sigma_decay != 1.0:
             new_sigma = jnp.maximum(state.sigma * cfg.sigma_decay, cfg.sigma_min)
+        new_obs_stats = state.obs_stats
+        if self._obs_norm:
+            # refresh the running stats from center-policy probe episodes —
+            # deterministic and identical on every device (replicated
+            # params + keys); Chan merge keeps the Welford triple O(1)
+            c1, s1, q1 = self._probe_obs_moments(state)
+            new_obs_stats = merge_obs_moments(state.obs_stats, c1, s1, q1)
         new_state = ESState(
             params_flat=new_params,
             opt_state=new_opt_state,
             key=state.key,
             generation=state.generation + 1,
             sigma=new_sigma,
+            obs_stats=new_obs_stats,
         )
         return new_state, jnp.linalg.norm(grad_ascent)
+
+    def _probe_obs_moments(self, state: ESState):
+        """Summed (count, obs_sum, obs_sumsq) over obs_probe_episodes
+        center-policy episodes, keyed disjointly from member/center streams."""
+        _, rkey = _gen_keys(state)
+        base = jax.random.fold_in(rkey, 2**31 - 2)
+        keys = jax.vmap(lambda i: jax.random.fold_in(base, i))(
+            jnp.arange(self.config.obs_probe_episodes)
+        )
+        params = self._member_cast(self.spec.unravel(state.params_flat))
+        packed = (params, state.obs_stats)
+        c, s, q = jax.vmap(self._obs_probe, in_axes=(None, 0))(packed, keys)
+        return c.sum(), s.sum(axis=0), q.sum(axis=0)
 
     # ---- shard_map bodies ----
 
@@ -682,12 +785,24 @@ class ESEngine:
 
         chex.assert_shape(params_flat, (self.spec.dim,))
         chex.assert_tree_all_finite(params_flat)
+        obs_stats = None
+        if self._obs_norm:
+            # count=1, mean=0, m2=1 → var 1: the first generation
+            # normalizes as identity-ish and real moments take over as the
+            # probe count grows
+            obs_dim = int(self.env.obs_dim)
+            obs_stats = (
+                jnp.float32(1.0),
+                jnp.zeros((obs_dim,), jnp.float32),
+                jnp.ones((obs_dim,), jnp.float32),
+            )
         return ESState(
             params_flat=params_flat,
             opt_state=self.optimizer.init(params_flat),
             key=key,
             generation=jnp.int32(0),
             sigma=jnp.float32(self.config.sigma),
+            obs_stats=obs_stats,
         )
 
     def compile(self, state: ESState) -> float:

@@ -43,6 +43,8 @@ def _pack_state(es, st) -> dict:
     else:
         d["key"] = _np(st.key)
         d["opt_state"] = _to_numpy_tree(st.opt_state)
+        if getattr(st, "obs_stats", None) is not None:
+            d["obs_stats"] = _to_numpy_tree(st.obs_stats)
     return d
 
 
@@ -89,6 +91,10 @@ def _meta_dict(es) -> dict:
         "seed": es.seed,
         "generation": int(es.generation),
         "history_len": len(es.history),
+        # state-SCHEMA flag: obs_norm adds obs_stats to every device state;
+        # restoring across a mismatch would otherwise fail deep inside
+        # Orbax (template mismatch) or silently drop the stats
+        "obs_norm": bool(getattr(es, "_obs_norm", False)),
     }
     if hasattr(es, "archive"):
         meta["archive_k"] = es.archive.k
@@ -157,6 +163,18 @@ def restore_checkpoint(es, path: str) -> None:
     if meta["algo"] != type(es).__name__:
         raise ValueError(
             f"checkpoint algo {meta['algo']!r} != this object's {type(es).__name__!r}"
+        )
+    # schema gate: obs_norm changes every device state's shape (obs_stats).
+    # Checkpoints from before the flag existed lack the key → treated as
+    # written with obs_norm off.
+    ck_obs_norm = bool(meta.get("obs_norm", False))
+    es_obs_norm = bool(getattr(es, "_obs_norm", False))
+    if ck_obs_norm != es_obs_norm:
+        raise ValueError(
+            f"checkpoint was written with obs_norm={ck_obs_norm} but this "
+            f"object was constructed with obs_norm={es_obs_norm} — rebuild "
+            "with the matching setting (the running obs stats are part of "
+            "training state)"
         )
 
     ckptr = ocp.StandardCheckpointer()
@@ -233,12 +251,18 @@ def _unpack_state(es, packed: dict, host_opt=None):
 
     from ..parallel.engine import ESState
 
+    obs_stats = packed.get("obs_stats")
+    if obs_stats is not None:
+        obs_stats = tuple(
+            jnp.asarray(x, jnp.float32) for x in obs_stats
+        )
     return ESState(
         params_flat=jnp.asarray(packed["params_flat"]),
         opt_state=packed["opt_state"],
         key=jnp.asarray(packed["key"]),
         generation=jnp.int32(packed["generation"]),
         sigma=jnp.float32(packed["sigma"]),
+        obs_stats=obs_stats,
     )
 
 

@@ -71,26 +71,35 @@ def run_topology(nprocs: int, local_devices: int) -> dict:
         path = f.name
     procs = []
     try:
+        import os
+
+        env = {**os.environ, "PYTHONPATH": str(REPO)}
         procs = [
             subprocess.Popen(
                 [sys.executable, path, str(pid), str(nprocs), str(port),
                  str(local_devices)],
-                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
-                env={"PYTHONPATH": str(REPO), "PATH": "/usr/bin:/bin"},
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+                env=env,
             )
             for pid in range(nprocs)
         ]
-        outs = []
+        outs, errs = [], []
         for p in procs:
             try:
-                outs.append(p.communicate(timeout=900)[0])
+                out, err = p.communicate(timeout=900)
+                outs.append(out)
+                errs.append(err)
             except subprocess.TimeoutExpired:
                 raise RuntimeError(
                     f"{nprocs}x{local_devices}: worker hung (>900s) — "
                     "likely a Gloo rendezvous deadlock"
                 )
-        if any(p.returncode != 0 for p in procs):
-            raise RuntimeError(f"{nprocs}x{local_devices}: a worker failed")
+        for p, err in zip(procs, errs):
+            if p.returncode != 0:
+                raise RuntimeError(
+                    f"{nprocs}x{local_devices}: a worker failed; stderr "
+                    f"tail:\n{err[-2000:]}"
+                )
         line = [ln for ln in outs[0].splitlines() if ln.startswith("{")][-1]
         return json.loads(line)
     finally:
