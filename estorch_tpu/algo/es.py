@@ -295,10 +295,7 @@ class ES:
             jax.random.PRNGKey(self.seed), 3
         )
         self._obs0 = obs0
-        if self._recurrent:
-            variables = self.module.init(init_key, obs0, self.module.carry_init())
-        else:
-            variables = self.module.init(init_key, obs0)
+        variables = self._module_init(init_key)
         params = variables["params"]
         self._frozen = {k: v for k, v in variables.items() if k != "params"}
 
@@ -362,6 +359,15 @@ class ES:
             obs_probe_episodes=self._obs_probe_episodes,
         )
         return flat, state_key
+
+    def _module_init(self, key):
+        """Flax module init honoring the policy kind's apply contract —
+        the ONE place that knows recurrent modules take a carry (used for
+        both the main init and the novelty family's fresh meta-centers,
+        so the two can never diverge)."""
+        if self._recurrent:
+            return self.module.init(key, self._obs0, self.module.carry_init())
+        return self.module.init(key, self._obs0)
 
     def _post_engine_init(self):
         self.best_reward = -np.inf

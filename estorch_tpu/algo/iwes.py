@@ -81,6 +81,14 @@ class IW_ES(ES):
                 "IW_ES supports the standard/decomposed forwards; "
                 "streamed/noise_kernel are untested with reuse"
             )
+        if self._obs_norm:
+            raise ValueError(
+                "IW_ES does not support obs_norm: buffered generations' "
+                "fitness was measured under OLDER running stats, so the "
+                "effective policy f(θ) the density ratio assumes fixed "
+                "drifts with the normalization — the reuse estimate would "
+                "be silently biased"
+            )
         # newest-last ring of minimal per-generation reuse records:
         # (params_flat, sigma, pair_offsets, fitness).  Deliberately NOT the
         # whole ESState — that would pin reuse_window copies of the optax

@@ -84,8 +84,8 @@ class NS_ES(ES):
                     .numpy()
                 )
             return self.engine.init_state(flat, key=self.seed + 7919 * m)
-        vs = self.module.init(
-            jax.random.fold_in(jax.random.PRNGKey(self.seed), 1000 + m), self._obs0
+        vs = self._module_init(
+            jax.random.fold_in(jax.random.PRNGKey(self.seed), 1000 + m)
         )
         flat = self._spec.flatten(vs["params"])
         return self.engine.init_state(

@@ -184,3 +184,40 @@ def test_engine_modes_run_all_algorithms(mode):
     es.train(2, verbose=False)
     assert len(es.history) == 2
     assert np.isfinite(es.history[-1]["reward_mean"])
+
+
+@pytest.mark.parametrize("mode", ["obs_norm", "recurrent"])
+def test_round3_modes_run_novelty_family(mode):
+    """obs_norm and recurrent policies compose with the novelty family's
+    split path (stats refresh / carry threading live below _eval_local and
+    apply_weights, which NS/NSR/NSRA share with vanilla ES)."""
+    from estorch_tpu import NSR_ES, RecurrentPolicy
+
+    kw = dict(BACKENDS["device"])
+    over = {}
+    if mode == "obs_norm":
+        over["obs_norm"] = True
+    else:
+        kw["policy"] = RecurrentPolicy
+        kw["policy_kwargs"] = {"action_dim": 2, "hidden": (8,),
+                               "gru_size": 8}
+    es = NSR_ES(population_size=16, sigma=0.05, seed=0, table_size=1 << 14,
+                meta_population_size=2, k=3, **kw, **over)
+    es.train(2, verbose=False)
+    assert len(es.history) == 2
+    assert np.isfinite(es.history[-1]["reward_mean"])
+    if mode == "obs_norm":
+        for st in es.meta_states:
+            assert st.obs_stats is not None
+
+
+def test_iwes_rejects_obs_norm():
+    """Buffered generations' fitness was measured under older running
+    stats — the density ratio's fixed-f(θ) assumption breaks, so the
+    combination must fail loudly, not bias silently."""
+    from estorch_tpu import IW_ES
+
+    kw = dict(BACKENDS["device"])
+    with pytest.raises(ValueError, match="obs_norm"):
+        IW_ES(population_size=16, sigma=0.05, seed=0, table_size=1 << 14,
+              obs_norm=True, **kw)
