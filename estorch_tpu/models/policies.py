@@ -23,7 +23,7 @@ MXU when vmapped across the population.
 
 from __future__ import annotations
 
-from typing import Callable, Sequence
+from typing import Any, Callable, Sequence
 
 import flax.linen as nn
 import jax.numpy as jnp
@@ -59,12 +59,14 @@ class MLPPolicy(nn.Module):
 
 
 class RecurrentPolicy(nn.Module):
-    """MLP trunk + GRU core + action head, for POMDPs.
+    """MLP trunk + recurrent core (GRU or LSTM) + action head, for POMDPs.
 
     Apply contract (recurrent): ``module.apply(vars, obs, carry) ->
-    (out, new_carry)``; ``carry_init()`` gives the episode-start carry.
-    The GRU is ordinary dense matmuls — vmapped across the population they
-    batch onto the MXU exactly like the feedforward policies.
+    (out, new_carry)``; ``carry_init()`` gives the episode-start carry —
+    an array for the GRU, an ``(c, h)`` tuple for the LSTM (every consumer
+    is pytree-agnostic, so the cell choice is invisible downstream).
+    The cells are ordinary dense matmuls — vmapped across the population
+    they batch onto the MXU exactly like the feedforward policies.
     """
 
     action_dim: int
@@ -73,24 +75,35 @@ class RecurrentPolicy(nn.Module):
     discrete: bool = True
     action_scale: float = 1.0
     activation: Callable = nn.tanh
+    cell: str = "gru"  # "gru" | "lstm"
 
     # marks the module for ES/rollout wiring (not a dataclass field)
     is_recurrent = True
 
+    def _check_cell(self) -> None:
+        if self.cell not in ("gru", "lstm"):
+            raise ValueError(f"cell must be 'gru' or 'lstm', got {self.cell!r}")
+
     @nn.compact
-    def __call__(
-        self, x: jnp.ndarray, carry: jnp.ndarray
-    ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    def __call__(self, x: jnp.ndarray, carry) -> tuple[jnp.ndarray, Any]:
+        self._check_cell()
         for i, h in enumerate(self.hidden):
             x = self.activation(nn.Dense(h, name=f"dense_{i}")(x))
-        carry, x = nn.GRUCell(features=self.gru_size, name="gru")(carry, x)
+        if self.cell == "lstm":
+            carry, x = nn.OptimizedLSTMCell(
+                features=self.gru_size, name="lstm"
+            )(carry, x)
+        else:
+            carry, x = nn.GRUCell(features=self.gru_size, name="gru")(carry, x)
         x = nn.Dense(self.action_dim, name="head")(x)
         if not self.discrete:
             x = jnp.tanh(x) * self.action_scale
         return x, carry
 
-    def carry_init(self) -> jnp.ndarray:
-        return jnp.zeros((self.gru_size,), jnp.float32)
+    def carry_init(self):
+        self._check_cell()
+        z = jnp.zeros((self.gru_size,), jnp.float32)
+        return (z, z) if self.cell == "lstm" else z
 
 
 class NatureCNN(nn.Module):

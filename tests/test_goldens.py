@@ -41,28 +41,53 @@ GOLDENS = {
         "archive_sum": 0.30099,
         "meta_indices": [1, 1, 1],
     },
+    # round-3 modes (captured 8-virtual-device CPU, same recipe):
+    "ES_obsnorm": {
+        "reward_means": [43.0, 46.1875, 46.4375],
+        "params_sum": -5.65297,
+        # probe accounting: 1 (init) + 3 gens × 1 episode, CartPole-length
+        # episodes — pinned so the stats plumbing can't silently change
+        "obs_count": 140.0,
+        "obs_mean_sum": 0.03157,
+    },
+    "ES_recurrent": {"reward_means": [9.875, 9.625, 9.375],
+                     "params_sum": -2.02425},
+    "ES_lowrank": {"reward_means": [43.625, 41.25, 38.25],
+                   "params_sum": -5.60954},
 }
 
 CLASSES = {"ES": ES, "ES_decomposed": ES, "NS_ES": NS_ES, "NSR_ES": NSR_ES,
-           "NSRA_ES": NSRA_ES}
+           "NSRA_ES": NSRA_ES, "ES_obsnorm": ES, "ES_recurrent": ES,
+           "ES_lowrank": ES}
 EXTRA = {
     "ES": {},
     "ES_decomposed": {"decomposed": True},
     "NS_ES": {"meta_population_size": 2, "k": 3},
     "NSR_ES": {"meta_population_size": 2, "k": 3},
     "NSRA_ES": {"meta_population_size": 2, "k": 3, "weight": 0.7},
+    "ES_obsnorm": {"obs_norm": True},
+    "ES_recurrent": {},
+    "ES_lowrank": {"low_rank": 1},
 }
 
 
 def _run(name):
+    from estorch_tpu import RecurrentPolicy
+
+    policy = RecurrentPolicy if name == "ES_recurrent" else MLPPolicy
+    pk = (
+        {"action_dim": 2, "hidden": (8,), "gru_size": 8}
+        if name == "ES_recurrent"
+        else {"action_dim": 2, "hidden": (8,)}
+    )
     es = CLASSES[name](
-        policy=MLPPolicy,
+        policy=policy,
         agent=JaxAgent,
         optimizer=optax.adam,
         population_size=16,
         sigma=0.1,
         seed=7,
-        policy_kwargs={"action_dim": 2, "hidden": (8,)},
+        policy_kwargs=pk,
         agent_kwargs={"env": CartPole(), "horizon": 50},
         optimizer_kwargs={"learning_rate": 1e-2},
         table_size=1 << 15,
@@ -81,6 +106,10 @@ def test_golden(name):
     if name.startswith("ES"):
         got = round(float(np.asarray(es.state.params_flat).sum()), 5)
         np.testing.assert_allclose(got, g["params_sum"], atol=2e-4)
+        if "obs_count" in g:
+            assert float(es.state.obs_stats[0]) == g["obs_count"]
+            got_ms = round(float(np.asarray(es.state.obs_stats[1]).sum()), 5)
+            np.testing.assert_allclose(got_ms, g["obs_mean_sum"], atol=2e-4)
     else:
         got_sums = [
             round(float(np.asarray(s.params_flat).sum()), 5) for s in es.meta_states

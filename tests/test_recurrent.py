@@ -211,3 +211,32 @@ class TestRecurrentPredict:
         assert out.shape == (1,) and h.shape == (8,)
         out2, h2 = es.predict(jnp.zeros((1,)), carry=h)
         assert h2.shape == (8,)
+
+
+class TestLSTMCore:
+    def test_lstm_carry_is_tuple_and_trains(self):
+        pk = {**RECURRENT_PK, "cell": "lstm"}
+        mod = RecurrentPolicy(**pk)
+        c0 = mod.carry_init()
+        assert isinstance(c0, tuple) and len(c0) == 2
+        es = _make_es(RecurrentPolicy, pk, population_size=64)
+        es.train(3, verbose=False)
+        assert np.isfinite(es.history[-1]["reward_mean"])
+
+    def test_lstm_learns_memory_task(self):
+        pk = {**RECURRENT_PK, "cell": "lstm"}
+        es = _make_es(RecurrentPolicy, pk, population_size=256)
+        es.train(80, verbose=False)
+        ev = es.evaluate_policy(n_episodes=64, seed=9)
+        assert ev["mean"] > 8.0, f"LSTM policy failed to learn: {ev}"
+
+    def test_bad_cell_rejected(self):
+        with pytest.raises(ValueError, match="cell"):
+            _make_es(RecurrentPolicy, {**RECURRENT_PK, "cell": "rnn"})
+
+    def test_lstm_bf16_runs(self):
+        pk = {**RECURRENT_PK, "cell": "lstm"}
+        es = _make_es(RecurrentPolicy, pk, population_size=32,
+                      compute_dtype="bfloat16")
+        es.train(2, verbose=False)
+        assert np.isfinite(es.history[-1]["reward_mean"])
