@@ -17,7 +17,8 @@ __version__ = "0.1.0"
 from . import envs, models, ops, parallel, utils  # noqa: F401
 from .algo import ES, IW_ES, NS_ES, NSR_ES, NSRA_ES, NoveltyArchive
 from .envs.agent import JaxAgent, PooledAgent
-from .models import MLPPolicy, NatureCNN, RecurrentPolicy, VirtualBatchNorm
+from .models import (MLPPolicy, NatureCNN, RecurrentNatureCNN,
+                     RecurrentPolicy, VirtualBatchNorm)
 
 __all__ = [
     "ES",
@@ -30,6 +31,7 @@ __all__ = [
     "PooledAgent",
     "MLPPolicy",
     "NatureCNN",
+    "RecurrentNatureCNN",
     "RecurrentPolicy",
     "VirtualBatchNorm",
     "envs",

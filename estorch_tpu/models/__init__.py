@@ -1,4 +1,4 @@
-from .policies import MLPPolicy, NatureCNN, RecurrentPolicy
+from .policies import MLPPolicy, NatureCNN, RecurrentNatureCNN, RecurrentPolicy
 from .vbn import VirtualBatchNorm, capture_reference_stats
 
 
@@ -14,6 +14,7 @@ def __getattr__(name):
 __all__ = [
     "MLPPolicy",
     "NatureCNN",
+    "RecurrentNatureCNN",
     "RecurrentPolicy",
     "VirtualBatchNorm",
     "TorchVirtualBatchNorm",

@@ -26,6 +26,7 @@ from __future__ import annotations
 from typing import Any, Callable, Sequence
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from .vbn import VirtualBatchNorm
@@ -106,6 +107,68 @@ class RecurrentPolicy(nn.Module):
         return (z, z) if self.cell == "lstm" else z
 
 
+def _nature_conv_stack(x: jnp.ndarray, use_vbn: bool = False,
+                       update_stats: bool = False) -> jnp.ndarray:
+    """The shared Nature-DQN conv trunk (32×8s4, 64×4s2, 64×3s1) — ONE
+    definition serves NatureCNN and RecurrentNatureCNN so the spec cannot
+    drift between them.  Called inside an ``nn.compact`` ``__call__``;
+    submodule names stay ``conv_i``/``vbn_i``."""
+    for i, (feat, kern, stride) in enumerate(
+        [(32, 8, 4), (64, 4, 2), (64, 3, 1)]
+    ):
+        x = nn.Conv(feat, (kern, kern), strides=(stride, stride),
+                    padding="VALID", name=f"conv_{i}")(x)
+        if use_vbn:
+            x = VirtualBatchNorm(feat, name=f"vbn_{i}")(x,
+                                                        update_stats=update_stats)
+        x = nn.relu(x)
+    return x
+
+
+class RecurrentNatureCNN(nn.Module):
+    """Nature-DQN conv trunk + GRU core + head: vision policies with
+    memory, for the pooled Atari path (flickering/occluded-screen POMDPs
+    where frame stacking is not enough).
+
+    Same recurrent apply contract as :class:`RecurrentPolicy`.  No VBN:
+    the reference-batch capture applies the module statelessly, which has
+    no recurrent form (the GRU core provides the activation stability VBN
+    exists to add).
+    """
+
+    action_dim: int
+    gru_size: int = 256
+    discrete: bool = True
+    action_scale: float = 1.0
+
+    is_recurrent = True
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, carry) -> tuple[jnp.ndarray, Any]:
+        # normalize into the CARRY's dtype — the engine casts carry_init
+        # to the compute dtype (bf16 path), so this keeps the whole
+        # forward and the returned carry dtype-pure; a hard f32 cast here
+        # would silently promote every activation and flip the scan
+        # carry's dtype mid-episode
+        target = jax.tree_util.tree_leaves(carry)[0].dtype
+        if jnp.issubdtype(x.dtype, jnp.integer):
+            x = x.astype(target) / jnp.asarray(255.0, target)
+        else:
+            x = x.astype(target)
+        x = x[None]  # single observation -> batch axis for the convs
+        x = _nature_conv_stack(x)
+        x = x.reshape(-1)
+        x = nn.relu(nn.Dense(512, name="fc")(x))
+        carry, x = nn.GRUCell(features=self.gru_size, name="gru")(carry, x)
+        x = nn.Dense(self.action_dim, name="head")(x)
+        if not self.discrete:
+            x = jnp.tanh(x) * self.action_scale
+        return x, carry
+
+    def carry_init(self) -> jnp.ndarray:
+        return jnp.zeros((self.gru_size,), jnp.float32)
+
+
 class NatureCNN(nn.Module):
     """Nature-DQN CNN policy for Atari-style (84, 84, C) observations."""
 
@@ -122,14 +185,8 @@ class NatureCNN(nn.Module):
             x = x.astype(jnp.float32) / 255.0  # raw Atari bytes
         else:
             x = x.astype(jnp.float32)  # already-normalized pixels (pong84)
-        for i, (feat, kern, stride) in enumerate(
-            [(32, 8, 4), (64, 4, 2), (64, 3, 1)]
-        ):
-            x = nn.Conv(feat, (kern, kern), strides=(stride, stride), padding="VALID",
-                        name=f"conv_{i}")(x)
-            if self.use_vbn:
-                x = VirtualBatchNorm(feat, name=f"vbn_{i}")(x, update_stats=update_stats)
-            x = nn.relu(x)
+        x = _nature_conv_stack(x, use_vbn=self.use_vbn,
+                               update_stats=update_stats)
         x = x.reshape((x.shape[0], -1))
         x = nn.relu(nn.Dense(512, name="fc")(x))
         x = nn.Dense(self.action_dim, name="head")(x)

@@ -240,3 +240,37 @@ class TestLSTMCore:
                       compute_dtype="bfloat16")
         es.train(2, verbose=False)
         assert np.isfinite(es.history[-1]["reward_mean"])
+
+
+class TestRecurrentVision:
+    """RecurrentNatureCNN on the pooled pixel-pong path: conv trunk + GRU
+    memory over real 84×84 observations."""
+
+    def test_shapes_and_carry(self):
+        from estorch_tpu import RecurrentNatureCNN
+
+        mod = RecurrentNatureCNN(action_dim=3, gru_size=32)
+        obs = jnp.zeros((84, 84, 1), jnp.float32)
+        h0 = mod.carry_init()
+        assert h0.shape == (32,)
+        variables = mod.init(jax.random.PRNGKey(0), obs, h0)
+        out, h1 = mod.apply(variables, obs, h0)
+        assert out.shape == (3,) and h1.shape == (32,)
+
+    def test_pooled_pong_trains(self):
+        from estorch_tpu import PooledAgent, RecurrentNatureCNN
+
+        es = ES(
+            policy=RecurrentNatureCNN,
+            agent=PooledAgent,
+            optimizer=optax.adam,
+            population_size=16,
+            sigma=0.05,
+            policy_kwargs={"action_dim": 3, "gru_size": 32},
+            agent_kwargs={"env_name": "pong84", "horizon": 48},
+            optimizer_kwargs={"learning_rate": 1e-2},
+            seed=0,
+        )
+        es.train(1, verbose=False)
+        assert np.isfinite(es.history[-1]["reward_mean"])
+        assert es.engine.recurrent
