@@ -110,15 +110,56 @@ def _meta_dict(es) -> dict:
     return meta
 
 
-def save_checkpoint(es, path: str) -> None:
-    """Write a complete checkpoint of ``es`` to directory ``path``."""
+class AsyncSaveHandle:
+    """Returned by ``save_checkpoint(..., asynchronous=True)``: the array
+    write continues in Orbax's background thread while training proceeds.
+    Call :meth:`wait` (idempotent) before restoring from the path or
+    exiting the process."""
+
+    def __init__(self, ckptr, owned: bool = True):
+        self._ckptr = ckptr
+        self._owned = owned  # shared checkpointers (PeriodicCheckpointer)
+        # are closed by their owner, not per-save
+        self._done = False
+
+    def wait(self) -> None:
+        if not self._done:
+            self._ckptr.wait_until_finished()
+            if self._owned:
+                self._ckptr.close()
+            self._done = True
+
+
+def save_checkpoint(es, path: str, asynchronous: bool = False,
+                    _async_ckptr=None):
+    """Write a complete checkpoint of ``es`` to directory ``path``.
+
+    ``asynchronous=True``: the device→disk array write happens in Orbax's
+    background thread, so on a real accelerator the training loop is not
+    blocked for the save's disk time (JAX snapshots the on-device values
+    at save-call time — later training steps cannot corrupt the write).
+    Returns an :class:`AsyncSaveHandle`; call ``.wait()`` before restoring
+    or process exit.  Synchronous saves return ``None``.
+    """
     import orbax.checkpoint as ocp
 
     path = os.path.abspath(path)
     os.makedirs(path, exist_ok=True)
-    ckptr = ocp.StandardCheckpointer()
-    ckptr.save(os.path.join(path, "state"), _state_tree(es), force=True)
-    ckptr.wait_until_finished()
+    if asynchronous:
+        # _async_ckptr: a long-lived checkpointer supplied by the caller
+        # (PeriodicCheckpointer) — Orbax's intended reuse pattern; a bare
+        # call gets its own, closed by the handle's wait()
+        ckptr = _async_ckptr or ocp.AsyncCheckpointer(
+            ocp.StandardCheckpointHandler()
+        )
+        ckptr.save(
+            os.path.join(path, "state"),
+            args=ocp.args.StandardSave(_state_tree(es)),
+            force=True,
+        )
+    else:
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(os.path.join(path, "state"), _state_tree(es), force=True)
     with open(os.path.join(path, "meta.json"), "w") as f:
         json.dump(_meta_dict(es), f, indent=2)
     # per-generation records survive resume (meta's history_len cross-checks)
@@ -131,6 +172,10 @@ def save_checkpoint(es, path: str) -> None:
             [s.opt_state for s in _all_states(es)],
             os.path.join(path, "host_opt.pt"),
         )
+    if asynchronous:
+        return AsyncSaveHandle(ckptr, owned=_async_ckptr is None)
+    ckptr.wait_until_finished()
+    return None
 
 
 def restore_checkpoint(es, path: str) -> None:
@@ -274,11 +319,25 @@ class PeriodicCheckpointer:
         es.train(100, log_fn=ck.on_record)
     """
 
-    def __init__(self, es, root: str, every: int = 10, max_to_keep: int = 3):
+    def __init__(self, es, root: str, every: int = 10, max_to_keep: int = 3,
+                 asynchronous: bool = False):
         self.es = es
         self.root = os.path.abspath(root)
         self.every = int(every)
         self.max_to_keep = int(max_to_keep)
+        # asynchronous: each save's array write drains in Orbax's
+        # background thread while training continues; the previous save is
+        # awaited before the next one starts (at most one write in flight),
+        # and ONE long-lived AsyncCheckpointer serves every save
+        self.asynchronous = bool(asynchronous)
+        self._pending = None
+        self._ckptr = None
+        if self.asynchronous:
+            import orbax.checkpoint as ocp
+
+            self._ckptr = ocp.AsyncCheckpointer(
+                ocp.StandardCheckpointHandler()
+            )
         os.makedirs(self.root, exist_ok=True)
 
     def on_record(self, record: dict) -> None:
@@ -287,14 +346,45 @@ class PeriodicCheckpointer:
             self.save(gen)
 
     def save(self, gen: int) -> str:
+        self.wait()
         path = os.path.join(self.root, f"gen_{gen:08d}")
-        save_checkpoint(self.es, path)
-        self._gc()
+        self._pending = save_checkpoint(
+            self.es, path, asynchronous=self.asynchronous,
+            _async_ckptr=self._ckptr,
+        )
+        if self._pending is None:
+            self._gc()  # sync save: already durable
+        # async: GC is DEFERRED to wait() — collecting now could delete the
+        # last durable checkpoint while this one is still draining, leaving
+        # nothing restorable if the process dies mid-write
         return path
 
+    def wait(self) -> None:
+        """Block until the in-flight async save (if any) is durable, then
+        collect stale checkpoints.  Called automatically before each new
+        save; call it yourself before reading ``latest()`` or exiting."""
+        if self._pending is not None:
+            self._pending.wait()
+            self._pending = None
+            self._gc()
+
+    def close(self) -> None:
+        """Drain the in-flight save and release the async checkpointer."""
+        self.wait()
+        if self._ckptr is not None:
+            self._ckptr.close()
+            self._ckptr = None
+
     def latest(self) -> str | None:
+        """Newest checkpoint whose Orbax payload is FINALIZED — an async
+        save mid-drain (or a crash mid-write) leaves meta.json without a
+        state/ dir (Orbax writes to a tmp dir and renames on finalize);
+        such a directory must not shadow the older restorable one."""
         cks = sorted(d for d in os.listdir(self.root) if d.startswith("gen_"))
-        return os.path.join(self.root, cks[-1]) if cks else None
+        for d in reversed(cks):
+            if os.path.isdir(os.path.join(self.root, d, "state")):
+                return os.path.join(self.root, d)
+        return None
 
     def _gc(self) -> None:
         import shutil

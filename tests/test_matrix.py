@@ -221,3 +221,18 @@ def test_iwes_rejects_obs_norm():
     with pytest.raises(ValueError, match="obs_norm"):
         IW_ES(population_size=16, sigma=0.05, seed=0, table_size=1 << 14,
               obs_norm=True, **kw)
+
+
+def test_iwes_recurrent_composes():
+    """IW_ES's density-ratio reuse involves only params/noise/fitness —
+    forward-shape agnostic, so the recurrent standard forward composes."""
+    from estorch_tpu import IW_ES, RecurrentPolicy
+
+    kw = dict(BACKENDS["device"])
+    kw["policy"] = RecurrentPolicy
+    kw["policy_kwargs"] = {"action_dim": 2, "hidden": (8,), "gru_size": 8}
+    es = IW_ES(population_size=16, sigma=0.05, seed=0, table_size=1 << 14,
+               **kw)
+    es.train(2, verbose=False)
+    assert np.isfinite(es.history[-1]["reward_mean"])
+    assert "reused_prev" in es.history[-1]

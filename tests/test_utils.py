@@ -396,3 +396,93 @@ class TestCompilationCache:
             from estorch_tpu.utils.backend import _reset_live_cache
 
             _reset_live_cache()
+
+
+class TestAsyncCheckpoint:
+    def test_async_save_restores_bit_exact(self, tmp_path):
+        import optax
+
+        from estorch_tpu import ES, JaxAgent, MLPPolicy
+        from estorch_tpu.envs import CartPole
+        from estorch_tpu.utils import restore_checkpoint, save_checkpoint
+
+        def build():
+            return ES(
+                policy=MLPPolicy, agent=JaxAgent, optimizer=optax.adam,
+                population_size=16, sigma=0.1,
+                policy_kwargs={"action_dim": 2, "hidden": (8,),
+                               "discrete": True},
+                agent_kwargs={"env": CartPole(), "horizon": 32},
+                optimizer_kwargs={"learning_rate": 1e-2}, seed=3,
+            )
+
+        es = build()
+        es.train(2, verbose=False)
+        handle = save_checkpoint(es, tmp_path / "ck", asynchronous=True)
+        # training continues while the write drains in the background —
+        # the save must snapshot the state AT save time, not pick up these
+        # later updates
+        es.train(2, verbose=False)
+        handle.wait()
+        handle.wait()  # idempotent
+
+        es2 = build()
+        restore_checkpoint(es2, tmp_path / "ck")
+        assert es2.generation == 2
+        es_ref = build()
+        es_ref.train(2, verbose=False)
+        np.testing.assert_array_equal(
+            np.asarray(es2.state.params_flat),
+            np.asarray(es_ref.state.params_flat),
+        )
+
+    def test_periodic_async_resume_exact(self, tmp_path):
+        from estorch_tpu.utils import PeriodicCheckpointer, restore_checkpoint
+
+        es = _device_es()
+        ck = PeriodicCheckpointer(es, str(tmp_path / "cks"), every=2,
+                                  max_to_keep=2, asynchronous=True)
+        es.train(4, log_fn=ck.on_record)
+        ck.wait()
+        b = _device_es()
+        restore_checkpoint(b, ck.latest())
+        assert b.generation == 4
+        np.testing.assert_array_equal(
+            np.asarray(es.state.params_flat), np.asarray(b.state.params_flat)
+        )
+
+    def test_latest_skips_unfinalized_dir(self, tmp_path):
+        """A crash mid-async-drain leaves meta.json without a finalized
+        Orbax state/ — latest() must fall back to the older restorable
+        checkpoint instead of handing restore a partial one."""
+        from estorch_tpu.utils import PeriodicCheckpointer
+
+        es = _device_es()
+        es.train(2, verbose=False)
+        ck = PeriodicCheckpointer(es, str(tmp_path / "cks"), every=1)
+        good = ck.save(1)
+        # simulate the partial newer checkpoint
+        partial = os.path.join(str(tmp_path / "cks"), "gen_00000099")
+        os.makedirs(partial)
+        open(os.path.join(partial, "meta.json"), "w").write("{}")
+        assert ck.latest() == good
+
+    def test_async_gc_deferred_until_durable(self, tmp_path):
+        """With max_to_keep=1 the old checkpoint must survive until the
+        new async save has drained (GC runs in wait(), not at launch)."""
+        from estorch_tpu.utils import PeriodicCheckpointer
+
+        es = _device_es()
+        es.train(1, verbose=False)
+        ck = PeriodicCheckpointer(es, str(tmp_path / "cks"), every=1,
+                                  max_to_keep=1, asynchronous=True)
+        ck.save(0)
+        ck.wait()
+        first = ck.latest()
+        assert first is not None
+        ck.save(1)
+        # in-flight: the only durable checkpoint must still exist
+        assert os.path.isdir(os.path.join(first, "state"))
+        ck.close()
+        kept = sorted(os.listdir(tmp_path / "cks"))
+        assert kept == ["gen_00000001"]
