@@ -106,12 +106,51 @@ def check_optional_deps() -> dict:
     return out
 
 
+def check_host() -> dict:
+    """Host-side facts that decide what parallelism can actually help:
+    worker threads/processes cannot speed up a 1-core box (they time-slice
+    it), and the persistent compile cache is what makes fresh processes
+    cheap."""
+    import os
+
+    import jax
+
+    from .utils.backend import default_compilation_cache_dir
+
+    # report the LIVE cache dir when one is configured, else the default
+    # enable_compilation_cache() would use
+    cache_dir = (
+        jax.config.jax_compilation_cache_dir
+        or default_compilation_cache_dir()
+    )
+    cached = (
+        len(os.listdir(cache_dir)) if os.path.isdir(cache_dir) else 0
+    )
+    return {
+        "cpu_count": os.cpu_count(),
+        "note": (
+            "1 CPU: host worker threads/processes and virtual devices "
+            "time-slice one core — correctness yes, speedup no"
+            if (os.cpu_count() or 1) == 1 else
+            f"{os.cpu_count()} CPUs available for host workers / env pools"
+        ),
+        "compile_cache_dir": cache_dir,
+        "compile_cache_entries": cached,
+        "compile_cache_hint": (
+            "utils.enable_compilation_cache() makes every later process "
+            "load compiled programs from disk (<1s) instead of paying the "
+            "20-40s XLA compile"
+        ),
+    }
+
+
 def report(timeout_s: float = 45.0) -> dict:
     dev = probe_device(timeout_s)
     rep = {
         "device": dev,
         "native": check_native_pool(),
         "optional": check_optional_deps(),
+        "host": check_host(),
     }
     cpu_recipe = (
         "run on the virtual CPU mesh instead — jax.config.update("

@@ -9,6 +9,16 @@ in-process config update BEFORE first device use.
 from __future__ import annotations
 
 
+def default_compilation_cache_dir() -> str:
+    """The cache location :func:`enable_compilation_cache` uses when no
+    directory is given (shared with the doctor's report)."""
+    import os
+
+    return os.path.join(
+        os.path.expanduser("~"), ".cache", "estorch_tpu", "xla_cache"
+    )
+
+
 def enable_compilation_cache(
     cache_dir: str | None = None, min_compile_time_s: float = 1.0
 ) -> str:
@@ -34,9 +44,7 @@ def enable_compilation_cache(
     import jax
 
     if cache_dir is None:
-        cache_dir = os.path.join(
-            os.path.expanduser("~"), ".cache", "estorch_tpu", "xla_cache"
-        )
+        cache_dir = default_compilation_cache_dir()
     os.makedirs(cache_dir, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update(
