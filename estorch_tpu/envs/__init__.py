@@ -3,8 +3,8 @@ from .base import EnvSpec, JaxEnv
 from .cartpole import CartPole
 from .mountain_car import MountainCarContinuous
 from .mountain_car_discrete import MountainCar
-from .locomotion import (Cheetah2D, Hopper2D, Humanoid2D, Swimmer2D,
-                         Walker2D)
+from .locomotion import (Cheetah2D, Hopper2D, Humanoid2D, PositionOnly,
+                         Swimmer2D, Walker2D)
 from .pendulum import Pendulum
 from .rollout import RolloutResult, make_population_rollout, make_rollout, select_action
 from .synthetic import RecallEnv, SyntheticEnv
@@ -17,6 +17,7 @@ __all__ = [
     "Cheetah2D",
     "Hopper2D",
     "Humanoid2D",
+    "PositionOnly",
     "Swimmer2D",
     "Walker2D",
     "MountainCar",

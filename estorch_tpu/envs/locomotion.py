@@ -564,3 +564,74 @@ class Cheetah2D(_PlanarBase):
         self._finalize_chain(chain)
 
     ctrl_cost = 0.05
+
+
+@dataclasses.dataclass(frozen=True)
+class PositionOnly:
+    """POMDP wrapper for the planar runners: zero every velocity channel
+    of the observation (torso velocity, spin, joint rates), keeping the
+    positional half (height, lean, joint angles).
+
+    The classic partially observable locomotion setup: balance and gait
+    need rate feedback the policy can no longer see, so a memoryless
+    policy must infer it from nothing while a recurrent one can estimate
+    it from consecutive positions — the locomotion-grade counterpart of
+    the RecallEnv memory probe. Dynamics, reward, termination, and BC are
+    the wrapped env's, untouched; obs_dim is unchanged (channels are
+    zeroed, not dropped) so the same policy shapes fit both variants.
+    """
+
+    base: _PlanarBase
+
+    def __post_init__(self):
+        # the mask below hard-codes the STANDARD runner layout (_obs:
+        # height+lean, joint angles, then velocities); an env overriding
+        # _obs (Swimmer2D) would get the wrong channels zeroed silently
+        if type(self.base)._obs is not _PlanarBase._obs:
+            raise ValueError(
+                f"PositionOnly supports the standard runner observation "
+                f"layout; {type(self.base).__name__} overrides _obs — "
+                "build its POMDP mask explicitly"
+            )
+        import numpy as _np
+
+        n_joints = len(self.base.chain.parent)
+        n_pos = 2 + n_joints  # height+lean, joint angles
+        # NumPy, not jnp: envs are static Python data constructed BEFORE
+        # any backend choice (envs/base.py contract) — a jnp array here
+        # would initialize the default backend at env construction
+        mask = _np.zeros((self.base.obs_dim,), _np.float32)
+        mask[:n_pos] = 1.0
+        object.__setattr__(self, "_mask", mask)
+
+    # static facts forwarded for the engine/rollout machinery
+    @property
+    def obs_dim(self):
+        return self.base.obs_dim
+
+    @property
+    def action_dim(self):
+        return self.base.action_dim
+
+    @property
+    def discrete(self):
+        return self.base.discrete
+
+    @property
+    def bc_dim(self):
+        return self.base.bc_dim
+
+    @property
+    def default_horizon(self):
+        return self.base.default_horizon
+
+    def reset(self, key):
+        state, obs = self.base.reset(key)
+        return state, obs * self._mask
+
+    def step(self, state, action):
+        nstate, obs, reward, done = self.base.step(state, action)
+        return nstate, obs * self._mask, reward, done
+
+    def behavior(self, state, obs):
+        return self.base.behavior(state, obs)

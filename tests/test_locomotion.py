@@ -202,3 +202,57 @@ class TestLearnability:
         first = es.history[0]["reward_mean"]
         last = es.history[-1]["reward_mean"]
         assert last > first + 30.0, (first, last)
+
+
+class TestPositionOnly:
+    """POMDP wrapper: velocity channels zeroed, everything else untouched."""
+
+    def test_velocity_channels_zeroed_positions_kept(self):
+        import jax
+
+        from estorch_tpu.envs import PositionOnly, Walker2D
+
+        base = Walker2D()
+        env = PositionOnly(base)
+        assert env.obs_dim == base.obs_dim
+        key = jax.random.PRNGKey(0)
+        s0b, ob = base.reset(key)
+        s0w, ow = env.reset(key)
+        n_pos = 2 + len(base.chain.parent)
+        np.testing.assert_array_equal(np.asarray(ow[:n_pos]),
+                                      np.asarray(ob[:n_pos]))
+        assert (np.asarray(ow[n_pos:]) == 0).all()
+
+    def test_dynamics_and_reward_unchanged(self):
+        import jax
+        import jax.numpy as jnp
+
+        from estorch_tpu.envs import PositionOnly, Walker2D
+
+        base = Walker2D()
+        env = PositionOnly(base)
+        key = jax.random.PRNGKey(1)
+        sb, _ = base.reset(key)
+        sw, _ = env.reset(key)
+        a = jnp.full((base.action_dim,), 0.3)
+        for _ in range(3):
+            sb, ob, rb, db = base.step(sb, a)
+            sw, ow, rw, dw = env.step(sw, a)
+            assert float(rb) == float(rw)
+            assert bool(db) == bool(dw)
+        np.testing.assert_array_equal(np.asarray(env.behavior(sw, ow)),
+                                      np.asarray(base.behavior(sb, ob)))
+
+    def test_swimmer_layout_rejected(self):
+        from estorch_tpu.envs import PositionOnly, Swimmer2D
+
+        with pytest.raises(ValueError, match="_obs"):
+            PositionOnly(Swimmer2D())
+
+    def test_construction_does_not_touch_jax(self):
+        """Envs are static Python data built BEFORE any backend choice —
+        the mask must be NumPy, not a device array."""
+        from estorch_tpu.envs import PositionOnly, Walker2D
+
+        env = PositionOnly(Walker2D())
+        assert type(env._mask).__module__ == "numpy"
