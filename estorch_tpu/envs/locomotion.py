@@ -625,6 +625,10 @@ class PositionOnly:
     def default_horizon(self):
         return self.base.default_horizon
 
+    @property
+    def action_bound(self):
+        return self.base.action_bound
+
     def reset(self, key):
         state, obs = self.base.reset(key)
         return state, obs * self._mask

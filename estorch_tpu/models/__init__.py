@@ -3,11 +3,15 @@ from .vbn import VirtualBatchNorm, capture_reference_stats
 
 
 def __getattr__(name):
-    # torch import is deferred: device-path users never pay for it
+    # torch imports are deferred: device-path users never pay for them
     if name == "TorchVirtualBatchNorm":
         from .vbn_torch import TorchVirtualBatchNorm
 
         return TorchVirtualBatchNorm
+    if name == "TorchRunningObsNorm":
+        from .obsnorm_torch import TorchRunningObsNorm
+
+        return TorchRunningObsNorm
     raise AttributeError(name)
 
 
@@ -15,6 +19,7 @@ __all__ = [
     "MLPPolicy",
     "NatureCNN",
     "RecurrentNatureCNN",
+    "TorchRunningObsNorm",
     "RecurrentPolicy",
     "VirtualBatchNorm",
     "TorchVirtualBatchNorm",

@@ -1,0 +1,60 @@
+"""Running observation normalization for HOST-path (torch) policies.
+
+The device path normalizes observations inside the compiled generation
+program (``ES(..., obs_norm=True)``, parallel/engine.py).  Host-path
+users own their rollout loops (reference contract, SURVEY.md §3.3), so
+they normalize there — this module is the torch twin with the SAME math
+(Welford (count, mean, m2) running triple, Chan parallel merge, clipped
+(obs−mean)·rsqrt(var)), so a policy trained either way sees identically
+normalized inputs.
+
+Usage in a reference-style agent::
+
+    norm = TorchRunningObsNorm(obs_dim)
+    def rollout(self, policy):
+        obs = env.reset()
+        while not done:
+            action = policy(norm(torch.as_tensor(obs)))
+            ...
+        norm.update(torch.as_tensor(episode_obs))   # feed raw moments
+
+The stats are registered buffers: ``state_dict()`` round-trips them, so
+torch checkpoints resume with the stats intact.
+"""
+
+from __future__ import annotations
+
+import torch
+
+
+class TorchRunningObsNorm(torch.nn.Module):
+    def __init__(self, obs_dim: int, clip: float = 5.0):
+        super().__init__()
+        self.clip = float(clip)
+        # count=1, mean=0, m2=1 → var 1: identity-ish until fed, matching
+        # the device path's init (parallel/engine.py init_state)
+        self.register_buffer("count", torch.tensor(1.0))
+        self.register_buffer("mean", torch.zeros(obs_dim))
+        self.register_buffer("m2", torch.ones(obs_dim))
+
+    @torch.no_grad()
+    def update(self, obs_batch: torch.Tensor) -> None:
+        """Fold a (n, obs_dim) batch of RAW observations into the running
+        stats — the Chan parallel update, identical to the device path's
+        merge_obs_moments."""
+        obs_batch = obs_batch.reshape(-1, self.mean.shape[0]).float()
+        c1 = torch.tensor(float(obs_batch.shape[0]))
+        if float(c1) == 0.0:
+            return
+        mean1 = obs_batch.mean(dim=0)
+        m2_1 = ((obs_batch - mean1) ** 2).sum(dim=0)
+        tot = self.count + c1
+        delta = mean1 - self.mean
+        self.mean += delta * (c1 / tot)
+        self.m2 += m2_1 + delta * delta * (self.count * c1 / tot)
+        self.count.copy_(tot)
+
+    def forward(self, x: torch.Tensor) -> torch.Tensor:
+        var = torch.clamp(self.m2 / self.count, min=1e-8)
+        out = (x.float() - self.mean) * torch.rsqrt(var)
+        return torch.clamp(out, -self.clip, self.clip)

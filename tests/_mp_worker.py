@@ -23,6 +23,7 @@ jax.config.update("jax_num_cpu_devices", 4)
 def main() -> None:
     pid, nprocs, port = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
     outdir = pathlib.Path(sys.argv[4])
+    algo = sys.argv[5] if len(sys.argv) > 5 else "es"
 
     import estorch_tpu.parallel.multihost as mh
 
@@ -35,10 +36,10 @@ def main() -> None:
     import numpy as np
     import optax
 
-    from estorch_tpu import ES, JaxAgent, MLPPolicy
+    from estorch_tpu import ES, NSR_ES, JaxAgent, MLPPolicy
     from estorch_tpu.envs import CartPole
 
-    es = ES(
+    kw = dict(
         policy=MLPPolicy,
         agent=JaxAgent,
         optimizer=optax.adam,
@@ -50,17 +51,38 @@ def main() -> None:
         seed=7,
         mesh=mh.global_population_mesh(),
     )
+    if algo == "nsr":
+        # the novelty family keeps archive/meta-selection HOST-side on
+        # every process, derived from replicated device results + the
+        # seeded RNG — the claim under test is that all processes evolve
+        # identical host state with zero communication
+        es = NSR_ES(meta_population_size=2, k=3, **kw)
+    else:
+        es = ES(**kw)
     es.train(2, verbose=False)
 
     # leader_only must elect exactly one writer
     wrote = mh.leader_only(lambda: True)()
 
+    extra = {}
+    if algo == "nsr":
+        extra = {
+            "archive": np.asarray(es.archive.bcs, np.float64),
+            "meta_sums": np.asarray(
+                [np.asarray(s.params_flat, np.float64).sum()
+                 for s in es.meta_states]
+            ),
+            "meta_indices": np.asarray(
+                [r["meta_index"] for r in es.history], np.int64
+            ),
+        }
     np.savez(
         outdir / f"proc{pid}.npz",
         params=np.asarray(es.state.params_flat, np.float64),
         fitness=np.asarray(es.history[-1]["reward_mean"], np.float64),
         best=np.float64(es.best_reward),
         is_leader_writer=np.bool_(bool(wrote)),
+        **extra,
     )
     print(f"proc {pid}: OK", flush=True)
 

@@ -41,14 +41,13 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-@pytest.mark.slow
-def test_two_process_training_bit_synchronized(tmp_path):
+def _launch_two(tmp_path, algo="es"):
     port = _free_port()
     env = {"PYTHONPATH": str(REPO), "PATH": "/usr/bin:/bin"}
     procs = [
         subprocess.Popen(
             [sys.executable, str(WORKER), str(pid), "2", str(port),
-             str(tmp_path)],
+             str(tmp_path), algo],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
             env=env,
         )
@@ -65,6 +64,11 @@ def test_two_process_training_bit_synchronized(tmp_path):
         outs.append((p.returncode, out, err))
     for rc, out, err in outs:
         assert rc == 0, f"worker failed rc={rc}\nstdout:\n{out}\nstderr:\n{err[-3000:]}"
+
+
+@pytest.mark.slow
+def test_two_process_training_bit_synchronized(tmp_path):
+    _launch_two(tmp_path, algo="es")
 
     r0 = np.load(tmp_path / "proc0.npz")
     r1 = np.load(tmp_path / "proc1.npz")
@@ -99,3 +103,19 @@ def test_two_process_training_bit_synchronized(tmp_path):
     es.train(2, verbose=False)
     single = np.asarray(es.state.params_flat, np.float64)
     np.testing.assert_allclose(r0["params"], single, rtol=0, atol=5e-6)
+
+
+@pytest.mark.slow
+def test_two_process_novelty_family_host_state_synchronized(tmp_path):
+    """NSR-ES across two real processes: the archive, meta-centers, and
+    meta-selection sequence live HOST-side on every process, derived from
+    replicated device results plus the seeded RNG — they must come out
+    bit-identical with zero inter-process communication (the design claim
+    in parallel/multihost.py)."""
+    _launch_two(tmp_path, algo="nsr")
+    r0 = np.load(tmp_path / "proc0.npz")
+    r1 = np.load(tmp_path / "proc1.npz")
+    np.testing.assert_array_equal(r0["params"], r1["params"])
+    np.testing.assert_array_equal(r0["archive"], r1["archive"])
+    np.testing.assert_array_equal(r0["meta_sums"], r1["meta_sums"])
+    np.testing.assert_array_equal(r0["meta_indices"], r1["meta_indices"])

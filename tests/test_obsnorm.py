@@ -265,3 +265,48 @@ class TestCombosAndLearning:
         es = _pendulum_es(compute_dtype="bfloat16")
         es.train(2, verbose=False)
         assert np.isfinite(es.history[-1]["reward_mean"])
+
+
+class TestTorchHostTwin:
+    """TorchRunningObsNorm must match the device path's math exactly."""
+
+    def test_matches_device_normalize_and_merge(self):
+        import torch
+
+        from estorch_tpu.models import TorchRunningObsNorm
+        from estorch_tpu.parallel.engine import merge_obs_moments
+
+        rng = np.random.default_rng(3)
+        tn = TorchRunningObsNorm(5)
+        stats = (jnp.float32(1.0), jnp.zeros(5), jnp.ones(5))
+        for _ in range(4):
+            batch = rng.normal(3.0, 2.0, size=(100, 5)).astype(np.float32)
+            tn.update(torch.from_numpy(batch))
+            stats = merge_obs_moments(
+                stats,
+                jnp.float32(len(batch)),
+                jnp.asarray(batch.sum(0)),
+                jnp.asarray((batch * batch).sum(0)),
+            )
+        np.testing.assert_allclose(tn.count.numpy(), float(stats[0]))
+        np.testing.assert_allclose(tn.mean.numpy(), np.asarray(stats[1]),
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(tn.m2.numpy(), np.asarray(stats[2]),
+                                   rtol=1e-3, atol=1e-2)
+
+        obs = rng.normal(3.0, 2.0, size=(5,)).astype(np.float32)
+        got_t = tn(torch.from_numpy(obs)).numpy()
+        got_j = np.asarray(normalize_obs(jnp.asarray(obs), stats, 5.0))
+        np.testing.assert_allclose(got_t, got_j, rtol=1e-4, atol=1e-4)
+
+    def test_state_dict_roundtrip(self):
+        import torch
+
+        from estorch_tpu.models import TorchRunningObsNorm
+
+        a = TorchRunningObsNorm(3)
+        a.update(torch.randn(50, 3) * 4 + 1)
+        b = TorchRunningObsNorm(3)
+        b.load_state_dict(a.state_dict())
+        x = torch.randn(3)
+        np.testing.assert_array_equal(a(x).numpy(), b(x).numpy())
