@@ -38,6 +38,13 @@ def enable_compilation_cache(
     directory: JAX pins its cache object on first use and never re-reads
     the dir config, so a dir change must also reset the live cache (done
     here) or it would silently keep using the old path.
+
+    CPU caveat: XLA:CPU AOT entries record exact machine features; the
+    loader logs noisy E-level feature-mismatch warnings (observed even
+    same-machine for XLA-internal pseudo-features like
+    ``+prefer-no-scatter``) and a cache shared ACROSS heterogeneous CPUs
+    could in principle hit SIGILL — keep the cache directory per-machine.
+    TPU executables key on the chip generation and have no such edge.
     """
     import os
 
