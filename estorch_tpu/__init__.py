@@ -12,7 +12,7 @@ classes are re-exported here as they land:
     from estorch_tpu import ES, NS_ES, NSR_ES, NSRA_ES, VirtualBatchNorm
 """
 
-__version__ = "0.1.0"
+__version__ = "0.3.0"
 
 from . import envs, models, ops, parallel, utils  # noqa: F401
 from .algo import ES, IW_ES, NS_ES, NSR_ES, NSRA_ES, NoveltyArchive

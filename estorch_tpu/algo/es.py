@@ -670,7 +670,8 @@ class ES:
         return {"params": self.best_policy, **self._frozen}
 
     def evaluate_policy(self, n_episodes: int = 10, use_best: bool = False,
-                        seed: int = 0, meta_index: int | None = None):
+                        seed: int = 0, meta_index: int | None = None,
+                        return_details: bool = False):
         """Mean/std episode return of the current (or best) policy.
 
         The reference's users hand-roll this with ``agent.rollout(es.policy)``
@@ -680,6 +681,11 @@ class ES:
         controls the device path only).  ``meta_index`` selects a specific
         meta-population center (novelty family; default = center 0, the one
         ``es.policy`` exposes).
+
+        ``return_details=True`` adds per-episode arrays: ``rewards``
+        (n_episodes,) and — device path only — ``bc`` (n_episodes, bc_dim),
+        the behavior characterizations (e.g. final torso position for the
+        locomotion family), for studies that measure more than the return.
         """
         if meta_index is not None:
             if not hasattr(self, "meta_states"):
@@ -731,6 +737,7 @@ class ES:
                 p = (p, base_state.obs_stats)
             res = fn(p, keys)
             rewards = np.asarray(res.total_reward)
+            bc = np.asarray(res.bc)
         else:
             # both engines' evaluate_center reads only state.params_flat, so
             # a params-swapped state evaluates the requested policy
@@ -747,13 +754,18 @@ class ES:
                 ],
                 np.float32,
             )
-        return {
+            bc = None
+        out = {
             "mean": float(rewards.mean()),
             "std": float(rewards.std()),
             "min": float(rewards.min()),
             "max": float(rewards.max()),
             "episodes": int(n_episodes),
         }
+        if return_details:
+            out["rewards"] = rewards
+            out["bc"] = bc
+        return out
 
     def predict(self, obs, use_best: bool = False, carry=None):
         """Policy forward pass with current (or best) parameters.

@@ -40,22 +40,12 @@ def run(recurrent: bool, seed: int, gens: int, pop: int):
     )
     es.train(gens, verbose=False)
     # displacement of the center policy: mean final BC x over held-out
-    # episodes (the BC is the torso's final (x, y))
-    import jax
-
-    from estorch_tpu.envs.rollout import make_rollout
-
-    single = make_rollout(
-        es.env, es._policy_apply, 200,
-        carry_init=es.module.carry_init if recurrent else None,
-    )
-    keys = jax.random.split(jax.random.PRNGKey(99), 16)
-    res = jax.vmap(single, in_axes=(None, 0))(es.policy, keys)
-    disp = float(np.asarray(res.bc)[:, 0].mean())
+    # episodes (the locomotion BC is the torso's final (x, y))
+    ev = es.evaluate_policy(n_episodes=16, seed=99, return_details=True)
     return {
         "final_mean": es.history[-1]["reward_mean"],
         "best": es.best_reward,
-        "center_disp_x": disp,
+        "center_disp_x": float(ev["bc"][:, 0].mean()),
     }
 
 

@@ -130,3 +130,27 @@ class TestVBN:
         names = ["/".join(str(p) for p in path) for path, _ in flat_names]
         assert not any("mean" in n or "var" in n for n in names)
         assert any("vbn_0" in n for n in names)  # affine present
+
+
+def test_evaluate_policy_return_details():
+    """return_details adds per-episode rewards and (device path) BCs —
+    the public surface locomotion studies use for displacement metrics."""
+    import optax
+
+    from estorch_tpu import ES, JaxAgent, MLPPolicy
+    from estorch_tpu.envs import CartPole
+
+    es = ES(
+        policy=MLPPolicy, agent=JaxAgent, optimizer=optax.adam,
+        population_size=16, sigma=0.1,
+        policy_kwargs={"action_dim": 2, "hidden": (8,), "discrete": True},
+        agent_kwargs={"env": CartPole(), "horizon": 32},
+        optimizer_kwargs={"learning_rate": 1e-2}, seed=0,
+    )
+    es.train(1, verbose=False)
+    ev = es.evaluate_policy(n_episodes=4, return_details=True)
+    assert ev["rewards"].shape == (4,)
+    assert ev["bc"].shape == (4, 2)
+    assert ev["mean"] == pytest.approx(float(ev["rewards"].mean()))
+    # default stays detail-free
+    assert "rewards" not in es.evaluate_policy(n_episodes=2)
