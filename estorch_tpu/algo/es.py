@@ -173,9 +173,9 @@ class ES:
                 )
             if obs_norm:
                 raise ValueError(
-                    "obs_norm is a device-path option (running stats ride "
-                    "the compiled generation program); host agents own "
-                    "their rollouts and can normalize there"
+                    "obs_norm is a device/pooled-path option (running stats "
+                    "ride the training state); host agents own their "
+                    "rollouts — use models.TorchRunningObsNorm there"
                 )
             self.backend = "host"
             self._init_host(

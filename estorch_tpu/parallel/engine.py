@@ -126,9 +126,39 @@ def normalize_obs(obs: jax.Array, obs_stats, clip: float) -> jax.Array:
     return jnp.clip(x, -clip, clip)
 
 
+def merge_obs_moments_np(obs_stats, cnt1: float, osum1, osumsq1):
+    """Host-side float64 Chan merge for POOLED-scale raw sums.
+
+    The in-program f32 merge below is safe only for a few episodes' worth
+    of samples; the pooled path accumulates population×horizon steps per
+    generation, where ``sumsq − sum·mean`` cancels catastrophically in
+    f32 (e.g. c≈1e6 at mean≈100: the f32 ulp of sumsq exceeds the true
+    m2).  Merge in f64, hand back an f32 jnp triple for the state."""
+    import numpy as np
+
+    c0 = float(np.asarray(obs_stats[0]))
+    m0 = np.asarray(obs_stats[1], np.float64)
+    M0 = np.asarray(obs_stats[2], np.float64)
+    c1 = float(cnt1)
+    s1 = np.asarray(osum1, np.float64)
+    q1 = np.asarray(osumsq1, np.float64)
+    mean1 = s1 / c1
+    m2_1 = np.maximum(q1 - s1 * mean1, 0.0)
+    tot = c0 + c1
+    delta = mean1 - m0
+    mean = m0 + delta * (c1 / tot)
+    m2 = M0 + m2_1 + delta * delta * (c0 * c1 / tot)
+    return (
+        jnp.float32(tot),
+        jnp.asarray(mean, jnp.float32),
+        jnp.asarray(m2, jnp.float32),
+    )
+
+
 def merge_obs_moments(obs_stats, cnt1, osum1, osumsq1):
     """Chan parallel update: fold one generation's raw probe sums (small —
-    a few episodes' worth, safe in f32) into the running Welford triple."""
+    a few episodes' worth, safe in f32) into the running Welford triple.
+    For pooled-scale sums use :func:`merge_obs_moments_np`."""
     c0, mean0, m2_0 = obs_stats
     mean1 = osum1 / cnt1
     m2_1 = jnp.maximum(osumsq1 - osum1 * mean1, 0.0)

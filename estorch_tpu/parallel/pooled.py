@@ -79,12 +79,33 @@ class PooledEngine:
                 "low_rank is a device-path option (ops/lowrank.py); the "
                 "pooled path materializes per-member thetas"
             )
+        # obs_norm on the pooled path: normalization + raw-moment
+        # accumulation happen HOST-side in the step loop below (the obs
+        # batches are already on the host); the running Welford stats ride
+        # ESState.obs_stats exactly like the device path — checkpointed,
+        # split==fused — while the CORE update programs stay stats-agnostic
+        # (they carry obs_stats through untouched), so the core config has
+        # the flag stripped.  Richer than the device path's center-probe:
+        # the stats see every member's observations.
+        self.obs_norm = bool(config.obs_norm)
+        self._obs_clip = float(config.obs_clip)
+        self._pending_moments = None
+        if self.obs_norm and self.prep:
+            raise ValueError(
+                "obs_norm + Atari preprocessing is unsupported: pixel "
+                "policies normalize via VBN / their own /255 scaling"
+            )
+        import dataclasses as _dc
+
+        core_config = (
+            _dc.replace(config, obs_norm=False) if self.obs_norm else config
+        )
         # update-only device engine: shares offsets/psum/optax with the
         # fully-on-device path; its ctor also applies the compute_dtype wrap
         # (incl. the stateful bf16 shim + carry cast for recurrent policies),
         # which we reuse below instead of wrapping a second time
         self.core = ESEngine(None, policy_apply, spec, table, optimizer,
-                             config, mesh, carry_init=carry_init)
+                             core_config, mesh, carry_init=carry_init)
         policy_apply = self.core.policy_apply
         carry_init = self.core._carry_init  # bf16 path: pre-cast variant
         self.recurrent = carry_init is not None
@@ -194,7 +215,30 @@ class PooledEngine:
     # ------------------------------------------------------------ interface
 
     def init_state(self, params_flat, key) -> ESState:
-        return self.core.init_state(params_flat, key)
+        state = self.core.init_state(params_flat, key)
+        if self.obs_norm:
+            # same init as the device path: count=1, mean=0, m2=1 → var 1
+            d = self.pool.obs_dim
+            state = state._replace(obs_stats=(
+                jnp.float32(1.0),
+                jnp.zeros((d,), jnp.float32),
+                jnp.ones((d,), jnp.float32),
+            ))
+        return state
+
+    # ---- obs_norm host-side helpers ----
+
+    def _norm_params(self, state):
+        """(mean, rstd) numpy pair from the state's Welford triple."""
+        c, m, m2 = state.obs_stats
+        c = float(c)
+        mean = np.asarray(m, np.float32)
+        var = np.maximum(np.asarray(m2, np.float32) / c, 1e-8)
+        return mean, (1.0 / np.sqrt(var)).astype(np.float32)
+
+    def _norm_np(self, obs, mean, rstd):
+        return np.clip((obs - mean) * rstd, -self._obs_clip,
+                       self._obs_clip).astype(np.float32)
 
     def compile(self, state: ESState) -> float:
         import time as _time
@@ -228,11 +272,28 @@ class PooledEngine:
     def evaluate(self, state: ESState) -> PooledEvalResult:
         pair_offs = self.core.all_pair_offsets(state)
         thetas = self._materialize(state.params_flat, state.sigma, pair_offs)
+        norm = self._norm_params(state) if self.obs_norm else None
+        if self.obs_norm:
+            # raw-moment accumulators for this generation's alive steps —
+            # merged into the state by apply_weights/generation_step
+            self._pending_moments = [
+                0.0,
+                np.zeros(self.pool.obs_dim, np.float64),
+                np.zeros(self.pool.obs_dim, np.float64),
+            ]
         if self.double_buffer:
-            return self._evaluate_double_buffered(thetas)
-        return self._evaluate_sync(thetas)
+            return self._evaluate_double_buffered(thetas, norm)
+        return self._evaluate_sync(thetas, norm)
 
-    def _evaluate_sync(self, thetas) -> PooledEvalResult:
+    def _accumulate_moments(self, obs, alive) -> None:
+        raw = obs[alive]
+        if len(raw):
+            m = self._pending_moments
+            m[0] += float(len(raw))
+            m[1] += raw.sum(axis=0, dtype=np.float64)
+            m[2] += (raw.astype(np.float64) ** 2).sum(axis=0)
+
+    def _evaluate_sync(self, thetas, norm=None) -> PooledEvalResult:
         n = self.config.population_size
         horizon = self.config.horizon
 
@@ -243,13 +304,16 @@ class PooledEngine:
         steps = 0
         carry = self._carries(n) if self.recurrent else None
         for _ in range(horizon):
+            if norm is not None:
+                self._accumulate_moments(obs, alive)
+                feed = jnp.asarray(self._norm_np(obs, *norm))
+            else:
+                feed = jnp.asarray(obs)
             if self.recurrent:
-                acts_dev, carry = self._batch_actions(
-                    thetas, jnp.asarray(obs), carry
-                )
+                acts_dev, carry = self._batch_actions(thetas, feed, carry)
                 actions = np.asarray(acts_dev)
             else:
-                actions = np.asarray(self._batch_actions(thetas, jnp.asarray(obs)))
+                actions = np.asarray(self._batch_actions(thetas, feed))
             next_obs, rew, done = self.pool.step(actions)
             total += rew * alive
             steps += int(alive.sum())
@@ -264,7 +328,7 @@ class PooledEngine:
         final_obs[alive] = obs[alive]  # survivors: last frame
         return PooledEvalResult(fitness=total, bc=final_obs.copy(), steps=steps)
 
-    def _evaluate_double_buffered(self, thetas) -> PooledEvalResult:
+    def _evaluate_double_buffered(self, thetas, norm=None) -> PooledEvalResult:
         """Overlap device inference with native env stepping (SURVEY.md §7
         hard-part 1).
 
@@ -287,15 +351,21 @@ class PooledEngine:
         steps = 0
 
         def dispatch(half):
+            # NO moment accumulation here: the trailing dispatch after the
+            # last stepped iteration computes actions that are never
+            # stepped — accumulating at dispatch time would over-count vs
+            # the sync path (moments are taken at STEP time below)
+            if norm is not None:
+                feed = jnp.asarray(self._norm_np(half["obs"], *norm))
+            else:
+                feed = jnp.asarray(half["obs"])
             if self.recurrent:
                 acts, half["carry"] = self._batch_actions(
-                    half["thetas"], jnp.asarray(half["obs"]), half["carry"]
+                    half["thetas"], feed, half["carry"]
                 )
                 half["fut"] = acts
             else:
-                half["fut"] = self._batch_actions(
-                    half["thetas"], jnp.asarray(half["obs"])
-                )
+                half["fut"] = self._batch_actions(half["thetas"], feed)
 
         for half in halves:
             half["obs"] = half["pool"].reset()
@@ -313,6 +383,11 @@ class PooledEngine:
                 # while this half's envs step in C++ threads
                 actions = np.asarray(half["fut"])
                 sl = slice(half["lo"], half["lo"] + h)
+                if norm is not None:
+                    # accumulate exactly the observations that get STEPPED
+                    # (pre-step alive mask) — count == env_steps invariant,
+                    # identical to the sync path
+                    self._accumulate_moments(half["obs"], alive[sl])
                 next_obs, rew, done = half["pool"].step(actions)
                 total[sl] += rew * alive[sl]
                 steps += int(alive[sl].sum())
@@ -334,14 +409,17 @@ class PooledEngine:
         obs = self.center_pool.reset()[0]
         total, steps = 0.0, 0
         h = self._carry_init() if self.recurrent else None
+        norm = self._norm_params(state) if self.obs_norm else None
         for _ in range(self.config.horizon):
+            feed = (
+                jnp.asarray(self._norm_np(obs[None], *norm)[0])
+                if norm is not None else jnp.asarray(obs)
+            )
             if self.recurrent:
-                a_dev, h = self._center_action(
-                    state.params_flat, jnp.asarray(obs), h
-                )
+                a_dev, h = self._center_action(state.params_flat, feed, h)
                 a = np.asarray(a_dev)
             else:
-                a = np.asarray(self._center_action(state.params_flat, jnp.asarray(obs)))
+                a = np.asarray(self._center_action(state.params_flat, feed))
             nobs, rew, done = self.center_pool.step(a[None])
             total += float(rew[0])
             steps += 1
@@ -358,7 +436,23 @@ class PooledEngine:
         )
 
     def apply_weights(self, state: ESState, weights):
-        return self.core.apply_weights(state, jnp.asarray(weights))
+        new_state, gnorm = self.core.apply_weights(state, jnp.asarray(weights))
+        if self.obs_norm and self._pending_moments is not None:
+            # fold the generation's observed raw moments (accumulated by
+            # evaluate) into the running Welford triple — the f64 host
+            # merge: population×horizon samples per generation would
+            # cancel catastrophically in the f32 in-program merge
+            from .engine import merge_obs_moments_np
+
+            c1, s1, q1 = self._pending_moments
+            self._pending_moments = None
+            if c1 > 0:
+                new_state = new_state._replace(
+                    obs_stats=merge_obs_moments_np(
+                        new_state.obs_stats, c1, s1, q1
+                    )
+                )
+        return new_state, gnorm
 
     def generation_step(self, state: ESState):
         ev = self.evaluate(state)

@@ -175,7 +175,7 @@ class TestCheckpointRoundtrip:
 
 class TestGuards:
     def test_host_rejected(self):
-        with pytest.raises(ValueError, match="device-path option"):
+        with pytest.raises(ValueError, match="TorchRunningObsNorm"):
             ES(
                 policy=lambda: None, agent=_DummyHostAgent,
                 optimizer=optax.adam, population_size=8, sigma=0.1,
@@ -212,16 +212,17 @@ class TestGuards:
         with pytest.raises(ValueError, match="obs_norm"):
             restore_checkpoint(es_off, tmp_path / "ck")
 
-    def test_pooled_rejected(self):
+    def test_pooled_prep_rejected(self):
         from estorch_tpu import PooledAgent
 
-        with pytest.raises(ValueError, match="device-path"):
+        with pytest.raises(ValueError, match="preprocessing"):
             ES(
                 policy=MLPPolicy, agent=PooledAgent, optimizer=optax.adam,
                 population_size=16, sigma=0.1,
-                policy_kwargs={"action_dim": 2, "hidden": (8,),
+                policy_kwargs={"action_dim": 3, "hidden": (8,),
                                "discrete": True},
-                agent_kwargs={"env_name": "cartpole", "horizon": 32},
+                agent_kwargs={"env_name": "pong84", "horizon": 32,
+                              "frame_stack": 4},
                 optimizer_kwargs={"learning_rate": 1e-2},
                 obs_norm=True,
             )
@@ -310,3 +311,91 @@ class TestTorchHostTwin:
         b.load_state_dict(a.state_dict())
         x = torch.randn(3)
         np.testing.assert_array_equal(a(x).numpy(), b(x).numpy())
+
+
+class TestPooledObsNorm:
+    """Pooled-path obs_norm: normalization + moment accumulation happen
+    host-side in the step loop; the Welford stats ride ESState.obs_stats
+    exactly like the device path (checkpointed, split==fused), fed by
+    EVERY member's observations rather than a center probe."""
+
+    def _pooled_es(self, **over):
+        from estorch_tpu import PooledAgent
+
+        kw = dict(
+            policy=MLPPolicy, agent=PooledAgent, optimizer=optax.adam,
+            population_size=16, sigma=0.1,
+            policy_kwargs={"action_dim": 2, "hidden": (8,),
+                           "discrete": True},
+            agent_kwargs={"env_name": "cartpole", "horizon": 32},
+            optimizer_kwargs={"learning_rate": 1e-2}, seed=0,
+            obs_norm=True,
+        )
+        kw.update(over)
+        return ES(**kw)
+
+    def test_trains_and_stats_grow(self):
+        es = self._pooled_es()
+        es.train(2, verbose=False)
+        cnt, mean, m2 = es.state.obs_stats
+        # every alive member-step fed the stats: count = 1 + total steps
+        total_steps = sum(r["env_steps"] for r in es.history)
+        assert float(cnt) == 1.0 + total_steps
+        assert np.isfinite(np.asarray(mean)).all()
+        assert (np.asarray(m2) > 0).all()
+        assert np.isfinite(es.history[-1]["reward_mean"])
+        ev = es.evaluate_policy(n_episodes=2)
+        assert np.isfinite(ev["mean"])
+
+    def test_split_equals_fused_pooled(self):
+        """Two same-seeded instances (fresh pools → identical episode
+        sequences): the fused generation_step must equal the explicit
+        evaluate→rank→apply split, INCLUDING the merged obs stats.  (A
+        single instance cannot be compared against itself — the pool RNG
+        advances with every evaluation.)"""
+        es_a = self._pooled_es()
+        fused, _ = es_a.engine.generation_step(es_a.state)
+
+        es_b = self._pooled_es()
+        ev = es_b.engine.evaluate(es_b.state)
+        from estorch_tpu.utils import rank_weights_with_failures
+
+        w = rank_weights_with_failures(np.asarray(ev.fitness))
+        split, _ = es_b.engine.apply_weights(es_b.state, w)
+        np.testing.assert_allclose(
+            np.asarray(split.params_flat), np.asarray(fused.params_flat),
+            rtol=1e-5, atol=1e-7,
+        )
+        for a, b in zip(split.obs_stats, fused.obs_stats):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_checkpoint_roundtrip(self, tmp_path):
+        from estorch_tpu.utils import restore_checkpoint, save_checkpoint
+
+        es = self._pooled_es()
+        es.train(2, verbose=False)
+        save_checkpoint(es, tmp_path / "ck")
+        es2 = self._pooled_es()
+        restore_checkpoint(es2, tmp_path / "ck")
+        for a, b in zip(es.state.obs_stats, es2.state.obs_stats):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_double_buffer_runs(self):
+        es = self._pooled_es(
+            agent_kwargs={"env_name": "cartpole", "horizon": 32,
+                          "double_buffer": True},
+        )
+        es.train(1, verbose=False)
+        assert float(es.state.obs_stats[0]) > 1.0
+
+    def test_double_buffer_count_invariant(self):
+        """Double-buffered stats must obey count == 1 + env_steps exactly
+        like the sync path (moments accumulate at STEP time, not at
+        dispatch — the trailing dispatch's actions are never stepped)."""
+        es = self._pooled_es(
+            agent_kwargs={"env_name": "cartpole", "horizon": 32,
+                          "double_buffer": True},
+        )
+        es.train(2, verbose=False)
+        total_steps = sum(r["env_steps"] for r in es.history)
+        assert float(es.state.obs_stats[0]) == 1.0 + total_steps
