@@ -248,7 +248,9 @@ def halfcheetah_pooled(**over):
     """BASELINE config 2, pooled edition: HalfCheetah physics in gym.vector
     workers while the population's policy forwards run device-batched —
     the no-MJX path to MuJoCo at scale (vs halfcheetah_vbn's per-member
-    host rollouts)."""
+    host rollouts).  Pass ``obs_norm=True`` for the OpenAI-ES MuJoCo
+    setup (running observation normalization; default off for reference
+    parity — estorch has no such machinery)."""
     import optax
 
     from . import ES, MLPPolicy, PooledAgent
