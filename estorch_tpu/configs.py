@@ -192,17 +192,22 @@ def halfcheetah_vbn(**over):
 
 def humanoid2d_pop10k(**over):
     """Config-3 scale on the DEVICE path: Humanoid2D at population 10240
-    with rank-1 perturbations and a Humanoid-sized policy (256×256).
+    with rank-1 perturbations, running obs normalization, and a
+    Humanoid-sized policy (256×256).
 
-    The engine-mode choice is evidence-driven (bench_ab_cpu.jsonl): at
-    pop-10240 × 166k-params, `low_rank=1` measured 9.5× the full-rank
-    throughput with 3× less memory — the member noise state drops from
-    O(dim) to O(Σ(m+n)r).  eval_chunk bounds materialized member weights
-    the same way the bench's pop-10k point does."""
+    The engine-mode choices are evidence-driven (bench_ab_cpu.jsonl,
+    BENCHMARKS.md): at pop-10240 × 166k-params, `low_rank=1` measured 9.5×
+    the full-rank throughput with 3× less memory — the member noise state
+    drops from O(dim) to O(Σ(m+n)r) — and `obs_norm` measured +30-43%
+    held-out eval on real MuJoCo (3/3 HalfCheetah seeds).  The two compose
+    as of round 4 (normalization is an input-side transform, independent
+    of the noise representation).  eval_chunk bounds materialized member
+    weights the same way the bench's pop-10k point does."""
     from .envs import Humanoid2D
 
     return _planar_device(Humanoid2D(), 10240, (256, 256), 400, 2e-2,
-                          {"low_rank": 1, "eval_chunk": 1024, **over})
+                          {"low_rank": 1, "obs_norm": True,
+                           "eval_chunk": 1024, **over})
 
 
 def humanoid_mirrored(**over):

@@ -91,8 +91,15 @@ class EngineConfig:
     # is (obs - mean)·rsqrt(var) clipped to ±obs_clip, with the running
     # raw-obs moments carried in ESState.obs_stats and refreshed each
     # generation from obs_probe_episodes center-policy episodes — fully
-    # in-program, replicated on every device. Standard + recurrent
-    # forwards; mutually exclusive with decomposed/streamed/low_rank.
+    # in-program, replicated on every device. Composes with every noise
+    # representation (standard/recurrent/decomposed/streamed/low_rank):
+    # normalization is an input-side transform, applied to raw obs in f32
+    # before any forward. NOTE the stats-refresh data source differs by
+    # backend: the device path feeds obs_stats from center-policy probe
+    # episodes only, while the pooled path folds in every member's
+    # (perturbed-policy) observations — both are self-consistent and
+    # checkpoint-compatible, but a run migrated across paths resumes with
+    # differently-converged normalization statistics.
     obs_clip: float = 5.0  # normalized-obs clip range
     obs_probe_episodes: int = 1  # center episodes per generation feeding
     # the running stats (more → faster stat convergence, more probe FLOPs)
@@ -136,6 +143,15 @@ def merge_obs_moments_np(obs_stats, cnt1: float, osum1, osumsq1):
     m2).  Merge in f64, hand back an f32 jnp triple for the state."""
     import numpy as np
 
+    # Precision bound: the merge itself is f64-exact, but the count is
+    # handed back as f32 for the ESState schema, so past 2^24 (~16.7M)
+    # samples the STORED count rounds (ulp 2 at 2^25, …).  mean/m2 keep
+    # full f64 accuracy — only the count's least bits are lost, a ≤2^-24
+    # relative error in the next merge's weights.  At pooled scale
+    # (pop 256 × horizon 1000 → 2^24 in ~65 generations) the documented
+    # "count == 1 + env_steps" invariant therefore holds exactly only
+    # below 2^24 total samples; beyond it the stats keep converging
+    # correctly but the count is a rounded f32.
     c0 = float(np.asarray(obs_stats[0]))
     m0 = np.asarray(obs_stats[1], np.float64)
     M0 = np.asarray(obs_stats[2], np.float64)
@@ -158,7 +174,15 @@ def merge_obs_moments_np(obs_stats, cnt1: float, osum1, osumsq1):
 def merge_obs_moments(obs_stats, cnt1, osum1, osumsq1):
     """Chan parallel update: fold one generation's raw probe sums (small —
     a few episodes' worth, safe in f32) into the running Welford triple.
-    For pooled-scale sums use :func:`merge_obs_moments_np`."""
+    For pooled-scale sums use :func:`merge_obs_moments_np`.
+
+    Saturation bound of the all-f32 device-path merge: the running count
+    stops incrementing once cnt1 < ulp(count)/2, i.e. count ≳ cnt1·2^24 —
+    at the device path's few-episode probes (cnt1 ≈ 100-1000) that is
+    ~10^9-10^10 samples, far past any recorded run; the update weight
+    already decays as cnt1/count long before, so the frozen tail is
+    benign.  The pooled path never hits this (its merge is
+    :func:`merge_obs_moments_np`, f64 on the host)."""
     c0, mean0, m2_0 = obs_stats
     mean1 = osum1 / cnt1
     m2_1 = jnp.maximum(osumsq1 - osum1 * mean1, 0.0)
@@ -286,11 +310,6 @@ class ESEngine:
                 "mutually exclusive with decomposed/streamed/low_rank"
             )
         if config.obs_norm:
-            if config.decomposed or config.streamed or config.low_rank:
-                raise ValueError(
-                    "obs_norm runs the standard forward; it is mutually "
-                    "exclusive with decomposed/streamed/low_rank"
-                )
             if env is None:
                 raise ValueError(
                     "obs_norm needs device-native rollouts to carry the "
@@ -442,6 +461,16 @@ class ESEngine:
             if self._bf16:
                 lr_packed_apply = _bf16_io_apply(lr_packed_apply)
 
+            if config.obs_norm:
+                # normalization wraps OUTSIDE the bf16 shim: raw obs are
+                # normalized in f32 against the generation's stats snapshot,
+                # then cast — the same order as the standard path above
+                base_lr_apply = lr_packed_apply
+
+                def lr_packed_apply(packed, obs):
+                    inner, stats = packed
+                    return base_lr_apply(inner, normalize_obs(obs, stats, clip))
+
             self._rollout_lowrank = make_rollout(env, lr_packed_apply, config.horizon)
 
         self._rollout_decomposed = None
@@ -454,6 +483,13 @@ class ESEngine:
                 # packed (shared, noise, c) params — INCLUDING the scale c —
                 # arrive pre-cast from _eval_local; only obs/output shim here
                 packed_apply = _bf16_io_apply(packed_apply)
+
+            if config.obs_norm:
+                base_dec_apply = packed_apply
+
+                def packed_apply(packed, obs):
+                    inner, stats = packed
+                    return base_dec_apply(inner, normalize_obs(obs, stats, clip))
 
             self._rollout_decomposed = make_rollout(
                 env, packed_apply, config.horizon
@@ -593,6 +629,8 @@ class ESEngine:
                         self._member_cast(lrn),
                         self._member_cast(state.sigma * sign),
                     )
+                    if self._obs_norm:
+                        params = (params, state.obs_stats)
                     return self._member_rollout(rollout, params, key)
                 eps = self.table.slice(off, dim)
                 if cfg.decomposed:
@@ -602,6 +640,8 @@ class ESEngine:
                         self._member_cast(self.spec.unravel(eps)),
                         self._member_cast(state.sigma * sign),
                     )
+                    if self._obs_norm:
+                        params = (params, state.obs_stats)
                 else:
                     rollout = self._rollout
                     theta = state.params_flat + state.sigma * sign * eps
@@ -665,6 +705,12 @@ class ESEngine:
             c = state.sigma * signs_c
 
             def batched_apply(obs_batch):
+                if self._obs_norm:
+                    # stats broadcast over the population batch dim; streamed
+                    # is f32-only so no dtype shim is needed
+                    obs_batch = normalize_obs(
+                        obs_batch, state.obs_stats, float(self.config.obs_clip)
+                    )
                 return self._streamed_apply(shared_tree, offs_c, c, obs_batch)
 
             res = self._rollout_batched(batched_apply, keys_c)

@@ -90,6 +90,7 @@ class PooledEngine:
         self.obs_norm = bool(config.obs_norm)
         self._obs_clip = float(config.obs_clip)
         self._pending_moments = None
+        self._pending_moments_gen = -1
         if self.obs_norm and self.prep:
             raise ValueError(
                 "obs_norm + Atari preprocessing is unsupported: pixel "
@@ -275,12 +276,17 @@ class PooledEngine:
         norm = self._norm_params(state) if self.obs_norm else None
         if self.obs_norm:
             # raw-moment accumulators for this generation's alive steps —
-            # merged into the state by apply_weights/generation_step
+            # merged into the state by apply_weights/generation_step.
+            # Stamped with the evaluated state's generation so a discarded
+            # evaluation (eval-only probe, exception between the calls)
+            # can never fold its observations into a LATER, unrelated
+            # update's running stats — apply_weights drops on mismatch.
             self._pending_moments = [
                 0.0,
                 np.zeros(self.pool.obs_dim, np.float64),
                 np.zeros(self.pool.obs_dim, np.float64),
             ]
+            self._pending_moments_gen = int(state.generation)
         if self.double_buffer:
             return self._evaluate_double_buffered(thetas, norm)
         return self._evaluate_sync(thetas, norm)
@@ -437,7 +443,11 @@ class PooledEngine:
 
     def apply_weights(self, state: ESState, weights):
         new_state, gnorm = self.core.apply_weights(state, jnp.asarray(weights))
-        if self.obs_norm and self._pending_moments is not None:
+        if (
+            self.obs_norm
+            and self._pending_moments is not None
+            and self._pending_moments_gen == int(state.generation)
+        ):
             # fold the generation's observed raw moments (accumulated by
             # evaluate) into the running Welford triple — the f64 host
             # merge: population×horizon samples per generation would
@@ -452,6 +462,9 @@ class PooledEngine:
                         new_state.obs_stats, c1, s1, q1
                     )
                 )
+        else:
+            # stale moments from a discarded evaluation: drop, never merge
+            self._pending_moments = None
         return new_state, gnorm
 
     def generation_step(self, state: ESState):

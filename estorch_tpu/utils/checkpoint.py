@@ -222,8 +222,23 @@ def restore_checkpoint(es, path: str) -> None:
             "training state)"
         )
 
+    # An async save writes meta.json immediately while the Orbax array
+    # drain runs in the background (Orbax writes to a tmp dir and renames
+    # on finalize) — so a path can pass every meta/schema check above and
+    # still have no restorable payload.  Catch it here with a clear error
+    # instead of a deep Orbax FileNotFoundError.
+    state_dir = os.path.join(path, "state")
+    if not os.path.isdir(state_dir):
+        raise ValueError(
+            f"checkpoint at {path!r} has no finalized state/ payload — "
+            "an async save is still draining (call handle.wait() / "
+            "PeriodicCheckpointer.wait() first) or the write crashed "
+            "mid-save; use PeriodicCheckpointer.latest() to find the "
+            "newest restorable checkpoint"
+        )
+
     ckptr = ocp.StandardCheckpointer()
-    tree = ckptr.restore(os.path.join(path, "state"), _state_tree(es))
+    tree = ckptr.restore(state_dir, _state_tree(es))
 
     es.generation = int(tree["generation"])
     br = float(tree["best_reward"])

