@@ -675,15 +675,16 @@ class ES:
         """Mean/std episode return of the current (or best) policy.
 
         The reference's users hand-roll this with ``agent.rollout(es.policy)``
-        loops; here it is one vmapped compiled program on the device path and
-        the engines' own center-evaluation on host/pooled paths (where
-        episode randomness comes from the env/pool RNG streams — ``seed``
-        controls the device path only).  ``meta_index`` selects a specific
-        meta-population center (novelty family; default = center 0, the one
-        ``es.policy`` exposes).
+        loops; here it is one vmapped compiled program on the device path,
+        one batched pooled pass on the pooled path (all episodes step
+        concurrently in native threads — ``seed`` picks the episode set on
+        both), and the engine's own serial center-evaluation on the host
+        path (episode randomness from the env RNG; host agents own their
+        rollouts).  ``meta_index`` selects a specific meta-population center
+        (novelty family; default = center 0, the one ``es.policy`` exposes).
 
         ``return_details=True`` adds per-episode arrays: ``rewards``
-        (n_episodes,) and — device path only — ``bc`` (n_episodes, bc_dim),
+        (n_episodes,) and — device/pooled paths — ``bc`` (n_episodes, bc_dim),
         the behavior characterizations (e.g. final torso position for the
         locomotion family), for studies that measure more than the return.
         """
@@ -738,14 +739,21 @@ class ES:
             res = fn(p, keys)
             rewards = np.asarray(res.total_reward)
             bc = np.asarray(res.bc)
+        elif self.backend == "pooled":
+            # engines read only state.params_flat (+ obs_stats), so a
+            # params-swapped state evaluates the requested policy
+            flat = self._best_flat if use_best else base_state.params_flat
+            eval_state = base_state._replace(params_flat=jnp.asarray(flat))
+            res = self.engine.evaluate_center_batch(
+                eval_state, n_episodes, seed=seed
+            )
+            rewards = np.asarray(res.fitness, np.float32)
+            bc = np.asarray(res.bc)
         else:
-            # both engines' evaluate_center reads only state.params_flat, so
-            # a params-swapped state evaluates the requested policy
+            # host path: torch agents own their rollouts — serial by design
             flat = self._best_flat if use_best else base_state.params_flat
             eval_state = base_state._replace(
                 params_flat=np.asarray(flat, np.float32)
-                if self.backend == "host"
-                else jnp.asarray(flat)
             )
             rewards = np.asarray(
                 [
