@@ -387,7 +387,8 @@ class ES:
         from ..envs.gym_vec_pool import pool_env_spec
         from ..parallel.pooled import PooledEngine
 
-        spec_info = pool_env_spec(self.agent.env_name)
+        env_kwargs = getattr(self.agent, "env_kwargs", None)
+        spec_info = pool_env_spec(self.agent.env_name, env_kwargs)
         prep = getattr(self.agent, "prep", None)
         if prep:
             from ..envs.atari_wrappers import apply_prep_to_spec
@@ -412,6 +413,8 @@ class ES:
             double_buffer=getattr(self.agent, "double_buffer", False),
             prep=prep,
             carry_init=self.module.carry_init if self._recurrent else None,
+            env_kwargs=env_kwargs,
+            bc_indices=getattr(self.agent, "bc_indices", None),
         )
         self.state = self.engine.init_state(flat, state_key)
 
@@ -420,7 +423,8 @@ class ES:
         reshaped to the policy-facing observation shape (pixels etc.)."""
         from ..envs.gym_vec_pool import make_pool
 
-        pool = make_pool(self.agent.env_name, max(1, n // 4))
+        pool = make_pool(self.agent.env_name, max(1, n // 4),
+                         env_kwargs=getattr(self.agent, "env_kwargs", None))
         prep = getattr(self.agent, "prep", None)
         if prep:
             # VBN statistics must be collected in the policy's actual input

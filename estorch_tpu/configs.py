@@ -275,6 +275,39 @@ def halfcheetah_pooled(**over):
     return ES(**kw)
 
 
+def halfcheetah_nsres(**over):
+    """BASELINE config 4, pooled edition on REAL MuJoCo: NSR-ES on
+    HalfCheetah with BC = final x-position (Conti et al.'s locomotion
+    characterization).  ``env_kwargs`` puts the x-position into the
+    observation (gymnasium excludes it by default) and ``bc_indices=(0,)``
+    selects it as the archive's 1-dim BC — the novelty family then
+    searches over where the gait ENDS, not what it looks like."""
+    import optax
+
+    from . import NSR_ES, MLPPolicy, PooledAgent
+
+    kw = dict(
+        policy=MLPPolicy,
+        agent=PooledAgent,
+        optimizer=optax.adam,
+        population_size=256,
+        sigma=0.02,
+        k=10,
+        meta_population_size=3,
+        policy_kwargs={"action_dim": 6, "hidden": (64, 64), "discrete": False},
+        agent_kwargs={
+            "env_name": "gym:HalfCheetah-v5",
+            "horizon": 1000,
+            "env_kwargs": {"exclude_current_positions_from_observation": False},
+            "bc_indices": (0,),
+        },
+        optimizer_kwargs={"learning_rate": 1e-2},
+        weight_decay=0.005,
+    )
+    kw.update(over)
+    return NSR_ES(**kw)
+
+
 def pong84_conv(**over):
     """Conv-rollout stress without ALE: NatureCNN on the bundled C++ pixel
     pong (84×84), pooled execution with the full Atari preprocessing stack
@@ -340,6 +373,7 @@ CONFIGS: dict[str, Callable] = {
     "humanoid_mirrored": humanoid_mirrored,
     "humanoid_nsres": humanoid_nsres,
     "halfcheetah_pooled": halfcheetah_pooled,
+    "halfcheetah_nsres": halfcheetah_nsres,
     "pong84_conv": pong84_conv,
     "atari_frostbite": atari_frostbite,
 }

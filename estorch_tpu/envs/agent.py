@@ -31,6 +31,12 @@ class PooledAgent:
     n_threads: int = 0
     double_buffer: bool = False  # overlap device forwards with env stepping
     # (two half-population pools; see parallel/pooled.py)
+    env_kwargs: dict | None = None  # forwarded to gym.make for gym: envs
+    # (e.g. exclude_current_positions_from_observation=False)
+    bc_indices: tuple | None = None  # behavior characterization = these
+    # final-observation dims instead of the full final obs (e.g. (0,) for
+    # the final x-position — the Conti et al. locomotion BC the novelty
+    # family searches over)
     # ALE-standard preprocessing (envs/atari_wrappers.py); defaults are
     # pass-through so non-Atari pooled configs are untouched
     frame_stack: int = 1

@@ -33,10 +33,12 @@ class GymVecPool:
     """N gymnasium envs behind the pool interface (auto-reset semantics)."""
 
     def __init__(self, env_id: str, n_envs: int, n_threads: int = 0, seed: int = 0,
-                 asynchronous: bool | None = None):
+                 asynchronous: bool | None = None,
+                 env_kwargs: dict | None = None):
         import gymnasium as gym
 
         self.env_name = f"gym:{env_id}"
+        self.env_kwargs = dict(env_kwargs or {})
         self.n_envs = int(n_envs)
         if n_threads:
             # interface parity with NativeEnvPool only — gym.vector has no
@@ -61,7 +63,10 @@ class GymVecPool:
             )
             asynchronous = cores > 1 and 1 < self.n_envs <= 2 * cores
         ctor = gym.vector.AsyncVectorEnv if asynchronous else gym.vector.SyncVectorEnv
-        self._vec = ctor([self._make_one(env_id) for _ in range(self.n_envs)])
+        self._vec = ctor(
+            [self._make_one(env_id, self.env_kwargs)
+             for _ in range(self.n_envs)]
+        )
         self._seed = int(seed)
         self._seeded = False
 
@@ -80,11 +85,11 @@ class GymVecPool:
         self._act_shape = tuple(getattr(act_space, "shape", ()) or ())
 
     @staticmethod
-    def _make_one(env_id: str):
+    def _make_one(env_id: str, env_kwargs: dict):
         def thunk():
             import gymnasium as gym
 
-            return gym.make(env_id)
+            return gym.make(env_id, **env_kwargs)
 
         return thunk
 
@@ -130,21 +135,37 @@ class GymVecPool:
             pass
 
 
-def make_pool(env_name: str, n_envs: int, n_threads: int = 0, seed: int = 0):
-    """Pool factory: ``gym:<EnvId>`` → GymVecPool, else the C++ NativeEnvPool."""
+def make_pool(env_name: str, n_envs: int, n_threads: int = 0, seed: int = 0,
+              env_kwargs: dict | None = None):
+    """Pool factory: ``gym:<EnvId>`` → GymVecPool, else the C++ NativeEnvPool.
+
+    ``env_kwargs`` forward to ``gym.make`` (e.g. HalfCheetah's
+    ``exclude_current_positions_from_observation=False``, which puts the
+    x-position in the observation — the canonical locomotion BC); the
+    in-tree native envs take no kwargs."""
     if env_name.startswith("gym:"):
-        return GymVecPool(env_name[4:], n_envs, n_threads=n_threads, seed=seed)
+        return GymVecPool(env_name[4:], n_envs, n_threads=n_threads, seed=seed,
+                          env_kwargs=env_kwargs)
+    if env_kwargs:
+        raise ValueError(
+            f"env_kwargs only apply to gym: envs; {env_name!r} is an "
+            "in-tree native env with a fixed construction"
+        )
     from .native_pool import NativeEnvPool
 
     return NativeEnvPool(env_name, n_envs, n_threads=n_threads, seed=seed)
 
 
-def pool_env_spec(env_name: str) -> dict:
-    """env_spec covering both pool families (probe-free for native envs)."""
+def pool_env_spec(env_name: str, env_kwargs: dict | None = None) -> dict:
+    """env_spec covering both pool families (probe-free for native envs).
+
+    Rejects env_kwargs for native envs HERE, not just in make_pool: the
+    spec probe runs first in ES._init_pooled, and a silently-ignored
+    kwarg would otherwise surface only after policy shapes were built."""
     if env_name.startswith("gym:"):
         import gymnasium as gym
 
-        env = gym.make(env_name[4:])
+        env = gym.make(env_name[4:], **(env_kwargs or {}))
         obs_shape = tuple(env.observation_space.shape)
         act = env.action_space
         spec = {
@@ -156,6 +177,11 @@ def pool_env_spec(env_name: str) -> dict:
         }
         env.close()
         return spec
+    if env_kwargs:
+        raise ValueError(
+            f"env_kwargs only apply to gym: envs; {env_name!r} is an "
+            "in-tree native env with a fixed construction"
+        )
     from .native_pool import env_spec
 
     return env_spec(env_name)

@@ -54,8 +54,11 @@ class PooledEngine:
         double_buffer: bool = False,
         prep: dict | None = None,
         carry_init=None,
+        env_kwargs: dict | None = None,
+        bc_indices=None,
     ):
         self.env_name = env_name
+        self.env_kwargs = dict(env_kwargs) if env_kwargs else None
         self.prep = dict(prep) if prep else None
         self.spec = spec
         self.config = config
@@ -113,7 +116,8 @@ class PooledEngine:
         self._carry_init = carry_init
         self.double_buffer = bool(double_buffer)
         def _pool(n_envs, threads, pool_seed):
-            pool = make_pool(env_name, n_envs, n_threads=threads, seed=pool_seed)
+            pool = make_pool(env_name, n_envs, n_threads=threads,
+                             seed=pool_seed, env_kwargs=self.env_kwargs)
             if self.prep:
                 from ..envs.atari_wrappers import AtariPreprocessPool
 
@@ -136,7 +140,31 @@ class PooledEngine:
         # n_threads=0 (auto): a 1-env pool gains nothing from threads, and a
         # nonzero value would trip GymVecPool's unused-n_threads warning
         self.center_pool = _pool(1, 0, seed + 1)
-        self.bc_dim = self.pool.obs_dim  # BC = final observation
+        # BC = final observation, optionally sliced to bc_indices (e.g.
+        # (0,) = final x-position when the env exposes it — the canonical
+        # locomotion BC the novelty family's archive searches over)
+        self._bc_idx = (
+            np.asarray(bc_indices, np.intp) if bc_indices is not None else None
+        )
+        if self._bc_idx is not None:
+            if len(self.pool.obs_shape) != 1:
+                # the BC frame is the FLAT final obs; on pixel/prep pools
+                # the last axis is channels, not the flat vector — slicing
+                # there would silently break the archive's (n, bc_dim)
+                # contract
+                raise ValueError(
+                    "bc_indices need a 1-D observation; got obs_shape "
+                    f"{self.pool.obs_shape} — pixel policies characterize "
+                    "behavior via the full final frame"
+                )
+            if self._bc_idx.min() < 0 or self._bc_idx.max() >= self.pool.obs_dim:
+                raise ValueError(
+                    f"bc_indices {list(self._bc_idx)} out of range for "
+                    f"obs_dim {self.pool.obs_dim}"
+                )
+        self.bc_dim = (
+            len(self._bc_idx) if self._bc_idx is not None else self.pool.obs_dim
+        )
         discrete = self.pool.discrete
         obs_shape = self.pool.obs_shape  # policy-facing shape (pixels etc.)
 
@@ -345,7 +373,15 @@ class PooledEngine:
             if not alive.any():
                 break
         final_obs[alive] = obs[alive]  # survivors: last frame
-        return PooledEvalResult(fitness=total, bc=final_obs.copy(), steps=steps)
+        return PooledEvalResult(
+            fitness=total, bc=self._bc(final_obs.copy()), steps=steps
+        )
+
+    def _bc(self, final_obs):
+        """BC frame → characterization: identity, or the bc_indices dims."""
+        return (
+            final_obs if self._bc_idx is None else final_obs[..., self._bc_idx]
+        )
 
     def _evaluate_double_buffered(self, thetas, norm=None) -> PooledEvalResult:
         """Overlap device inference with native env stepping (SURVEY.md §7
@@ -420,7 +456,9 @@ class PooledEngine:
         for half in halves:
             sl = slice(half["lo"], half["lo"] + h)
             final_obs[sl][alive[sl]] = half["obs"][alive[sl]]
-        return PooledEvalResult(fitness=total, bc=final_obs, steps=steps)
+        return PooledEvalResult(
+            fitness=total, bc=self._bc(final_obs), steps=steps
+        )
 
     def evaluate_center_batch(
         self, state: ESState, n_episodes: int, seed: int = 0
@@ -473,7 +511,7 @@ class PooledEngine:
             obs = nobs[0]
         return RolloutResult(
             total_reward=jnp.float32(total),
-            bc=jnp.asarray(obs, jnp.float32),
+            bc=jnp.asarray(self._bc(np.asarray(obs)), jnp.float32),
             steps=jnp.int32(steps),
         )
 

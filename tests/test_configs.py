@@ -20,6 +20,7 @@ class TestConfigs:
             "humanoid_mirrored",
             "humanoid_nsres",
             "halfcheetah_pooled",
+            "halfcheetah_nsres",
             "pong84_conv",
             "atari_frostbite",
         }
@@ -58,6 +59,35 @@ class TestConfigs:
             for m in policy.modules():
                 if type(m).__name__ == "TorchVirtualBatchNorm":
                     assert bool(m.initialized)
+
+    def test_halfcheetah_nsres_runs_pooled_with_x_bc(self):
+        """Config 4 on real MuJoCo: NSR-ES pooled, BC = final x-position."""
+        from estorch_tpu.configs import halfcheetah_nsres
+
+        from estorch_tpu.parallel.mesh import single_device_mesh
+
+        es = halfcheetah_nsres(
+            population_size=8,
+            meta_population_size=2,
+            k=3,
+            mesh=single_device_mesh(),
+            agent_kwargs={
+                "env_name": "gym:HalfCheetah-v5",
+                "horizon": 20,
+                "env_kwargs": {
+                    "exclude_current_positions_from_observation": False
+                },
+                "bc_indices": (0,),
+            },
+        )
+        es.train(1, verbose=False)
+        assert es.backend == "pooled"
+        assert es.engine.bc_dim == 1
+        # archive holds 1-dim BCs: meta seeds + this generation's center
+        assert es.archive.bcs.shape[1] == 1
+        assert np.isfinite(es.history[0]["reward_mean"])
+        es.engine.pool.close()
+        es.engine.center_pool.close()
 
     def test_atari_gated_with_clear_error(self):
         with pytest.raises(ImportError, match="ale_py"):

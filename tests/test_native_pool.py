@@ -326,6 +326,48 @@ class TestGymVecPool:
             table_size=1 << 16,
         )
 
+    def test_env_kwargs_reach_gym_make(self):
+        """env_kwargs forward to gym.make — HalfCheetah with x-position in
+        the observation (the BC the novelty locomotion family needs) grows
+        obs_dim 17 → 18, consistently in spec probe AND pool."""
+        from estorch_tpu.envs.gym_vec_pool import make_pool, pool_env_spec
+
+        kw = {"exclude_current_positions_from_observation": False}
+        spec = pool_env_spec("gym:HalfCheetah-v5", kw)
+        assert spec["obs_dim"] == 18
+        pool = make_pool("gym:HalfCheetah-v5", 2, seed=0, env_kwargs=kw)
+        assert pool.obs_dim == 18
+        pool.close()
+
+    def test_env_kwargs_rejected_for_native(self):
+        from estorch_tpu.envs.gym_vec_pool import make_pool
+
+        with pytest.raises(ValueError, match="native"):
+            make_pool("cartpole", 2, env_kwargs={"x": 1})
+
+    def test_bc_indices_slice_the_final_obs(self):
+        """bc_indices=(0,) → 1-dim BC everywhere the pooled path reports
+        one: member evaluation, center evaluation, batched held-out eval."""
+        es = ES(
+            policy=MLPPolicy, agent=PooledAgent, optimizer=optax.adam,
+            population_size=8, sigma=0.1, seed=0,
+            policy_kwargs={"action_dim": 2, "hidden": (8,)},
+            agent_kwargs={"env_name": "cartpole", "horizon": 30,
+                          "bc_indices": (0,)},
+            optimizer_kwargs={"learning_rate": 1e-2},
+            table_size=1 << 14,
+            mesh=single_device_mesh(),
+        )
+        assert es.engine.bc_dim == 1
+        ev = es.engine.evaluate(es.state)
+        assert np.asarray(ev.bc).shape == (8, 1)
+        c = es.engine.evaluate_center(es.state)
+        assert np.asarray(c.bc).shape == (1,)
+        det = es.evaluate_policy(n_episodes=3, return_details=True)
+        assert det["bc"].shape == (3, 1)
+        es.engine.pool.close()
+        es.engine.center_pool.close()
+
 
 class TestPong84ConvPath:
     """The Atari-config machinery (conv policy + pooled pixel env) end to
