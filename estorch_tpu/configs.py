@@ -155,10 +155,19 @@ def humanoid2d_device(**over):
     """Device-native locomotion, planar humanoid (11 bodies, 10 joints):
     the hardest in-tree task — balance a jointed column on two legs with
     free-swinging arm counterweights — and the device-native stand-in for
-    the MuJoCo-Humanoid configs (BASELINE config 3 stays on host/pooled)."""
+    the MuJoCo-Humanoid configs (BASELINE config 3 stays on host/pooled).
+
+    obs_norm defaults ON (round-4 A/B, BENCHMARKS.md: Humanoid2D's obs
+    variance spans 165×, and normalization won 2/2 seeds on final mean
+    and AUC — passing round 3's 600-generation plateau by gen 80); pass
+    obs_norm=False for the raw-observation variant — including to
+    RESTORE checkpoints saved before round 4 (the running stats are
+    training state, so restore_checkpoint rejects an obs_norm
+    mismatch)."""
     from .envs import Humanoid2D
 
-    return _planar_device(Humanoid2D(), 1024, (64, 64), 400, 2e-2, over)
+    return _planar_device(Humanoid2D(), 1024, (64, 64), 400, 2e-2,
+                          {"obs_norm": True, **over})
 
 
 def cheetah2d_device(**over):
