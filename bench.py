@@ -111,6 +111,10 @@ POP10K = {"env": "synthetic", "hidden": [256, 256], "population": 10240,
 # weights: whole-shard at 10240x166k floats would gamble with 16 GB HBM
 LOCO = {"env": "cheetah2d", "hidden": [64, 64], "population": 1024,
         "horizon": 200}  # physics-on-chip point (cheetah2d_device recipe)
+LOCO10K = {"env": "humanoid2d", "hidden": [256, 256], "population": 10240,
+           "horizon": 100, "eval_chunk": 1024}  # config-3 scale with
+# physics: the humanoid2d_pop10k recipe's shape at horizon 100 (a bench
+# row, not a training run — scan length and alive-step fraction differ)
 
 
 def _env_and_policy(cfg):
@@ -179,6 +183,7 @@ def measure_one(cfg, force_cpu=False):
         noise_kernel=cfg.get("noise_kernel", False),
         streamed=cfg.get("streamed", False),
         low_rank=cfg.get("low_rank", 0),
+        obs_norm=cfg.get("obs_norm", False),
     )
     gens = cfg.get("gens", 5)
     es.train(1, verbose=False)  # warm-up generation (compile + AOT sanity)
@@ -302,13 +307,14 @@ AB_MATRIX = [
      {"dtype": "bfloat16", "low_rank": 1, "gens": 3}),
     ("loco/standard/bf16", LOCO, {"dtype": "bfloat16", "gens": 3}),
     ("loco/standard/f32", LOCO, {"dtype": "float32", "gens": 3}),
-    # config-3 scale with physics: the humanoid2d_pop10k recipe's shape at
-    # horizon 100 (not the recipe's 400 — a bench row, not a training run;
-    # scan length and alive-step fraction differ accordingly)
-    ("loco10k/lowrank1/bf16",
-     {"env": "humanoid2d", "hidden": [256, 256], "population": 10240,
-      "horizon": 100, "eval_chunk": 1024},
+    ("loco10k/lowrank1/bf16", LOCO10K,
      {"dtype": "bfloat16", "low_rank": 1, "gens": 3}),
+    # the north-star composition (round 4): running obs normalization ON
+    # TOP of the rank-1 noise representation — measures what the per-step
+    # normalize + per-generation center probe cost at config-3 scale.
+    # Shares LOCO10K with the row above so the pair can never diverge.
+    ("loco10k/lowrank1+obsnorm/bf16", LOCO10K,
+     {"dtype": "bfloat16", "low_rank": 1, "obs_norm": True, "gens": 3}),
 ]
 
 
