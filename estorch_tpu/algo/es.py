@@ -112,6 +112,7 @@ class ES:
         obs_norm: bool = False,
         obs_clip: float = 5.0,
         obs_probe_episodes: int = 1,
+        obs_warmup_episodes: int = 0,
     ):
         self.population_size = population_size
         self.sigma = sigma
@@ -132,6 +133,12 @@ class ES:
         self._obs_norm = bool(obs_norm)
         self._obs_clip = float(obs_clip)
         self._obs_probe_episodes = int(obs_probe_episodes)
+        self._obs_warmup_episodes = int(obs_warmup_episodes)
+        if self._obs_warmup_episodes and not self._obs_norm:
+            raise ValueError(
+                "obs_warmup_episodes warm-starts the running obs stats; "
+                "it requires obs_norm=True"
+            )
 
         self._policy_arg = policy
         self._policy_kwargs = dict(policy_kwargs or {})
@@ -193,6 +200,13 @@ class ES:
             self.backend = "device"
         elif hasattr(self.agent, "env_name"):
             # pooled path: C++ envpool stepping + device-batched inference
+            if self._obs_warmup_episodes:
+                raise ValueError(
+                    "obs_warmup_episodes is a device-path option; the "
+                    "pooled path's stats are fed by every member's "
+                    "observations from generation 0, so its init "
+                    "transient is one generation long already"
+                )
             self.backend = "pooled"
             self._init_pooled(
                 policy, dict(policy_kwargs or {}), optimizer,
@@ -357,6 +371,7 @@ class ES:
             obs_norm=self._obs_norm,
             obs_clip=self._obs_clip,
             obs_probe_episodes=self._obs_probe_episodes,
+            obs_warmup_episodes=self._obs_warmup_episodes,
         )
         return flat, state_key
 

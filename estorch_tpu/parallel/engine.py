@@ -103,6 +103,13 @@ class EngineConfig:
     obs_clip: float = 5.0  # normalized-obs clip range
     obs_probe_episodes: int = 1  # center episodes per generation feeding
     # the running stats (more → faster stat convergence, more probe FLOPs)
+    obs_warmup_episodes: int = 0  # >0: run this many init-policy probe
+    # episodes at init_state so generation 0 already normalizes with real
+    # moments instead of the identity init (round-4 A/B: the identity
+    # init costs early-generation AUC while the stats converge; warmup
+    # removes that transient). Device path only — the pooled path's
+    # stats are fed by every member's observations from generation 0
+    # onward, so its transient is one generation long already.
 
 
 class ESState(NamedTuple):
@@ -810,18 +817,28 @@ class ESEngine:
         )
         return new_state, jnp.linalg.norm(grad_ascent)
 
-    def _probe_obs_moments(self, state: ESState):
-        """Summed (count, obs_sum, obs_sumsq) over obs_probe_episodes
-        center-policy episodes, keyed disjointly from member/center streams."""
-        _, rkey = _gen_keys(state)
-        base = jax.random.fold_in(rkey, 2**31 - 2)
-        keys = jax.vmap(lambda i: jax.random.fold_in(base, i))(
-            jnp.arange(self.config.obs_probe_episodes)
+    def _probe_moments_sum(self, base_key, n_episodes, params_flat, obs_stats):
+        """Summed (count, obs_sum, obs_sumsq) over ``n_episodes`` probe
+        episodes of the policy at ``params_flat`` — the ONE probe-fanout
+        recipe, shared by the per-generation refresh and the init
+        warm-start so their keying/batching can never diverge."""
+        keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(
+            jnp.arange(n_episodes)
         )
-        params = self._member_cast(self.spec.unravel(state.params_flat))
-        packed = (params, state.obs_stats)
+        params = self._member_cast(self.spec.unravel(params_flat))
+        packed = (params, obs_stats)
         c, s, q = jax.vmap(self._obs_probe, in_axes=(None, 0))(packed, keys)
         return c.sum(), s.sum(axis=0), q.sum(axis=0)
+
+    def _probe_obs_moments(self, state: ESState):
+        """Per-generation refresh moments, keyed disjointly from
+        member/center streams."""
+        _, rkey = _gen_keys(state)
+        base = jax.random.fold_in(rkey, 2**31 - 2)
+        return self._probe_moments_sum(
+            base, self.config.obs_probe_episodes,
+            state.params_flat, state.obs_stats,
+        )
 
     # ---- shard_map bodies ----
 
@@ -872,6 +889,24 @@ class ESEngine:
                 jnp.zeros((obs_dim,), jnp.float32),
                 jnp.ones((obs_dim,), jnp.float32),
             )
+            warm = self.config.obs_warmup_episodes
+            if warm > 0:
+                # warm-start: init-policy probe episodes folded in BEFORE
+                # generation 0, keyed disjointly from every training
+                # stream (member/center/per-gen-probe use fold_in of the
+                # per-generation base; this folds the RAW state key).
+                # init_state runs host-side, so the f64 merge is free —
+                # and warmup is exactly the many-episodes-at-once case
+                # the in-program f32 merge is documented unsafe for.
+                import numpy as np
+
+                base = jax.random.fold_in(key, 2**31 - 3)
+                c, s, q = self._probe_moments_sum(
+                    base, warm, params_flat, obs_stats
+                )
+                obs_stats = merge_obs_moments_np(
+                    obs_stats, float(c), np.asarray(s), np.asarray(q)
+                )
         return ESState(
             params_flat=params_flat,
             opt_state=self.optimizer.init(params_flat),

@@ -130,6 +130,41 @@ class TestStatsAccounting:
         es.train(1, verbose=False)
         assert es.state.obs_stats is None
 
+    def test_warmup_folds_init_probes_exactly(self):
+        """obs_warmup_episodes=3 on Pendulum (h=100, never terminates):
+        init count = 1 + 3·100, real (non-identity) moments before
+        generation 0, then the per-gen probes keep the count exact."""
+        es = _pendulum_es(obs_warmup_episodes=3)
+        cnt, mean, m2 = es.state.obs_stats
+        assert float(cnt) == 1.0 + 3 * 100
+        assert float(np.abs(np.asarray(mean)).max()) > 0.0
+        es.train(2, verbose=False)
+        assert float(es.state.obs_stats[0]) == 1.0 + 3 * 100 + 2 * 100
+
+    def test_warmup_is_deterministic(self):
+        a = _pendulum_es(obs_warmup_episodes=2)
+        b = _pendulum_es(obs_warmup_episodes=2)
+        for x, y in zip(a.state.obs_stats, b.state.obs_stats):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+    def test_warmup_requires_obs_norm(self):
+        with pytest.raises(ValueError, match="obs_norm"):
+            _pendulum_es(obs_norm=False, obs_warmup_episodes=2)
+
+    def test_warmup_rejected_on_pooled(self):
+        from estorch_tpu import PooledAgent
+
+        with pytest.raises(ValueError, match="device-path"):
+            ES(
+                policy=MLPPolicy, agent=PooledAgent, optimizer=optax.adam,
+                population_size=16, sigma=0.1,
+                policy_kwargs={"action_dim": 2, "hidden": (8,),
+                               "discrete": True},
+                agent_kwargs={"env_name": "cartpole", "horizon": 32},
+                optimizer_kwargs={"learning_rate": 1e-2},
+                obs_norm=True, obs_warmup_episodes=2,
+            )
+
 
 class TestSplitEqualsFused:
     def test_split_path_matches_generation_step(self):
