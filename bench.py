@@ -16,7 +16,10 @@ step counts carry the same honesty property; its MFU counts policy-forward
 FLOPs only, not the physics).  "mfu" is always policy-forward FLOPs against the v5e bf16 peak
 (197 TFLOP/s) regardless of config dtype — one fixed denominator keeps
 cross-dtype A/B numbers comparable — and is null off-TPU (a CPU rate
-against a TPU peak means nothing).
+against a TPU peak means nothing).  When the TPU path fails and the
+headline falls back to CPU, the extras instead carry the same scaling
+points measured on the CPU mesh, each tagged ``cpu_relative: true`` —
+comparable to each other and to bench_ab_cpu.jsonl, never to TPU numbers.
 
 vs_baseline: ratio against a reference-style estorch loop measured live on
 this host — per-member Python loop, torch CPU MLP forward per step,
@@ -386,6 +389,29 @@ def main():
                  "mfu": round(r["mfu"], 6) if r["mfu"] is not None else None,
                  "dtype": r["dtype"],
                  "peak_hbm_gb": r.get("peak_hbm_gb")}
+                if r else None
+            )
+    else:
+        # Wedged-round artifact (round-4 verdict weak #1 / next #4): the one
+        # JSON everyone reads must still show the architecture's scaling,
+        # not just the smallest matmul.  Measure the big-policy / pop-10k /
+        # locomotion / config-3-scale points on the CPU mesh, clearly
+        # labeled cpu_relative (comparable to each other and to
+        # bench_ab_cpu.jsonl, NOT to any TPU number).  Modes follow the CPU
+        # A/B winners (low_rank=1 dominates the big/pop-10k shapes
+        # off-chip); gens=2 keeps the wedged-round bench bounded.
+        for name, cfg in (
+            ("big_policy", {**BIG, "low_rank": 1, "gens": 2}),
+            ("pop10k", {**POP10K, "low_rank": 1, "gens": 2}),
+            ("locomotion", {**LOCO, "gens": 2}),
+            ("loco10k", {**LOCO10K, "low_rank": 1, "gens": 2}),
+        ):
+            r = run_stage(cfg, timeout_s=1200, force_cpu=True)
+            extras[name] = (
+                {"rate": round(r["rate"], 1), "cpu_relative": True,
+                 "dtype": r["dtype"],
+                 "mode": "low_rank=1" if cfg.get("low_rank") else "standard",
+                 "peak_rss_gb": r.get("peak_rss_gb")}
                 if r else None
             )
 

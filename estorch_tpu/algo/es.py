@@ -270,18 +270,31 @@ class ES:
         lr_apply, lr_spec = None, None
         if self._low_rank:
             from ..models.decomposed import mlp_lowrank_apply, supports_decomposed
-            from ..ops.lowrank import make_lowrank_spec
+            from ..ops.lowrank import make_lowrank_spec, make_lowrank_tree_spec
 
-            if not supports_decomposed(self.module):
-                raise ValueError(
-                    "low_rank currently supports MLPPolicy without VBN "
-                    f"(ops/lowrank.py); got {type(self.module).__name__}"
+            if self._recurrent:
+                # recurrent form (round-4 verdict next #7): the generic
+                # tree spec — factored noise for every 2-D kernel (trunk,
+                # cell gates, head), per-episode materialization in the
+                # engine, standard carry-threaded rollout.  No per-step
+                # factored apply needed.
+                lr_spec = make_lowrank_tree_spec(
+                    self._spec.unravel(flat), self._low_rank
                 )
-            lr_spec = make_lowrank_spec(self._spec.unravel(flat), self._low_rank)
-            module = self.module
+            elif not supports_decomposed(self.module):
+                raise ValueError(
+                    "low_rank supports MLPPolicy without VBN "
+                    "(ops/lowrank.py) and recurrent policies (tree form); "
+                    f"got {type(self.module).__name__}"
+                )
+            else:
+                lr_spec = make_lowrank_spec(
+                    self._spec.unravel(flat), self._low_rank
+                )
+                module = self.module
 
-            def lr_apply(shared, lrn, c, obs):
-                return mlp_lowrank_apply(module, shared, lrn, c, obs)
+                def lr_apply(shared, lrn, c, obs):
+                    return mlp_lowrank_apply(module, shared, lrn, c, obs)
 
         self.engine = ESEngine(
             self.env, self._policy_apply, self._spec, self.table,
@@ -392,6 +405,7 @@ class ES:
         self.generation = 0
         self.compile_time_s: float | None = None
         self._eval_policy_fn = None  # lazily-built jitted eval rollout
+        self._eval_gait_fn = None  # same, with the env-metrics channel
 
     # --------------------------------------------------------- pooled backend
 
@@ -706,6 +720,11 @@ class ES:
         (n_episodes,) and — device/pooled paths — ``bc`` (n_episodes, bc_dim),
         the behavior characterizations (e.g. final torso position for the
         locomotion family), for studies that measure more than the return.
+        On the device path it also adds ``steps`` (n_episodes,) and — for
+        envs exposing the gait-metrics protocol (``step_metrics`` /
+        ``episode_metrics``, the locomotion family) — ``gait``: per-episode
+        arrays such as ``forward_velocity_mps`` and ``upright_fraction``,
+        so "it walks" is stated in m/s and %-upright, not reward units.
         """
         if meta_index is not None:
             if not hasattr(self, "meta_states"):
@@ -723,7 +742,8 @@ class ES:
         use_best = use_best and self._best_flat is not None
         if self.backend == "device":
             flat = jnp.asarray(self._best_flat) if use_best else base_state.params_flat
-            fn = self._eval_policy_fn
+            want_gait = return_details and hasattr(self.env, "step_metrics")
+            fn = self._eval_gait_fn if want_gait else self._eval_policy_fn
             if fn is None:
                 from ..envs.rollout import make_rollout
 
@@ -745,9 +765,14 @@ class ES:
                 single = make_rollout(
                     self.env, apply_fn, self.config.horizon,
                     carry_init=self.module.carry_init if self._recurrent else None,
+                    with_env_metrics=want_gait,
                 )
                 # one cached callable: jit re-specializes per n_episodes shape
-                fn = self._eval_policy_fn = jax.jit(jax.vmap(single, in_axes=(None, 0)))
+                fn = jax.jit(jax.vmap(single, in_axes=(None, 0)))
+                if want_gait:
+                    self._eval_gait_fn = fn
+                else:
+                    self._eval_policy_fn = fn
             keys = jax.random.split(jax.random.PRNGKey(seed), n_episodes)
             p = self._spec.unravel(flat)
             if self._obs_norm:
@@ -755,9 +780,15 @@ class ES:
                 # the snapshot's own stats are part of training state, and
                 # the freshest moments are the best estimate of the env)
                 p = (p, base_state.obs_stats)
-            res = fn(p, keys)
+            gait_sums = None
+            if want_gait:
+                res, gait_sums = fn(p, keys)
+                gait_sums = np.asarray(gait_sums)
+            else:
+                res = fn(p, keys)
             rewards = np.asarray(res.total_reward)
             bc = np.asarray(res.bc)
+            eval_steps = np.asarray(res.steps)
         elif self.backend == "pooled":
             # engines read only state.params_flat (+ obs_stats), so a
             # params-swapped state evaluates the requested policy
@@ -792,6 +823,18 @@ class ES:
         if return_details:
             out["rewards"] = rewards
             out["bc"] = bc
+            if self.backend == "device":
+                out["steps"] = eval_steps
+                if gait_sums is not None:
+                    per_ep = [
+                        self.env.episode_metrics(bc[i], eval_steps[i],
+                                                 gait_sums[i])
+                        for i in range(n_episodes)
+                    ]
+                    out["gait"] = {
+                        k: np.asarray([m[k] for m in per_ep], np.float32)
+                        for k in per_ep[0]
+                    }
         return out
 
     def predict(self, obs, use_best: bool = False, carry=None):

@@ -297,6 +297,44 @@ class _PlanarBase:
     def control_dt(self):
         return self.chain.dt * self.chain.frame_skip
 
+    # ---- gait metrics (round-4 verdict weak #4: "walking" must be a
+    # measured claim — m/s and %-upright — not a reward-scale one) ----
+
+    # stricter than max_lean (the FALLING threshold, ~57° on the humanoid):
+    # a body can average 50° of lean without terminating and is not
+    # meaningfully "upright"; 0.35 rad ≈ 20° is a standing/walking posture
+    upright_lean: float = 0.35
+
+    @property
+    def metric_names(self) -> tuple:
+        return ("upright_fraction",)
+
+    def step_metrics(self, state) -> jax.Array:
+        """Per-step gait accumulables, summed alive-masked by the rollout
+        (envs/rollout.py ``with_env_metrics``)."""
+        if self.max_lean is None:
+            # horizontal-body runners (swimmer, cheetah) have no upright
+            # posture to lose; report 1 so the fraction reads "n/a-upright"
+            return jnp.ones((1,), jnp.float32)
+        lean = jnp.abs(state["theta"][0] - self.upright_offset)
+        return (lean < self.upright_lean).astype(jnp.float32)[None]
+
+    def episode_metrics(self, bc, steps, sums) -> dict:
+        """Episode gait summary from the rollout's (bc, steps, metric sums).
+
+        ``forward_velocity_mps`` is displacement-based — (final torso x −
+        initial x) / alive time — the quantity that transfers to MuJoCo
+        Humanoid's "distance covered" framing, robust to within-episode
+        speed variance.  Initial x is deterministic (reset noise perturbs
+        angles/velocities only, ``_init_state``)."""
+        steps = max(int(steps), 1)
+        t = steps * float(self.control_dt)
+        x0 = float(self.chain.init_pos[0][0])
+        return {
+            "upright_fraction": float(sums[0]) / steps,
+            "forward_velocity_mps": (float(bc[0]) - x0) / t,
+        }
+
 
 def _joint_angles(chain, state):
     pj = jnp.asarray(chain.parent, jnp.int32)
@@ -639,3 +677,120 @@ class PositionOnly:
 
     def behavior(self, state, obs):
         return self.base.behavior(state, obs)
+
+
+@dataclasses.dataclass(frozen=True)
+class DeceptiveValley:
+    """Deceptive-reward wrapper for the planar runners: a reward VALLEY
+    along the progress axis (round-4 verdict next #5 — a deceptive
+    locomotion task where greedy forward reward dead-ends).
+
+    The spatial U-maze of Conti et al. 2018 (PAPERS.md) is not expressible
+    in a planar (x, z) world — there is no second ground axis to walk
+    around an obstacle — so this is its exact 1-D equivalent, the
+    reward-landscape form of deception (Lehman & Stanley's definition: the
+    fitness gradient points AWAY from the global optimum):
+
+        φ(x) = x                                  x ≤ x_bait   (the bait)
+             = x_bait − valley_slope·(x − x_bait) x ≤ x_valley (the valley)
+             = φ(x_valley) + rise_slope·(x − x_valley)  beyond  (the prize)
+
+    Per-step reward is potential-based, ``reward_scale·(φ(x_t) −
+    φ(x_{t−1}))`` plus the base env's alive bonus and control cost, so an
+    episode's shaped return telescopes to ``reward_scale·(φ(x_T) − φ(x_0))``
+    — walking up to the bait and stopping is a true local optimum whose
+    basin covers the entire greedy path; every reward-following step past
+    it reads as WORSE until the valley is fully crossed.  Novelty search
+    over the final-position BC (the wrapped env's, untouched) has no such
+    barrier: x past the bait is simply unvisited behavior space.
+
+    Dynamics, observation, termination, and BC are the wrapped env's —
+    the agent must genuinely locomote ~``x_valley``+ body lengths to win.
+    """
+
+    base: _PlanarBase
+    x_bait: float = 1.0
+    x_valley: float = 3.0
+    valley_slope: float = 1.5
+    rise_slope: float = 4.0
+    reward_scale: float = 1.0
+
+    def __post_init__(self):
+        if not (self.x_bait < self.x_valley):
+            raise ValueError(
+                f"need x_bait < x_valley, got {self.x_bait} >= {self.x_valley}"
+            )
+        if self.valley_slope <= 0 or self.rise_slope <= 0:
+            raise ValueError("valley_slope and rise_slope must be positive "
+                             "(a non-decreasing φ is not deceptive)")
+
+    # static facts forwarded for the engine/rollout machinery
+    @property
+    def obs_dim(self):
+        return self.base.obs_dim
+
+    @property
+    def action_dim(self):
+        return self.base.action_dim
+
+    @property
+    def discrete(self):
+        return self.base.discrete
+
+    @property
+    def bc_dim(self):
+        return self.base.bc_dim
+
+    @property
+    def default_horizon(self):
+        return self.base.default_horizon
+
+    @property
+    def action_bound(self):
+        return self.base.action_bound
+
+    @property
+    def control_dt(self):
+        return self.base.control_dt
+
+    def _phi(self, x):
+        phi_valley_end = self.x_bait - self.valley_slope * (
+            self.x_valley - self.x_bait
+        )
+        return jnp.where(
+            x <= self.x_bait,
+            x,
+            jnp.where(
+                x <= self.x_valley,
+                self.x_bait - self.valley_slope * (x - self.x_bait),
+                phi_valley_end + self.rise_slope * (x - self.x_valley),
+            ),
+        )
+
+    def reset(self, key):
+        return self.base.reset(key)
+
+    def step(self, state, action):
+        nstate, obs, _, done = self.base.step(state, action)
+        act = jnp.clip(jnp.atleast_1d(action), -1.0, 1.0)
+        dphi = self._phi(nstate["pos"][0, 0]) - self._phi(state["pos"][0, 0])
+        reward = (
+            self.base.alive_bonus
+            + self.reward_scale * dphi
+            - self.base.ctrl_cost * jnp.sum(act**2)
+        )
+        return nstate, obs, reward, done
+
+    def behavior(self, state, obs):
+        return self.base.behavior(state, obs)
+
+    # gait metrics delegate: velocity/upright read dynamics, not reward
+    @property
+    def metric_names(self):
+        return self.base.metric_names
+
+    def step_metrics(self, state):
+        return self.base.step_metrics(state)
+
+    def episode_metrics(self, bc, steps, sums):
+        return self.base.episode_metrics(bc, steps, sums)

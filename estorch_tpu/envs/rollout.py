@@ -40,6 +40,7 @@ def make_rollout(
     horizon: int,
     carry_init: Callable[[], Any] | None = None,
     with_obs_moments: bool = False,
+    with_env_metrics: bool = False,
 ) -> Callable[[Any, jax.Array], Any]:
     """Build ``rollout(params, key) -> RolloutResult`` for one episode.
 
@@ -61,9 +62,22 @@ def make_rollout(
     ``(RolloutResult, (count, obs_sum, obs_sumsq))`` — the obs_norm
     probe's data source (parallel/engine.py), sharing one step body with
     the plain rollout so the two can never desynchronize.
+
+    ``with_env_metrics=True`` (requires ``env.step_metrics(state) ->
+    (k,) float32``): the scan additionally sums the env's per-step metric
+    vector over the states reached by alive steps, and the rollout
+    returns ``(RolloutResult, metric_sums (k,))``.  The env converts the
+    sums into episode quantities via ``env.episode_metrics`` (e.g. the
+    locomotion family's upright fraction) — measured gait claims instead
+    of reward-scale ones.
     """
     discrete = bool(env.discrete)
     stateful = carry_init is not None
+    if with_env_metrics and with_obs_moments:
+        raise ValueError("one aux channel per rollout: obs moments are the "
+                         "training probe, env metrics the evaluation one")
+    if with_env_metrics:
+        n_metrics = len(env.metric_names)
 
     def rollout(params: Any, key: jax.Array):
         state0, obs0 = env.reset(key)
@@ -88,6 +102,10 @@ def make_rollout(
                 out, h_new = policy_apply(params, obs), h
             action = select_action(out, discrete)
             nstate, nobs, reward, ndone = env.step(state, action)
+            if with_env_metrics:
+                # metrics of the state this alive step REACHED; frozen
+                # (post-termination) pseudo-steps contribute nothing
+                moments = moments + alive_f * env.step_metrics(nstate)
             total = total + reward * alive_f
             steps = steps + alive.astype(jnp.int32)
             # freeze state/obs after termination so BC reads the final frame
@@ -100,6 +118,12 @@ def make_rollout(
                 state_next, obs_next, done_next, total, steps, h_next, moments
             ), None
 
+        if with_obs_moments:
+            aux0 = (jnp.float32(0.0), zeros, zeros)
+        elif with_env_metrics:
+            aux0 = jnp.zeros((n_metrics,), jnp.float32)
+        else:
+            aux0 = None
         init = (
             state0,
             obs0,
@@ -107,14 +131,16 @@ def make_rollout(
             jnp.float32(0.0),
             jnp.int32(0),
             h0,
-            (jnp.float32(0.0), zeros, zeros) if with_obs_moments else None,
+            aux0,
         )
         (state, obs, done, total, steps, _, moments), _ = jax.lax.scan(
             step_fn, init, None, length=horizon
         )
         bc = env.behavior(state, obs).astype(jnp.float32)
         res = RolloutResult(total_reward=total, bc=bc, steps=steps)
-        return (res, moments) if with_obs_moments else res
+        return (
+            (res, moments) if (with_obs_moments or with_env_metrics) else res
+        )
 
     return rollout
 

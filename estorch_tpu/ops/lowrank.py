@@ -162,3 +162,107 @@ def lowrank_weighted_sum(
         nb = jax.lax.dynamic_slice(noise_mat, (0, off), (k, n))
         out[name]["bias"] = weights @ nb
     return out
+
+
+# ---- generic pytree form (recurrent / arbitrary policies) -----------------
+#
+# The MLP spec above is keyed by layer NAME because its consumer
+# (models/decomposed.py::mlp_lowrank_apply) restructures the MLP forward
+# around the layer identity — the per-STEP noise term stays O((m+n)·r).
+# Recurrent cells thread a carry through the episode scan, so their forward
+# cannot be restructured the same way without reimplementing every cell.
+# The tree form instead materializes each member's dense perturbation ONCE
+# PER EPISODE (amortized over the horizon's steps — the per-step forward is
+# then the standard rollout, carry threading included), while keeping the
+# two properties that matter at population scale: the per-member noise
+# STATE stays O(noise_dim) (the HBM win — table slices, never dense ε), and
+# the update is the same no-materialization einsum per factored leaf.
+# Transient per-chunk materialization equals what the standard path already
+# does with W + σ·s·ε.
+#
+# Any 2-D leaf where factoring saves ((m+n)·r < m·n) is factored; all other
+# leaves (biases, conv kernels, carry-init vectors) carry exact dense noise.
+
+
+@dataclasses.dataclass(frozen=True)
+class LowRankTreeSpec:
+    """Static layout of one member's low-rank noise vector over an
+    arbitrary param pytree (leaf order = ``jax.tree_util.tree_flatten``).
+
+    ``lr_leaves``: (leaf_index, m, n, a_off, b_off) — factored 2-D leaves.
+    ``dense_leaves``: (leaf_index, shape, size, off) — exact dense noise.
+    """
+
+    rank: int
+    noise_dim: int
+    treedef: Any
+    lr_leaves: tuple
+    dense_leaves: tuple
+
+
+def make_lowrank_tree_spec(params: Any, rank: int) -> LowRankTreeSpec:
+    """Layout from ANY param pytree — the recurrent-policy entry point."""
+    if rank < 1:
+        raise ValueError(f"low_rank must be >= 1, got {rank}")
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    lr_leaves, dense_leaves = [], []
+    off = 0
+    for i, leaf in enumerate(leaves):
+        shape = tuple(leaf.shape)
+        if leaf.ndim == 2 and rank * (shape[0] + shape[1]) < shape[0] * shape[1]:
+            m, n = shape
+            lr_leaves.append((i, m, n, off, off + m * rank))
+            off += (m + n) * rank
+        else:
+            size = 1
+            for s in shape:
+                size *= s
+            dense_leaves.append((i, shape, size, off))
+            off += size
+    return LowRankTreeSpec(
+        rank=rank, noise_dim=off, treedef=treedef,
+        lr_leaves=tuple(lr_leaves), dense_leaves=tuple(dense_leaves),
+    )
+
+
+def lowrank_tree_noise(spec: LowRankTreeSpec, noise_vec: jax.Array) -> Any:
+    """Materialize the dense noise pytree one member's slice represents."""
+    r = spec.rank
+    scale = 1.0 / jnp.sqrt(jnp.float32(r))
+    leaves = [None] * (len(spec.lr_leaves) + len(spec.dense_leaves))
+    for i, m, n, a_off, b_off in spec.lr_leaves:
+        a = noise_vec[a_off:a_off + m * r].reshape(m, r)
+        b = noise_vec[b_off:b_off + n * r].reshape(n, r)
+        leaves[i] = (a @ b.T) * scale
+    for i, shape, size, off in spec.dense_leaves:
+        leaves[i] = noise_vec[off:off + size].reshape(shape)
+    return jax.tree_util.tree_unflatten(spec.treedef, leaves)
+
+
+def lowrank_tree_perturb(
+    spec: LowRankTreeSpec, params: Any, noise_vec: jax.Array, scale
+) -> Any:
+    """``params + scale · dense(noise_vec)`` — one member's perturbed tree,
+    materialized once per episode (see the module-section comment)."""
+    noise = lowrank_tree_noise(spec, noise_vec)
+    return jax.tree_util.tree_map(lambda w, e: w + scale * e, params, noise)
+
+
+def lowrank_tree_weighted_sum(
+    spec: LowRankTreeSpec, noise_mat: jax.Array, weights: jax.Array
+) -> Any:
+    """Σ_i w_i · dense(noise_i) as a pytree, without materializing any
+    member's dense noise — the tree twin of :func:`lowrank_weighted_sum`
+    (same pair-folding argument: ±E share (A, B))."""
+    r = spec.rank
+    k = noise_mat.shape[0]
+    scale = 1.0 / jnp.sqrt(jnp.float32(r))
+    leaves = [None] * (len(spec.lr_leaves) + len(spec.dense_leaves))
+    for i, m, n, a_off, b_off in spec.lr_leaves:
+        a = noise_mat[:, a_off:a_off + m * r].reshape(k, m, r)
+        b = noise_mat[:, b_off:b_off + n * r].reshape(k, n, r)
+        leaves[i] = jnp.einsum("kmr,knr->mn", a * weights[:, None, None], b) * scale
+    for i, shape, size, off in spec.dense_leaves:
+        e = noise_mat[:, off:off + size]
+        leaves[i] = (weights @ e).reshape(shape)
+    return jax.tree_util.tree_unflatten(spec.treedef, leaves)

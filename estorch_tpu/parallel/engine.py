@@ -307,14 +307,15 @@ class ESEngine:
         carry_init=None,
     ):
         self.env = env
-        if carry_init is not None and (
-            config.decomposed or config.streamed or config.low_rank
-        ):
+        if carry_init is not None and (config.decomposed or config.streamed):
             # these paths restructure the FORWARD around the MLP layer
-            # identity (models/decomposed.py) and have no recurrent form yet
+            # identity (models/decomposed.py) and have no recurrent form.
+            # low_rank composes: the tree form (ops/lowrank.py) materializes
+            # each member's perturbation once per episode and runs the
+            # standard carry-threaded rollout
             raise ValueError(
                 "recurrent policies run the standard forward; they are "
-                "mutually exclusive with decomposed/streamed/low_rank"
+                "mutually exclusive with decomposed/streamed"
             )
         if config.obs_norm:
             if env is None:
@@ -328,7 +329,11 @@ class ESEngine:
                     "low_rank replaces the full-rank noise pathway; it is "
                     "mutually exclusive with decomposed/streamed/noise_kernel"
                 )
-            if lowrank_spec is None or (lowrank_apply is None and env is not None):
+            if lowrank_spec is None or (
+                lowrank_apply is None and env is not None and carry_init is None
+            ):
+                # recurrent policies need no lowrank_apply: they perturb via
+                # lowrank_tree_perturb and run the standard rollout
                 raise ValueError(
                     "EngineConfig.low_rank needs lowrank_apply + lowrank_spec "
                     "(ops/lowrank.py; ES builds them for MLPPolicy)"
@@ -460,7 +465,9 @@ class ESEngine:
             self._rollout_batched = make_batched_rollout(env, config.horizon)
 
         self._rollout_lowrank = None
-        if config.low_rank:
+        if config.low_rank and carry_init is None:
+            # the MLP per-step factored form; recurrent low_rank reuses
+            # self._rollout on per-episode-materialized trees instead
             def lr_packed_apply(packed, obs):
                 shared, lrn, c = packed
                 return lowrank_apply(shared, lrn, c, obs)
@@ -619,17 +626,38 @@ class ESEngine:
         if cfg.decomposed or cfg.low_rank:
             # shared center tree: unraveled (and, for bf16, cast) ONCE,
             # enters the member vmap as an un-batched constant — its matmuls
-            # fuse across the population
-            shared_tree = self._member_cast(self.spec.unravel(state.params_flat))
+            # fuse across the population.  The f32 original stays around for
+            # the recurrent low_rank branch, which perturbs in f32 and casts
+            # per member (the standard path's theta ordering)
+            center_f32 = self.spec.unravel(state.params_flat)
+            shared_tree = self._member_cast(center_f32)
 
         def chunk_body(_, xs):
             offs_c, signs_c, keys_c = xs
 
             def member_eval(off, sign, key):
                 if cfg.low_rank:
-                    # packed (A||B||bias) factors — dim is the LR noise_dim,
-                    # and no dense noise matrix ever exists on this path
-                    lrn = self.lr_spec.unpack(self.table.slice(off, self.noise_dim))
+                    nvec = self.table.slice(off, self.noise_dim)
+                    if self._carry_init is not None:
+                        # recurrent: dense perturbation materialized ONCE
+                        # per episode (ops/lowrank.py tree form) — noise
+                        # STATE stays O(noise_dim); the rollout is the
+                        # standard carry-threaded scan
+                        from ..ops.lowrank import lowrank_tree_perturb
+
+                        theta_tree = lowrank_tree_perturb(
+                            self.lr_spec, center_f32, nvec,
+                            state.sigma * sign,
+                        )
+                        rollout = self._rollout
+                        params = self._member_cast(theta_tree)
+                        if self._obs_norm:
+                            params = (params, state.obs_stats)
+                        return self._member_rollout(rollout, params, key)
+                    # MLP: packed (A||B||bias) factors — dim is the LR
+                    # noise_dim, and no dense noise matrix ever exists on
+                    # this path
+                    lrn = self.lr_spec.unpack(nvec)
                     rollout = self._rollout_lowrank
                     params = (
                         shared_tree,
@@ -747,13 +775,17 @@ class ESEngine:
             # one einsum per layer over the stacked factor slices — no dense
             # E_i is ever materialized (ops/lowrank.py)
             from ..ops.gradient import fold_mirrored_weights as _fold_lr
-            from ..ops.lowrank import lowrank_weighted_sum
+            from ..ops.lowrank import (lowrank_tree_weighted_sum,
+                                       lowrank_weighted_sum)
 
             row_w = _fold_lr(w_local) if cfg.mirrored else w_local
             noise_local = jax.vmap(
                 lambda o: self.table.slice(o, self.noise_dim)
             )(reduction_offs)
-            tree = lowrank_weighted_sum(self.lr_spec, noise_local, row_w)
+            wsum = (lowrank_tree_weighted_sum
+                    if hasattr(self.lr_spec, "treedef")
+                    else lowrank_weighted_sum)
+            tree = wsum(self.lr_spec, noise_local, row_w)
             grad_local = self.spec.flatten(tree) / (
                 cfg.population_size * state.sigma
             )
@@ -1092,11 +1124,11 @@ class ESEngine:
             off = all_offsets[member_index]
             sign = 1.0
         if self.config.low_rank:
-            from ..ops.lowrank import lowrank_noise_tree
+            from ..ops.lowrank import lowrank_noise_tree, lowrank_tree_noise
 
-            dense = lowrank_noise_tree(
-                self.lr_spec, self.table.slice(off, self.noise_dim)
-            )
+            mk = (lowrank_tree_noise if hasattr(self.lr_spec, "treedef")
+                  else lowrank_noise_tree)
+            dense = mk(self.lr_spec, self.table.slice(off, self.noise_dim))
             return state.params_flat + state.sigma * sign * self.spec.flatten(dense)
         eps = self.table.slice(off, self.spec.dim)
         return state.params_flat + state.sigma * sign * eps

@@ -93,7 +93,7 @@ class PooledEngine:
         self.obs_norm = bool(config.obs_norm)
         self._obs_clip = float(config.obs_clip)
         self._pending_moments = None
-        self._pending_moments_gen = -1
+        self._pending_moments_key = None
         if self.obs_norm and self.prep:
             raise ValueError(
                 "obs_norm + Atari preprocessing is unsupported: pixel "
@@ -307,16 +307,23 @@ class PooledEngine:
         if self.obs_norm:
             # raw-moment accumulators for this generation's alive steps —
             # merged into the state by apply_weights/generation_step.
-            # Stamped with the evaluated state's generation so a discarded
-            # evaluation (eval-only probe, exception between the calls)
-            # can never fold its observations into a LATER, unrelated
-            # update's running stats — apply_weights drops on mismatch.
+            # Stamped with the evaluated state's generation AND its params
+            # buffer identity so a discarded evaluation (eval-only probe,
+            # exception between the calls) or a DIFFERENT center at the
+            # same generation (meta-population NS/NSR/NSRA share gen
+            # numbers across centers) can never fold its observations into
+            # an unrelated update's running stats — apply_weights drops on
+            # any mismatch.
             self._pending_moments = [
                 0.0,
                 np.zeros(self.pool.obs_dim, np.float64),
                 np.zeros(self.pool.obs_dim, np.float64),
             ]
-            self._pending_moments_gen = int(state.generation)
+            # hold the buffer itself (not its id()) so the identity can't
+            # be recycled by the allocator between the two calls
+            self._pending_moments_key = (
+                int(state.generation), state.params_flat,
+            )
         if self.double_buffer:
             return self._evaluate_double_buffered(thetas, norm)
         return self._evaluate_sync(thetas, norm)
@@ -469,7 +476,15 @@ class PooledEngine:
         runs one batched forward per step.  Episode randomness comes from
         the pool seed, so ``seed`` picks the episode set.  Raw moments are
         NOT accumulated — held-out evaluation must not feed the training
-        stats."""
+        stats.
+
+        The fresh pool per call is deliberate, not an oversight: pools
+        seed only on their FIRST reset (see GymVecPool.reset), so caching
+        a pool across calls would silently turn "same seed → same episode
+        set" into "same seed → wherever the RNG stream got to" — the
+        determinism contract held-out comparisons rely on.  The repeated
+        ``_batch_actions`` specialization per distinct n_episodes is the
+        jit cache working as intended (same shapes hit the cache)."""
         bf16 = self.config.compute_dtype == "bfloat16"
         theta = jnp.asarray(
             state.params_flat, jnp.bfloat16 if bf16 else jnp.float32
@@ -517,10 +532,14 @@ class PooledEngine:
 
     def apply_weights(self, state: ESState, weights):
         new_state, gnorm = self.core.apply_weights(state, jnp.asarray(weights))
+        key = self._pending_moments_key
+        self._pending_moments_key = None
         if (
             self.obs_norm
             and self._pending_moments is not None
-            and self._pending_moments_gen == int(state.generation)
+            and key is not None
+            and key[0] == int(state.generation)
+            and key[1] is state.params_flat
         ):
             # fold the generation's observed raw moments (accumulated by
             # evaluate) into the running Welford triple — the f64 host

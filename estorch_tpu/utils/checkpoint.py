@@ -219,7 +219,10 @@ def restore_checkpoint(es, path: str) -> None:
             f"checkpoint was written with obs_norm={ck_obs_norm} but this "
             f"object was constructed with obs_norm={es_obs_norm} — rebuild "
             "with the matching setting (the running obs stats are part of "
-            "training state)"
+            f"training state), e.g. pass obs_norm={ck_obs_norm} to the "
+            "constructor or config recipe (humanoid2d_device/_pop10k "
+            "default obs_norm=True since round 4; older checkpoints need "
+            "the explicit obs_norm=False override)"
         )
 
     # An async save writes meta.json immediately while the Orbax array

@@ -1,0 +1,127 @@
+"""Novelty vs reward-only ES on a DECEPTIVE locomotion task.
+
+Round-4 verdict next #5: the novelty family's only outright win was
+MountainCarContinuous; its real-physics showing (HalfCheetah NSR-ES) was
+a predicted loss because plain locomotion is not deceptive.  This study
+runs the A/B on a task BUILT to be deceptive — `DeceptiveValley`
+(envs/locomotion.py): a reward valley along the progress axis of a
+planar runner, the 1-D equivalent of Conti et al.'s U-maze (PAPERS.md).
+Reward-following ES should stall at the bait (a true local optimum
+whose basin covers the greedy path); novelty search over the
+final-position BC has no such barrier.
+
+Protocol:
+  phase 0  calibrate reachable displacement: plain ES on the BASE env,
+           median final x of the trained policy → X_reach; the valley is
+           placed INSIDE demonstrated reach (bait 0.3·X, valley 0.7·X),
+           so "ES stalls" can never be an artifact of the prize being
+           physically unreachable.
+  phase 1  same budget per arm on the deceptive env:
+           ES (reward-only control) vs NSRA-ES (adaptive novelty).
+           Escape = median held-out final x past the valley.
+
+Run:  python examples/deceptive_valley_novelty.py [gens] [pop] [seeds]
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _median_final_x(es, n_episodes=16, meta_index=None):
+    ev = es.evaluate_policy(n_episodes=n_episodes, seed=101,
+                            meta_index=meta_index, return_details=True)
+    return float(np.median(ev["bc"][:, 0])), float(ev["mean"])
+
+
+def main():
+    gens = int(sys.argv[1]) if len(sys.argv) > 1 else 120
+    pop = int(sys.argv[2]) if len(sys.argv) > 2 else 256
+    n_seeds = int(sys.argv[3]) if len(sys.argv) > 3 else 2
+
+    import optax
+
+    from estorch_tpu import ES, NSRA_ES, JaxAgent, MLPPolicy
+    from estorch_tpu.envs import DeceptiveValley, Walker2D
+    from estorch_tpu.utils import enable_compilation_cache, force_cpu_backend
+
+    force_cpu_backend(8)
+    enable_compilation_cache()
+
+    base = Walker2D()
+    common = dict(
+        policy=MLPPolicy, agent=JaxAgent, optimizer=optax.adam,
+        population_size=pop, sigma=0.08,
+        policy_kwargs={"action_dim": base.action_dim, "hidden": (64, 64),
+                       "discrete": False, "action_scale": 1.0},
+        optimizer_kwargs={"learning_rate": 2e-2},
+    )
+
+    # phase 0: how far can this recipe actually walk?
+    cal = ES(agent_kwargs={"env": base, "horizon": 400}, seed=0, **common)
+    cal.train(max(gens // 2, 30), verbose=False)
+    x_reach, _ = _median_final_x(cal)
+    print(json.dumps({"phase": "calibrate", "x_reach": round(x_reach, 2),
+                      "gens": max(gens // 2, 30)}), flush=True)
+    if x_reach < 1.0:
+        print(json.dumps({"error": "calibration walked < 1.0 units; "
+                          "valley geometry would be degenerate"}), flush=True)
+        return
+
+    x_bait = round(0.3 * x_reach, 2)
+    x_valley = round(0.7 * x_reach, 2)
+    env = DeceptiveValley(base, x_bait=x_bait, x_valley=x_valley,
+                          valley_slope=1.5, rise_slope=4.0)
+    print(json.dumps({"phase": "geometry", "x_bait": x_bait,
+                      "x_valley": x_valley}), flush=True)
+
+    results = []
+    for seed in range(n_seeds):
+        for arm in ("es", "nsra"):
+            t0 = time.perf_counter()
+            if arm == "es":
+                algo = ES(agent_kwargs={"env": env, "horizon": 400},
+                          seed=seed, **common)
+            else:
+                algo = NSRA_ES(agent_kwargs={"env": env, "horizon": 400},
+                               seed=seed, k=10, meta_population_size=3,
+                               **common)
+            algo.train(gens, verbose=False)
+            if arm == "es":
+                x_med, r_mean = _median_final_x(algo)
+                per_center = [round(x_med, 2)]
+            else:
+                centers = [
+                    _median_final_x(algo, meta_index=i)
+                    for i in range(len(algo.meta_states))
+                ]
+                per_center = [round(x, 2) for x, _ in centers]
+                x_med, r_mean = max(centers, key=lambda c: c[0])
+            row = {
+                "phase": "ab", "arm": arm, "seed": seed,
+                "median_final_x": round(x_med, 2),
+                "per_center_x": per_center,
+                "escaped_valley": bool(x_med > x_valley),
+                "reached_bait": bool(x_med > 0.8 * x_bait),
+                "heldout_reward_mean": round(r_mean, 1),
+                "wall_s": round(time.perf_counter() - t0, 1),
+            }
+            results.append(row)
+            print(json.dumps(row), flush=True)
+
+    es_esc = [r["escaped_valley"] for r in results if r["arm"] == "es"]
+    ns_esc = [r["escaped_valley"] for r in results if r["arm"] == "nsra"]
+    print(json.dumps({
+        "verdict": {
+            "es_escapes": f"{sum(es_esc)}/{len(es_esc)}",
+            "nsra_escapes": f"{sum(ns_esc)}/{len(ns_esc)}",
+            "deception_held_for_es": not any(es_esc),
+            "novelty_won": sum(ns_esc) > sum(es_esc),
+        }
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

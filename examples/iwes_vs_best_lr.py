@@ -101,13 +101,19 @@ def main():
         "iwes_median_wall_to_bar_s": median_or_inf(
             [r["wall_to_bar_s"] for r in iwes_rows]),
     }
-    verdict["env_steps_winner"] = (
-        "iwes" if verdict["iwes_median_steps_to_bar"]
-        < verdict["vanilla_median_steps_to_bar"] else "vanilla"
+    def winner(iwes_med, vanilla_med):
+        # neither arm reached the bar → no evidence, no winner
+        if np.isinf(iwes_med) and np.isinf(vanilla_med):
+            return "none"
+        return "iwes" if iwes_med < vanilla_med else "vanilla"
+
+    verdict["env_steps_winner"] = winner(
+        verdict["iwes_median_steps_to_bar"],
+        verdict["vanilla_median_steps_to_bar"],
     )
-    verdict["wall_clock_winner"] = (
-        "iwes" if verdict["iwes_median_wall_to_bar_s"]
-        < verdict["vanilla_median_wall_to_bar_s"] else "vanilla"
+    verdict["wall_clock_winner"] = winner(
+        verdict["iwes_median_wall_to_bar_s"],
+        verdict["vanilla_median_wall_to_bar_s"],
     )
     print(json.dumps({"verdict": verdict}), flush=True)
 

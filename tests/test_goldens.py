@@ -54,11 +54,15 @@ GOLDENS = {
                      "params_sum": -2.02425},
     "ES_lowrank": {"reward_means": [43.625, 41.25, 38.25],
                    "params_sum": -5.60954},
+    # round-5 mode: factored noise over the recurrent tree (trunk + GRU
+    # gates + head), per-episode materialization (ops/lowrank.py tree form)
+    "ES_recurrent_lowrank": {"reward_means": [11.0, 9.375, 9.375],
+                             "params_sum": -1.73011},
 }
 
 CLASSES = {"ES": ES, "ES_decomposed": ES, "NS_ES": NS_ES, "NSR_ES": NSR_ES,
            "NSRA_ES": NSRA_ES, "ES_obsnorm": ES, "ES_recurrent": ES,
-           "ES_lowrank": ES}
+           "ES_lowrank": ES, "ES_recurrent_lowrank": ES}
 EXTRA = {
     "ES": {},
     "ES_decomposed": {"decomposed": True},
@@ -68,16 +72,18 @@ EXTRA = {
     "ES_obsnorm": {"obs_norm": True},
     "ES_recurrent": {},
     "ES_lowrank": {"low_rank": 1},
+    "ES_recurrent_lowrank": {"low_rank": 1},
 }
 
 
 def _run(name):
     from estorch_tpu import RecurrentPolicy
 
-    policy = RecurrentPolicy if name == "ES_recurrent" else MLPPolicy
+    recurrent = name.startswith("ES_recurrent")
+    policy = RecurrentPolicy if recurrent else MLPPolicy
     pk = (
         {"action_dim": 2, "hidden": (8,), "gru_size": 8}
-        if name == "ES_recurrent"
+        if recurrent
         else {"action_dim": 2, "hidden": (8,)}
     )
     es = CLASSES[name](

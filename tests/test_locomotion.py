@@ -11,8 +11,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from estorch_tpu.envs import (Cheetah2D, Hopper2D, Humanoid2D, Swimmer2D,
-                              Walker2D, make_rollout)
+from estorch_tpu.envs import (Cheetah2D, DeceptiveValley, Hopper2D,
+                              Humanoid2D, Swimmer2D, Walker2D, make_rollout)
 from estorch_tpu.envs.locomotion import _anchor_world
 
 ENVS = [Swimmer2D, Hopper2D, Walker2D, Humanoid2D, Cheetah2D]
@@ -256,3 +256,144 @@ class TestPositionOnly:
 
         env = PositionOnly(Walker2D())
         assert type(env._mask).__module__ == "numpy"
+
+
+class TestGaitMetrics:
+    """Gait-metric channel (round-4 verdict weak #4): 'walks' must be a
+    measured claim — m/s and upright fraction — not a reward-scale one."""
+
+    def test_rollout_env_metrics_channel(self):
+        env = Humanoid2D()
+
+        def apply(params, obs):
+            return jnp.tanh(obs[: env.action_dim] * params)
+
+        ro = make_rollout(env, apply, 40, with_env_metrics=True)
+        res, sums = jax.jit(ro)(jnp.float32(0.1), jax.random.key(0))
+        assert sums.shape == (len(env.metric_names),)
+        # upright steps can never exceed alive steps
+        assert 0.0 <= float(sums[0]) <= float(res.steps)
+        m = env.episode_metrics(np.asarray(res.bc), int(res.steps),
+                                np.asarray(sums))
+        assert set(m) == {"upright_fraction", "forward_velocity_mps"}
+        assert 0.0 <= m["upright_fraction"] <= 1.0
+        # displacement-based: velocity * time == distance traveled
+        t = int(res.steps) * float(env.control_dt)
+        x0 = float(env.chain.init_pos[0][0])
+        assert m["forward_velocity_mps"] * t == pytest.approx(
+            float(res.bc[0]) - x0, rel=1e-5
+        )
+
+    def test_horizontal_runner_upright_is_na(self):
+        """Cheetah/swimmer have no upright posture to lose: the indicator
+        is constant 1, so the fraction reads 1.0 (n/a-upright)."""
+        env = Cheetah2D()
+        state, _ = env.reset(jax.random.key(0))
+        assert float(env.step_metrics(state)[0]) == 1.0
+
+    def test_evaluate_policy_reports_gait(self):
+        import optax
+
+        from estorch_tpu import ES, JaxAgent, MLPPolicy
+
+        env = Walker2D()
+        es = ES(
+            policy=MLPPolicy, agent=JaxAgent, optimizer=optax.adam,
+            population_size=16, sigma=0.1,
+            policy_kwargs={"action_dim": env.action_dim, "hidden": (8,),
+                           "discrete": False, "action_scale": 1.0},
+            agent_kwargs={"env": env, "horizon": 24},
+            optimizer_kwargs={"learning_rate": 1e-2}, seed=0,
+        )
+        ev = es.evaluate_policy(n_episodes=3, return_details=True)
+        assert ev["steps"].shape == (3,)
+        assert ev["gait"]["upright_fraction"].shape == (3,)
+        assert ev["gait"]["forward_velocity_mps"].shape == (3,)
+        assert np.all(ev["gait"]["upright_fraction"] >= 0.0)
+        assert np.all(ev["gait"]["upright_fraction"] <= 1.0)
+        # the plain (detail-free) eval still works and agrees on the mean
+        assert es.evaluate_policy(n_episodes=3)["mean"] == pytest.approx(
+            ev["mean"]
+        )
+
+    def test_obs_moments_and_env_metrics_exclusive(self):
+        env = Walker2D()
+        with pytest.raises(ValueError, match="one aux channel"):
+            make_rollout(env, lambda p, o: o[: env.action_dim], 8,
+                         with_obs_moments=True, with_env_metrics=True)
+
+
+class TestDeceptiveValley:
+    """Deceptive-reward wrapper (round-4 verdict next #5): the fitness
+    landscape must actually be deceptive — a local optimum at the bait
+    whose basin covers the greedy path — while dynamics/BC stay the
+    base env's."""
+
+    def test_phi_shape_is_deceptive(self):
+        env = DeceptiveValley(Cheetah2D(), x_bait=1.0, x_valley=3.0,
+                              valley_slope=1.5, rise_slope=4.0)
+        phi = lambda x: float(env._phi(jnp.float32(x)))
+        assert phi(1.0) > phi(0.5) > phi(0.0)        # bait attracts
+        assert phi(1.0) > phi(2.0) > phi(3.0)        # valley repels
+        assert phi(5.0) > phi(1.0)                   # prize dominates bait
+        # continuity at the two knees
+        assert phi(1.0) == pytest.approx(phi(1.0 + 1e-6), abs=1e-4)
+        assert phi(3.0) == pytest.approx(phi(3.0 - 1e-6), abs=1e-4)
+
+    def test_shaped_return_telescopes(self):
+        """Summed shaped reward equals reward_scale·(φ(x_T) − φ(x_0)) plus
+        alive/control terms — potential-based shaping, exactly."""
+        base = Cheetah2D()  # never terminates, alive_bonus 0
+        env = DeceptiveValley(base, reward_scale=2.0)
+        state, _ = env.reset(jax.random.key(0))
+        x0 = float(state["pos"][0, 0])
+        total, ctrl = 0.0, 0.0
+        a = jnp.full((base.action_dim,), 0.4)
+        step = jax.jit(env.step)
+        for _ in range(20):
+            state, _, r, _ = step(state, a)
+            total += float(r)
+            ctrl += float(base.ctrl_cost * jnp.sum(jnp.clip(a, -1, 1) ** 2))
+        xT = float(state["pos"][0, 0])
+        want = 2.0 * (float(env._phi(jnp.float32(xT)))
+                      - float(env._phi(jnp.float32(x0)))) - ctrl
+        assert total == pytest.approx(want, abs=1e-3)
+
+    def test_dynamics_bc_and_termination_untouched(self):
+        base = Walker2D()
+        env = DeceptiveValley(base)
+        sb, ob = base.reset(jax.random.key(3))
+        sw, ow = env.reset(jax.random.key(3))
+        np.testing.assert_array_equal(np.asarray(ob), np.asarray(ow))
+        a = jnp.full((base.action_dim,), 0.3)
+        for _ in range(5):
+            sb, ob, _, db = base.step(sb, a)
+            sw, ow, _, dw = env.step(sw, a)
+        np.testing.assert_array_equal(np.asarray(ob), np.asarray(ow))
+        assert bool(db) == bool(dw)
+        np.testing.assert_array_equal(np.asarray(env.behavior(sw, ow)),
+                                      np.asarray(base.behavior(sb, ob)))
+
+    def test_bad_geometry_rejected(self):
+        with pytest.raises(ValueError, match="x_bait"):
+            DeceptiveValley(Cheetah2D(), x_bait=3.0, x_valley=1.0)
+        with pytest.raises(ValueError, match="slope"):
+            DeceptiveValley(Cheetah2D(), valley_slope=-1.0)
+
+    def test_trains_under_es_and_gait_metrics_pass_through(self):
+        import optax
+
+        from estorch_tpu import ES, JaxAgent, MLPPolicy
+
+        env = DeceptiveValley(Walker2D())
+        es = ES(
+            policy=MLPPolicy, agent=JaxAgent, optimizer=optax.adam,
+            population_size=16, sigma=0.1,
+            policy_kwargs={"action_dim": env.action_dim, "hidden": (8,),
+                           "discrete": False, "action_scale": 1.0},
+            agent_kwargs={"env": env, "horizon": 16},
+            optimizer_kwargs={"learning_rate": 1e-2}, seed=0,
+        )
+        es.train(1, verbose=False)
+        ev = es.evaluate_policy(n_episodes=2, return_details=True)
+        assert "gait" in ev and "forward_velocity_mps" in ev["gait"]

@@ -133,13 +133,77 @@ class TestRecurrentGuards:
         with pytest.raises(ValueError, match="decomposed"):
             _make_es(RecurrentPolicy, RECURRENT_PK, decomposed=True)
 
-    def test_low_rank_rejected(self):
-        with pytest.raises(ValueError, match="low_rank"):
-            _make_es(RecurrentPolicy, RECURRENT_PK, low_rank=1)
-
     def test_streamed_rejected(self):
         with pytest.raises(ValueError, match="streamed|recurrent"):
             _make_es(RecurrentPolicy, RECURRENT_PK, streamed=True)
+
+
+class TestRecurrentLowRank:
+    """Recurrent × low_rank (round-4 verdict next #7): factored noise over
+    the whole recurrent tree — trunk, cell gates, head — with per-episode
+    materialization (ops/lowrank.py tree form)."""
+
+    def test_tree_spec_factors_cell_kernels(self):
+        es = _make_es(RecurrentPolicy, RECURRENT_PK, low_rank=1)
+        spec = es.engine.lr_spec
+        assert hasattr(spec, "treedef")
+        # every 2-D kernel where rank-1 saves must be factored — the GRU
+        # gate kernels included (the whole point of the recurrent form)
+        assert len(spec.lr_leaves) >= 6  # trunk + 6 gru gates + head, minus
+        # any no-saving shapes
+        assert spec.noise_dim < es.engine.spec.dim  # the O(dim) state shrank
+
+    def test_trains_and_split_equals_fused(self):
+        from estorch_tpu.utils.fault import rank_weights_with_failures
+
+        es = _make_es(RecurrentPolicy, RECURRENT_PK, low_rank=1,
+                      population_size=32)
+        ev = es.engine.evaluate(es.state)
+        w = rank_weights_with_failures(np.asarray(ev.fitness))
+        split_state, _ = es.engine.apply_weights(es.state, w)
+
+        es2 = _make_es(RecurrentPolicy, RECURRENT_PK, low_rank=1,
+                       population_size=32)
+        fused_state, _ = es2.engine.generation_step(es2.state)
+        np.testing.assert_array_equal(
+            np.asarray(split_state.params_flat),
+            np.asarray(fused_state.params_flat),
+        )
+
+    def test_member_params_match_evaluated_member(self):
+        """member_params(i) must rebuild exactly the θ_i the rollout saw."""
+        es = _make_es(RecurrentPolicy, RECURRENT_PK, low_rank=1,
+                      population_size=16)
+        res = es.engine.evaluate(es.state)
+        fitness = np.asarray(res.fitness)
+        i = int(np.argmax(fitness))
+        theta = es.engine.member_params(es.state, i)
+
+        okey, rkey = jax.random.fold_in(
+            jax.random.fold_in(es.state.key, es.state.generation), 0
+        ), jax.random.fold_in(
+            jax.random.fold_in(es.state.key, es.state.generation), 1
+        )
+        pair_keys = jax.random.split(rkey, 8)
+        key_i = jnp.repeat(pair_keys, 2, axis=0)[i]
+        rollout = make_rollout(es.env, es._policy_apply, 16,
+                               carry_init=es.module.carry_init)
+        res_i = rollout(es._spec.unravel(theta), key_i)
+        assert float(res_i.total_reward) == pytest.approx(
+            fitness[i], abs=1e-4
+        )
+
+    def test_lstm_low_rank_trains(self):
+        pk = dict(RECURRENT_PK, cell="lstm")
+        es = _make_es(RecurrentPolicy, pk, low_rank=1, population_size=32)
+        es.train(2, verbose=False)
+        assert np.isfinite(es.history[-1]["reward_mean"])
+
+    def test_bf16_runs(self):
+        es = _make_es(RecurrentPolicy, RECURRENT_PK, low_rank=1,
+                      population_size=32, compute_dtype="bfloat16")
+        es.train(1, verbose=False)
+        assert np.isfinite(es.history[-1]["reward_mean"])
 
 
 class TestRecurrentPooled:
