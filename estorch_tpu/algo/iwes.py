@@ -74,7 +74,11 @@ class IW_ES(ES):
             )
         if self._low_rank:
             raise ValueError(
-                "IW_ES does not support low_rank (no dense ε for the ratio)"
+                "IW_ES does not support low_rank — and not merely as "
+                "pending work: the reused perturbation seen from the "
+                "drifted center, dense(v) + (c_old - c_new)/sigma, "
+                "generally has no rank-r preimage, so the factor-space "
+                "importance ratio is ill-posed (ROADMAP item 7)"
             )
         if self._streamed or self._noise_kernel:
             raise ValueError(

@@ -989,9 +989,14 @@ class ESEngine:
     def _require_dense_noise(self, what: str):
         if self.config.low_rank:
             raise ValueError(
-                f"{what} needs the dense (dim,) noise representation; "
-                "low_rank packs factors instead (ops/lowrank.py) — IW reuse "
-                "does not support low_rank yet"
+                f"{what} needs the dense (dim,) noise representation. "
+                "low_rank packs rank-r factors instead (ops/lowrank.py), "
+                "and IW reuse is not merely unimplemented there — it is "
+                "ill-posed: the reused perturbation seen from the drifted "
+                "center, dense(v) + (c_old - c_new)/sigma, generally lies "
+                "outside the rank-r image, so no factor-space importance "
+                "ratio exists (the induced distribution on dense "
+                "perturbations is singular; ROADMAP item 7)"
             )
 
     def noise_stats(self, offsets: jax.Array, d_vec: jax.Array):

@@ -10,15 +10,22 @@ Reward-following ES should stall at the bait (a true local optimum
 whose basin covers the greedy path); novelty search over the
 final-position BC has no such barrier.
 
-Protocol:
-  phase 0  calibrate reachable displacement: plain ES on the BASE env,
-           median final x of the trained policy → X_reach; the valley is
-           placed INSIDE demonstrated reach (bait 0.3·X, valley 0.7·X),
-           so "ES stalls" can never be an artifact of the prize being
-           physically unreachable.
-  phase 1  same budget per arm on the deceptive env:
-           ES (reward-only control) vs NSRA-ES (adaptive novelty).
-           Escape = median held-out final x past the valley.
+Substrate: Swimmer2D — no alive bonus and no termination, so the shaped
+fitness telescopes EXACTLY to reward_scale·(φ(x_T) − φ(x_0)) − control
+cost (no survival confound), and — decisive (round-5 calibration) —
+displacement is entirely EARNED: a passive/random swimmer stays at
+x ≈ 0.00 while trained undulation reaches ~8 units (the walker/cheetah
+alternatives drift ~0.5-0.8 units passively, so a valley inside their
+envelope gets crossed by accident, not locomotion).
+
+Geometry is SCALE-RELATIVE to the measured [passive, trained] span:
+phase 0 measures the untrained median final x (x_rand), the trained
+reach X, and the episode noise of final x; the bait sits at
+x_rand + 0.35·(X − x_rand) and the valley ends at x_rand + 0.75·(X −
+x_rand) — inside demonstrated reach, above passive drift — and the
+study aborts honestly unless the valley width clears 3 noise widths
+AND the bait clears the passive envelope by 5 (otherwise "escape"
+could be luck, not search).
 
 Run:  python examples/deceptive_valley_novelty.py [gens] [pop] [seeds]
 """
@@ -30,10 +37,11 @@ import time
 import numpy as np
 
 
-def _median_final_x(es, n_episodes=16, meta_index=None):
+def _final_x_stats(es, n_episodes=16, meta_index=None):
     ev = es.evaluate_policy(n_episodes=n_episodes, seed=101,
                             meta_index=meta_index, return_details=True)
-    return float(np.median(ev["bc"][:, 0])), float(ev["mean"])
+    xs = ev["bc"][:, 0]
+    return (float(np.median(xs)), float(np.std(xs)), float(ev["mean"]))
 
 
 def main():
@@ -44,38 +52,56 @@ def main():
     import optax
 
     from estorch_tpu import ES, NSRA_ES, JaxAgent, MLPPolicy
-    from estorch_tpu.envs import DeceptiveValley, Walker2D
+    from estorch_tpu.envs import DeceptiveValley, Swimmer2D
     from estorch_tpu.utils import enable_compilation_cache, force_cpu_backend
 
     force_cpu_backend(8)
     enable_compilation_cache()
 
-    base = Walker2D()
+    base = Swimmer2D()
     common = dict(
         policy=MLPPolicy, agent=JaxAgent, optimizer=optax.adam,
         population_size=pop, sigma=0.08,
-        policy_kwargs={"action_dim": base.action_dim, "hidden": (64, 64),
+        policy_kwargs={"action_dim": base.action_dim, "hidden": (32, 32),
                        "discrete": False, "action_scale": 1.0},
         optimizer_kwargs={"learning_rate": 2e-2},
     )
 
-    # phase 0: how far can this recipe actually walk?
+    # phase 0: passive envelope (median AND spread), reachable
+    # displacement, trained episode noise
     cal = ES(agent_kwargs={"env": base, "horizon": 400}, seed=0, **common)
+    x_rand, x_rand_noise, _ = _final_x_stats(cal)
     cal.train(max(gens // 2, 30), verbose=False)
-    x_reach, _ = _median_final_x(cal)
-    print(json.dumps({"phase": "calibrate", "x_reach": round(x_reach, 2),
+    x_reach, x_noise, _ = _final_x_stats(cal)
+    print(json.dumps({"phase": "calibrate", "x_rand": round(x_rand, 3),
+                      "x_rand_noise": round(x_rand_noise, 3),
+                      "x_reach": round(x_reach, 3),
+                      "final_x_noise": round(x_noise, 3),
                       "gens": max(gens // 2, 30)}), flush=True)
-    if x_reach < 1.0:
-        print(json.dumps({"error": "calibration walked < 1.0 units; "
-                          "valley geometry would be degenerate"}), flush=True)
-        return
 
-    x_bait = round(0.3 * x_reach, 2)
-    x_valley = round(0.7 * x_reach, 2)
+    span = x_reach - x_rand
+    x_bait = x_rand + 0.35 * span
+    x_valley = x_rand + 0.75 * span
+    width = x_valley - x_bait
+    # two distinct noise scales: the TRAINED policy's episode spread sizes
+    # the valley width; the PASSIVE policy's spread sizes the bait's
+    # clearance above where un-trained episodes land by luck
+    noise = max(x_noise, 1e-3)
+    p_noise = max(x_rand_noise, 1e-3)
+    if span <= 0 or width < 3.0 * noise or x_bait < x_rand + 5.0 * p_noise:
+        print(json.dumps({"error": "geometry not luck-proof: span %.3f, "
+                          "width %.3f vs 3*trained-noise %.3f, bait margin "
+                          "%.3f vs 5*passive-noise %.3f"
+                          % (span, width, 3 * noise,
+                             x_bait - x_rand, 5 * p_noise)}),
+              flush=True)
+        return
     env = DeceptiveValley(base, x_bait=x_bait, x_valley=x_valley,
-                          valley_slope=1.5, rise_slope=4.0)
-    print(json.dumps({"phase": "geometry", "x_bait": x_bait,
-                      "x_valley": x_valley}), flush=True)
+                          valley_slope=1.5, rise_slope=4.0,
+                          reward_scale=10.0)
+    print(json.dumps({"phase": "geometry", "x_bait": round(x_bait, 3),
+                      "x_valley": round(x_valley, 3),
+                      "reward_scale": 10.0}), flush=True)
 
     results = []
     for seed in range(n_seeds):
@@ -90,21 +116,22 @@ def main():
                                **common)
             algo.train(gens, verbose=False)
             if arm == "es":
-                x_med, r_mean = _median_final_x(algo)
-                per_center = [round(x_med, 2)]
+                x_med, _, r_mean = _final_x_stats(algo)
+                per_center = [round(x_med, 3)]
             else:
                 centers = [
-                    _median_final_x(algo, meta_index=i)
+                    _final_x_stats(algo, meta_index=i)
                     for i in range(len(algo.meta_states))
                 ]
-                per_center = [round(x, 2) for x, _ in centers]
-                x_med, r_mean = max(centers, key=lambda c: c[0])
+                per_center = [round(x, 3) for x, _, _ in centers]
+                best = max(centers, key=lambda c: c[0])
+                x_med, r_mean = best[0], best[2]
             row = {
                 "phase": "ab", "arm": arm, "seed": seed,
-                "median_final_x": round(x_med, 2),
+                "median_final_x": round(x_med, 3),
                 "per_center_x": per_center,
                 "escaped_valley": bool(x_med > x_valley),
-                "reached_bait": bool(x_med > 0.8 * x_bait),
+                "past_bait": bool(x_med > x_bait + 3 * max(noise, p_noise)),
                 "heldout_reward_mean": round(r_mean, 1),
                 "wall_s": round(time.perf_counter() - t0, 1),
             }

@@ -19,13 +19,19 @@ import time
 
 
 def main():
-    gens = int(sys.argv[1]) if len(sys.argv) > 1 else 40
-    pop = int(sys.argv[2]) if len(sys.argv) > 2 else 512
-    seed = int(sys.argv[3]) if len(sys.argv) > 3 else 0
+    # flags and positionals may come in any order: `... 40 512 0 --resume`
+    # and `... --resume` both work
+    pos = [a for a in sys.argv[1:] if not a.startswith("--")]
+    resume = "--resume" in sys.argv
+    gens = int(pos[0]) if len(pos) > 0 else 40
+    pop = int(pos[1]) if len(pos) > 1 else 512
+    seed = int(pos[2]) if len(pos) > 2 else 0
 
     from estorch_tpu import configs
     from estorch_tpu.parallel.mesh import single_device_mesh
-    from estorch_tpu.utils import enable_compilation_cache, force_cpu_backend
+    from estorch_tpu.utils import (PeriodicCheckpointer,
+                                   enable_compilation_cache,
+                                   force_cpu_backend, restore_checkpoint)
 
     force_cpu_backend(1)
     enable_compilation_cache()
@@ -33,6 +39,17 @@ def main():
     es = configs.humanoid_pooled(
         population_size=pop, seed=seed, mesh=single_device_mesh(),
     )
+    # checkpoint + periodic held-out evals: a wall-clock kill (the round-5
+    # stage-2 run died 2 generations before its final eval) must not cost
+    # the evidence — the latest checkpoint restores and every 10th
+    # generation already carries a held-out row
+    ck = PeriodicCheckpointer(es, f"runs/humanoid_v3_s{seed}/ckpts",
+                              every=5, max_to_keep=2)
+    resumed_at = 0
+    if resume and ck.latest():
+        restore_checkpoint(es, ck.latest())
+        resumed_at = es.generation
+        print(json.dumps({"resumed_at": resumed_at}), flush=True)
 
     t0 = time.perf_counter()
     total_steps = 0
@@ -51,19 +68,36 @@ def main():
             "elapsed_s": round(el, 1),
             "peak_rss_gb": round(rss, 2),
         }), flush=True)
+        ck.on_record(rec)
+        if rec["generation"] % 10 == 0:
+            ev10 = es.evaluate_policy(n_episodes=8, seed=1)
+            print(json.dumps({
+                "gen": rec["generation"],
+                "heldout_mean_8ep": round(ev10["mean"], 1),
+                "heldout_std": round(ev10["std"], 1),
+            }), flush=True)
 
-    es.train(gens, log_fn=log, verbose=False)
+    remaining = gens - es.generation
+    if remaining > 0:
+        es.train(remaining, log_fn=log, verbose=False)
+    ck.save(es.generation)
+    ck.close()
 
     ev = es.evaluate_policy(n_episodes=32, seed=1)
     print(json.dumps({
         "summary": "humanoid_pooled pop-%d obs_norm (Humanoid-v5)" % pop,
-        "gens": gens, "seed": seed,
+        # history-derived totals so a resumed run reports the WHOLE run,
+        # not just the post-resume session (the log rows' steps_per_s and
+        # wall_s stay session-relative by design)
+        "gens": es.generation, "seed": seed,
+        "resumed_at": resumed_at or None,
         "final_reward_mean": round(es.history[-1]["reward_mean"], 1),
         "best": round(es.best_reward, 1),
         "heldout_mean_32ep": round(ev["mean"], 1),
         "heldout_std": round(ev["std"], 1),
-        "total_env_steps": total_steps,
-        "wall_s": round(time.perf_counter() - t0, 1),
+        "total_env_steps": int(sum(r["env_steps"] for r in es.history)),
+        "session_env_steps": total_steps,
+        "session_wall_s": round(time.perf_counter() - t0, 1),
         "peak_rss_gb": round(
             resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6, 2),
     }), flush=True)

@@ -21,6 +21,7 @@ class TestConfigs:
             "humanoid_nsres",
             "halfcheetah_pooled",
             "halfcheetah_nsres",
+            "humanoid_pooled",
             "pong84_conv",
             "atari_frostbite",
         }
@@ -85,6 +86,26 @@ class TestConfigs:
         assert es.engine.bc_dim == 1
         # archive holds 1-dim BCs: meta seeds + this generation's center
         assert es.archive.bcs.shape[1] == 1
+        assert np.isfinite(es.history[0]["reward_mean"])
+        es.engine.pool.close()
+        es.engine.center_pool.close()
+
+    def test_humanoid_pooled_runs_real_mujoco(self):
+        """Config 3's pooled edition: Humanoid-v5 physics, obs_norm on,
+        actions squashed to the env's ±0.4 bound (round-5)."""
+        from estorch_tpu.configs import humanoid_pooled
+        from estorch_tpu.parallel.mesh import single_device_mesh
+
+        es = humanoid_pooled(
+            population_size=8,
+            mesh=single_device_mesh(),
+            agent_kwargs={"env_name": "gym:Humanoid-v5", "horizon": 30},
+        )
+        es.train(1, verbose=False)
+        assert es.backend == "pooled"
+        assert es._obs_norm
+        assert es.module.action_scale == 0.4
+        assert float(es.state.obs_stats[0]) > 0  # member obs fed the stats
         assert np.isfinite(es.history[0]["reward_mean"])
         es.engine.pool.close()
         es.engine.center_pool.close()

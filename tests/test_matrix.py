@@ -236,3 +236,30 @@ def test_iwes_recurrent_composes():
     es.train(2, verbose=False)
     assert np.isfinite(es.history[-1]["reward_mean"])
     assert "reused_prev" in es.history[-1]
+
+
+def test_recurrent_lowrank_runs_novelty_family():
+    """Round-5 composition: factored noise over the recurrent tree
+    (per-episode materialization) lives below _eval_local/_local_grad,
+    which the novelty family shares with vanilla ES."""
+    from estorch_tpu import NSR_ES, RecurrentPolicy
+
+    kw = dict(BACKENDS["device"])
+    kw["policy"] = RecurrentPolicy
+    kw["policy_kwargs"] = {"action_dim": 2, "hidden": (8,), "gru_size": 8}
+    es = NSR_ES(population_size=16, sigma=0.05, seed=0, table_size=1 << 14,
+                meta_population_size=2, k=3, low_rank=1, **kw)
+    es.train(2, verbose=False)
+    assert np.isfinite(es.history[-1]["reward_mean"])
+
+
+def test_iwes_rejects_low_rank_as_ill_posed():
+    """IW reuse under low_rank is not pending work — the drifted reused
+    perturbation generally has no rank-r preimage, so no factor-space
+    importance ratio exists; the combination must fail loudly."""
+    from estorch_tpu import IW_ES
+
+    kw = dict(BACKENDS["device"])
+    with pytest.raises(ValueError, match="ill-posed"):
+        IW_ES(population_size=16, sigma=0.05, seed=0, table_size=1 << 14,
+              low_rank=1, **kw)
