@@ -163,11 +163,23 @@ def humanoid2d_device(**over):
     obs_norm=False for the raw-observation variant — including to
     RESTORE checkpoints saved before round 4 (the running stats are
     training state, so restore_checkpoint rejects an obs_norm
-    mismatch)."""
+    mismatch).
+
+    obs_probe_episodes defaults to 4 here (round-5 A/B, BENCHMARKS.md:
+    4 probes tied 1 probe on one seed and found a 2.2× better optimum
+    on the other, at ~0.6% extra episode cost — the same faster-stats
+    lever as warmup).  The ENGINE default stays 1 (parity-minimal,
+    goldens pinned); this is a recipe-level choice.  Unlike obs_norm,
+    the probe count is NOT training state and restore does not gate on
+    it — resuming a pre-round-5 run under this default accumulates
+    stats 4× faster from the resume point (statistically sound either
+    way); pass obs_probe_episodes=1 for a procedure-exact
+    continuation."""
     from .envs import Humanoid2D
 
     return _planar_device(Humanoid2D(), 1024, (64, 64), 400, 2e-2,
-                          {"obs_norm": True, **over})
+                          {"obs_norm": True, "obs_probe_episodes": 4,
+                           **over})
 
 
 def cheetah2d_device(**over):
@@ -211,11 +223,14 @@ def humanoid2d_pop10k(**over):
     held-out eval on real MuJoCo (3/3 HalfCheetah seeds).  The two compose
     as of round 4 (normalization is an input-side transform, independent
     of the noise representation).  eval_chunk bounds materialized member
-    weights the same way the bench's pop-10k point does."""
+    weights the same way the bench's pop-10k point does.
+    obs_probe_episodes=4 per the round-5 probe-count A/B (see
+    humanoid2d_device)."""
     from .envs import Humanoid2D
 
     return _planar_device(Humanoid2D(), 10240, (256, 256), 400, 2e-2,
                           {"low_rank": 1, "obs_norm": True,
+                           "obs_probe_episodes": 4,
                            "eval_chunk": 1024, **over})
 
 
