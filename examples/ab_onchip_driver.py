@@ -28,11 +28,11 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import subprocess
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
 import bench  # noqa: E402  (repo-root bench.py: AB_MATRIX + stage protocol)
 
 # extras configs the headline run needs warm, measured with the same
@@ -74,6 +74,13 @@ def main(argv=None):
                         "(0 = never abort)")
     args = p.parse_args(argv)
 
+    # single-core mutual exclusion: a manual invocation must respect the
+    # same evidence flock the watcher/study queue serialize through
+    # (bench.acquire_evidence_lock no-ops when the watcher spawned us
+    # holding it, via EVIDENCE_LOCK_HELD)
+    print("waiting for evidence lock…", file=sys.stderr)
+    _lock_fd = bench.acquire_evidence_lock()  # held until process exit
+
     skip = done_labels(args.out) if args.skip_done else set()
     rows = list(bench.AB_MATRIX) + EXTRA_ROWS
     consec_fail = 0
@@ -83,24 +90,7 @@ def main(argv=None):
             continue
         cfg = {**base, **over}
         t0 = time.time()
-        try:
-            r = subprocess.run(
-                [sys.executable, os.path.join(os.path.dirname(bench.__file__),
-                                              "bench.py"),
-                 "--stage-one", json.dumps(cfg)],
-                timeout=args.timeout_s, capture_output=True, text=True)
-            # same parse bench.run_stage uses: the result is the LAST stdout
-            # line that is a JSON object — the JAX/TPU runtime occasionally
-            # emits stray stdout lines that must not fail a measured row
-            json_lines = [ln for ln in r.stdout.splitlines()
-                          if ln.startswith("{")]
-            out = json.loads(json_lines[-1])
-            _ = out["rate"]  # contract check, as run_stage does
-        except subprocess.TimeoutExpired:
-            out = {"rate": None, "cfg": cfg, "error": "timeout"}
-        except (IndexError, ValueError, KeyError, TypeError):
-            out = {"rate": None, "cfg": cfg, "error": "unparseable",
-                   "stderr_tail": bench._clean_stderr(r.stderr)[-500:]}
+        out = bench.run_stage_detailed(cfg, timeout_s=args.timeout_s)
         line = {"label": label, **out, "wall_s": round(time.time() - t0, 1)}
         with open(args.out, "a") as f:
             f.write(json.dumps(line) + "\n")

@@ -32,6 +32,9 @@ import subprocess
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+import bench  # noqa: E402  (acquire_evidence_lock — one lock protocol)
+
 PROBE = ("import jax; d = jax.devices(); "
          "print(d[0].platform, len(d), flush=True)")
 
@@ -62,8 +65,10 @@ def run_step(label, argv, log_path, timeout_s, stdout=None):
     burn the single host core unbounded and contaminate the next
     window's serialized measurements (the round-4 lesson)."""
     _log(log_path, f"{_now()} step={label} start")
+    # children must not re-take the evidence flock we already hold
+    env = {**os.environ, "EVIDENCE_LOCK_HELD": "1"}
     proc = subprocess.Popen(argv, cwd=REPO, start_new_session=True,
-                            stdout=stdout, stderr=None)
+                            stdout=stdout, stderr=None, env=env)
     try:
         rc = proc.wait(timeout=timeout_s)
         _log(log_path, f"{_now()} step={label} exit={rc}")
@@ -99,6 +104,11 @@ def recovery_sequence(log_path, probe_timeout_s):
                           log_path, timeout_s=3600, stdout=f)
         if ok:
             os.replace(tmp, out)
+        else:
+            try:
+                os.remove(tmp)  # don't leave a partial artifact beside
+            except OSError:      # the real one
+                pass
     # 3. bounded, resumable training run of the north-star config
     if probe(probe_timeout_s):
         run_step("onchip_window",
@@ -123,9 +133,28 @@ def main(argv=None):
         up = probe(args.probe_timeout_s)
         _log(args.log, f"{_now()} watcher attempt={attempt} up={up}")
         if up:
-            _log(args.log, f"{_now()} RECOVERY — launching evidence sequence")
-            recovery_sequence(args.log, args.probe_timeout_s)
-            _log(args.log, f"{_now()} sequence done; resuming probe loop")
+            # single-core host: on-chip measurements and CPU-mesh studies
+            # must never overlap (round-4 load-contamination lesson).  CPU
+            # study stages hold this flock (`flock .evidence.lock <stage>`);
+            # if one is mid-stage, defer to the next probe cycle instead of
+            # contaminating both sides' rates.
+            try:
+                lock_fd = bench.acquire_evidence_lock(max_wait_s=0,
+                                                      respect_env=False)
+            except bench.EvidenceLockBusy:
+                _log(args.log, f"{_now()} up but evidence lock busy "
+                               f"(CPU study mid-stage) — deferring")
+                if args.once:
+                    break
+                time.sleep(args.interval_s)
+                continue
+            try:
+                _log(args.log,
+                     f"{_now()} RECOVERY — launching evidence sequence")
+                recovery_sequence(args.log, args.probe_timeout_s)
+                _log(args.log, f"{_now()} sequence done; resuming probe loop")
+            finally:
+                os.close(lock_fd)  # releases the flock
         if args.once:
             break
         time.sleep(args.interval_s)

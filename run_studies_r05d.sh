@@ -1,0 +1,17 @@
+#!/bin/bash
+# Round-5 session-3 serialized CPU study queue.  Every stage holds the
+# evidence flock so the TPU watcher defers its on-chip sequence instead
+# of contending for the single host core (and vice versa).
+set -u
+cd /root/repo
+LOCK=/root/repo/.evidence.lock
+LOG=/root/repo/studies_r05d.log
+stage() {
+  echo "--- stage: $*" >> "$LOG"
+  flock "$LOCK" "$@" >> "$LOG" 2>&1
+  echo "exit $? $(date -u +%FT%TZ)" >> "$LOG"
+}
+stage /opt/venv/bin/python examples/deceptive_valley_novelty.py 120 512 2
+stage /opt/venv/bin/python examples/halfcheetah_pop1k.py 40 1024 3
+stage /opt/venv/bin/python examples/halfcheetah_pop1k.py 40 1024 4
+echo "queue done $(date -u +%FT%TZ)" >> "$LOG"
