@@ -28,6 +28,17 @@ AND the bait clears the passive envelope by 5 (otherwise "escape"
 could be luck, not search).
 
 Run:  python examples/deceptive_valley_novelty.py [gens] [pop] [seeds]
+          [valley_end_frac]
+
+`valley_end_frac` (default 0.75) is the task-difficulty knob: where the
+valley's far wall sits as a fraction of the calibrated [passive,
+trained] span.  The round-5 120-gen run at 0.75 measured NSRA's valley
+penetration at ~0.36 units per 120 gens — enough to show the mechanism
+(ES pinned AT the bait both seeds; novelty past it both seeds) but a
+3.3-unit-wide valley needs a budget no CPU-mesh session has.  A
+narrower valley (e.g. 0.55) is the same trap — a true local optimum
+whose width still clears the 3-noise-width guard by two orders of
+magnitude — sized so a full escape fits the generation budget.
 """
 
 import json
@@ -46,8 +57,9 @@ def _final_x_stats(es, n_episodes=16, meta_index=None):
 
 def main():
     gens = int(sys.argv[1]) if len(sys.argv) > 1 else 120
-    pop = int(sys.argv[2]) if len(sys.argv) > 2 else 256
+    pop = int(sys.argv[2]) if len(sys.argv) > 2 else 512
     n_seeds = int(sys.argv[3]) if len(sys.argv) > 3 else 2
+    valley_end_frac = float(sys.argv[4]) if len(sys.argv) > 4 else 0.75
 
     import optax
 
@@ -64,7 +76,12 @@ def main():
         population_size=pop, sigma=0.08,
         policy_kwargs={"action_dim": base.action_dim, "hidden": (32, 32),
                        "discrete": False, "action_scale": 1.0},
-        optimizer_kwargs={"learning_rate": 2e-2},
+        # the proven Swimmer2D recipe (locomotion_swimmer.py: full gait in
+        # ~30 gens at pop 512 / lr 3e-2) — calibration AND both A/B arms
+        # share it, so an ES stall at the bait is deception, not an
+        # under-powered optimizer (the round-5 0.77-unit calibration abort
+        # was pop 256 / lr 2e-2 under-training, not geometry)
+        optimizer_kwargs={"learning_rate": 3e-2},
     )
 
     # phase 0: passive envelope (median AND spread), reachable
@@ -81,7 +98,7 @@ def main():
 
     span = x_reach - x_rand
     x_bait = x_rand + 0.35 * span
-    x_valley = x_rand + 0.75 * span
+    x_valley = x_rand + valley_end_frac * span
     width = x_valley - x_bait
     # two distinct noise scales: the TRAINED policy's episode spread sizes
     # the valley width; the PASSIVE policy's spread sizes the bait's
@@ -103,17 +120,22 @@ def main():
                       "x_valley": round(x_valley, 3),
                       "reward_scale": 10.0}), flush=True)
 
+    from estorch_tpu import NS_ES
+
     results = []
     for seed in range(n_seeds):
-        for arm in ("es", "nsra"):
+        for arm in ("es", "nses", "nsra"):
             t0 = time.perf_counter()
             if arm == "es":
                 algo = ES(agent_kwargs={"env": env, "horizon": 400},
                           seed=seed, **common)
             else:
-                algo = NSRA_ES(agent_kwargs={"env": env, "horizon": 400},
-                               seed=seed, k=10, meta_population_size=3,
-                               **common)
+                # nses = pure novelty (Conti's strongest escaper on
+                # deceptive tasks); nsra = adaptive reward/novelty blend
+                cls = NS_ES if arm == "nses" else NSRA_ES
+                algo = cls(agent_kwargs={"env": env, "horizon": 400},
+                           seed=seed, k=10, meta_population_size=3,
+                           **common)
             algo.train(gens, verbose=False)
             if arm == "es":
                 x_med, _, r_mean = _final_x_stats(algo)
@@ -138,14 +160,18 @@ def main():
             results.append(row)
             print(json.dumps(row), flush=True)
 
-    es_esc = [r["escaped_valley"] for r in results if r["arm"] == "es"]
-    ns_esc = [r["escaped_valley"] for r in results if r["arm"] == "nsra"]
+    def esc(a):
+        return [r["escaped_valley"] for r in results if r["arm"] == a]
+
+    es_esc = esc("es")
+    nov_esc = esc("nses") + esc("nsra")
     print(json.dumps({
         "verdict": {
             "es_escapes": f"{sum(es_esc)}/{len(es_esc)}",
-            "nsra_escapes": f"{sum(ns_esc)}/{len(ns_esc)}",
+            "nses_escapes": f"{sum(esc('nses'))}/{len(esc('nses'))}",
+            "nsra_escapes": f"{sum(esc('nsra'))}/{len(esc('nsra'))}",
             "deception_held_for_es": not any(es_esc),
-            "novelty_won": sum(ns_esc) > sum(es_esc),
+            "novelty_won": sum(nov_esc) > 0 and not any(es_esc),
         }
     }), flush=True)
 

@@ -1,0 +1,6 @@
+#!/bin/bash
+cd /root/repo
+LOG=/root/repo/studies_r05d.log
+echo "--- stage: /opt/venv/bin/python examples/deceptive_valley_novelty.py 400 512 2 0.55" >> "$LOG"
+flock /root/repo/.evidence.lock /opt/venv/bin/python examples/deceptive_valley_novelty.py 400 512 2 0.55 >> "$LOG" 2>&1
+echo "exit $? $(date -u +%FT%TZ)" >> "$LOG"
