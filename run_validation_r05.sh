@@ -1,0 +1,15 @@
+#!/bin/bash
+# Round-5 session-3 test validation, serialized behind the CPU studies
+# via the evidence flock (single-core discipline).
+set -u
+cd /root/repo
+LOCK=/root/repo/.evidence.lock
+LOG=/root/repo/validation_r05.log
+stage() {
+  echo "--- stage: $*" >> "$LOG"
+  flock "$LOCK" "$@" >> "$LOG" 2>&1
+  echo "exit $? $(date -u +%FT%TZ)" >> "$LOG"
+}
+stage /opt/venv/bin/python -m pytest tests/test_recurrent.py -x -q
+stage /opt/venv/bin/python -m pytest tests/ -x -q
+echo "validation done $(date -u +%FT%TZ)" >> "$LOG"
