@@ -416,6 +416,14 @@ class ES:
         from ..envs.gym_vec_pool import pool_env_spec
         from ..parallel.pooled import PooledEngine
 
+        if getattr(policy, "learned_carry", False) or (
+                policy_kwargs or {}).get("learned_carry"):
+            raise ValueError(
+                "learned_carry is a device-path feature: the pooled "
+                "backend initializes episode carries before member params "
+                "exist (parallel/pooled.py), so a params-dependent "
+                "episode-start carry has no pooled form yet"
+            )
         env_kwargs = getattr(self.agent, "env_kwargs", None)
         spec_info = pool_env_spec(self.agent.env_name, env_kwargs)
         prep = getattr(self.agent, "prep", None)
@@ -857,6 +865,6 @@ class ES:
                                 self._obs_clip)
         if getattr(self, "_recurrent", False):
             if carry is None:
-                carry = self.module.carry_init()
+                carry = self.module.carry_init(p)
             return self._policy_apply(p, obs, carry)
         return self._policy_apply(p, obs)

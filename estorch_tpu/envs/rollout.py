@@ -38,7 +38,7 @@ def make_rollout(
     env: Any,
     policy_apply: Callable[..., jax.Array],
     horizon: int,
-    carry_init: Callable[[], Any] | None = None,
+    carry_init: Callable[..., Any] | None = None,
     with_obs_moments: bool = False,
     with_env_metrics: bool = False,
 ) -> Callable[[Any, jax.Array], Any]:
@@ -50,7 +50,9 @@ def make_rollout(
 
     Recurrent policies (``carry_init`` given): ``policy_apply(params, obs,
     h) -> (out, h')`` and the hidden carry is threaded through the episode
-    scan — reset to ``carry_init()`` at episode start, frozen (like env
+    scan — reset to ``carry_init(params)`` at episode start (so a policy
+    with a LEARNED initial carry reads it from the member's perturbed
+    params — models/policies.py ``learned_carry``), frozen (like env
     state) after termination.  The reference has no recurrent machinery
     (its ``agent.rollout`` owns the loop, SURVEY.md §3.3, so torch users
     thread hidden state themselves); here the loop is a compiled scan, so
@@ -73,6 +75,17 @@ def make_rollout(
     """
     discrete = bool(env.discrete)
     stateful = carry_init is not None
+    if stateful:
+        # carry_init may be the historical zero-arg form (custom user
+        # callables) or the params-aware form (learned episode-start
+        # carry, models/policies.py) — detect once at build time
+        import inspect
+
+        try:
+            _ci_takes_params = bool(
+                inspect.signature(carry_init).parameters)
+        except (TypeError, ValueError):
+            _ci_takes_params = True
     if with_env_metrics and with_obs_moments:
         raise ValueError("one aux channel per rollout: obs moments are the "
                          "training probe, env metrics the evaluation one")
@@ -81,7 +94,12 @@ def make_rollout(
 
     def rollout(params: Any, key: jax.Array):
         state0, obs0 = env.reset(key)
-        h0 = carry_init() if stateful else None
+        # episode-start carry may be learned: carry_init reads it from the
+        # member's (perturbed) params when the policy asks for that
+        if stateful:
+            h0 = carry_init(params) if _ci_takes_params else carry_init()
+        else:
+            h0 = None
         zeros = jnp.zeros_like(obs0, dtype=jnp.float32)
 
         def step_fn(carry, _):
@@ -149,7 +167,7 @@ def make_obs_probe(
     env: Any,
     policy_apply: Callable[..., jax.Array],
     horizon: int,
-    carry_init: Callable[[], Any] | None = None,
+    carry_init: Callable[..., Any] | None = None,
 ) -> Callable[[Any, jax.Array], tuple[jax.Array, jax.Array, jax.Array]]:
     """One episode's raw-observation moments: ``probe(params, key) ->
     (count, obs_sum, obs_sumsq)``.
@@ -175,7 +193,7 @@ def make_population_rollout(
     env: Any,
     policy_apply: Callable[..., jax.Array],
     horizon: int,
-    carry_init: Callable[[], Any] | None = None,
+    carry_init: Callable[..., Any] | None = None,
 ) -> Callable[[Any, jax.Array], RolloutResult]:
     """vmap of ``make_rollout`` over stacked params and per-member keys.
 

@@ -60,14 +60,26 @@ class MLPPolicy(nn.Module):
 
 
 class RecurrentPolicy(nn.Module):
-    """MLP trunk + recurrent core (GRU or LSTM) + action head, for POMDPs.
+    """MLP trunk + recurrent core (GRU or LSTM stack) + action head, for
+    POMDPs.
 
     Apply contract (recurrent): ``module.apply(vars, obs, carry) ->
-    (out, new_carry)``; ``carry_init()`` gives the episode-start carry —
-    an array for the GRU, an ``(c, h)`` tuple for the LSTM (every consumer
-    is pytree-agnostic, so the cell choice is invisible downstream).
-    The cells are ordinary dense matmuls — vmapped across the population
-    they batch onto the MXU exactly like the feedforward policies.
+    (out, new_carry)``; ``carry_init(params=None)`` gives the
+    episode-start carry — an array for the GRU, a ``(c, h)`` tuple for
+    the LSTM, and a tuple of per-layer carries when ``n_layers > 1``
+    (every consumer is pytree-agnostic, so the cell choice and depth are
+    invisible downstream).  The cells are ordinary dense matmuls —
+    vmapped across the population they batch onto the MXU exactly like
+    the feedforward policies.
+
+    ``learned_carry=True`` promotes the episode-start carry to ordinary
+    parameters (``carry0_*``): they are perturbed by ES noise and moved
+    by the update like any weight, and ``carry_init(params)`` reads the
+    member's values at episode start (the rollout passes the member's
+    perturbed tree — envs/rollout.py).  With ``params=None`` it falls
+    back to zeros, which is exactly what module init needs for a shape
+    donor.  Device path only: the pooled backend initializes carries
+    before member params exist and is gated in ``algo/es.py``.
     """
 
     action_dim: int
@@ -77,6 +89,8 @@ class RecurrentPolicy(nn.Module):
     action_scale: float = 1.0
     activation: Callable = nn.tanh
     cell: str = "gru"  # "gru" | "lstm"
+    n_layers: int = 1
+    learned_carry: bool = False
 
     # marks the module for ES/rollout wiring (not a dataclass field)
     is_recurrent = True
@@ -84,27 +98,65 @@ class RecurrentPolicy(nn.Module):
     def _check_cell(self) -> None:
         if self.cell not in ("gru", "lstm"):
             raise ValueError(f"cell must be 'gru' or 'lstm', got {self.cell!r}")
+        if self.n_layers < 1:
+            raise ValueError(f"n_layers must be >= 1, got {self.n_layers}")
+
+    def _cell_name(self, j: int) -> str:
+        # layer 0 keeps the historical single-layer name so existing
+        # checkpoints and goldens stay valid
+        return self.cell if j == 0 else f"{self.cell}_{j}"
+
+    def _carry0_names(self, j: int) -> tuple[str, ...]:
+        if self.cell == "lstm":
+            return (f"carry0_c_{j}", f"carry0_h_{j}")
+        return (f"carry0_{j}",)
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, carry) -> tuple[jnp.ndarray, Any]:
         self._check_cell()
         for i, h in enumerate(self.hidden):
             x = self.activation(nn.Dense(h, name=f"dense_{i}")(x))
-        if self.cell == "lstm":
-            carry, x = nn.OptimizedLSTMCell(
-                features=self.gru_size, name="lstm"
-            )(carry, x)
-        else:
-            carry, x = nn.GRUCell(features=self.gru_size, name="gru")(carry, x)
+        carries = (carry,) if self.n_layers == 1 else tuple(carry)
+        new_carries = []
+        for j in range(self.n_layers):
+            if self.cell == "lstm":
+                c, x = nn.OptimizedLSTMCell(
+                    features=self.gru_size, name=self._cell_name(j)
+                )(carries[j], x)
+            else:
+                c, x = nn.GRUCell(
+                    features=self.gru_size, name=self._cell_name(j)
+                )(carries[j], x)
+            new_carries.append(c)
+        if self.learned_carry:
+            # declared here so they live in the param tree (created at
+            # module.init); consumed by carry_init(params) at episode
+            # start, not by the per-step forward
+            for j in range(self.n_layers):
+                for name in self._carry0_names(j):
+                    self.param(name, nn.initializers.zeros,
+                               (self.gru_size,))
         x = nn.Dense(self.action_dim, name="head")(x)
         if not self.discrete:
             x = jnp.tanh(x) * self.action_scale
-        return x, carry
+        out_carry = new_carries[0] if self.n_layers == 1 else tuple(new_carries)
+        return x, out_carry
 
-    def carry_init(self):
+    def carry_init(self, params=None):
         self._check_cell()
-        z = jnp.zeros((self.gru_size,), jnp.float32)
-        return (z, z) if self.cell == "lstm" else z
+        if self.learned_carry and params is not None:
+            p = params["params"] if "params" in params else params
+
+            def one(j):
+                vals = tuple(p[name] for name in self._carry0_names(j))
+                return vals if self.cell == "lstm" else vals[0]
+        else:
+            z = jnp.zeros((self.gru_size,), jnp.float32)
+
+            def one(j):
+                return (z, z) if self.cell == "lstm" else z
+        per = [one(j) for j in range(self.n_layers)]
+        return per[0] if self.n_layers == 1 else tuple(per)
 
 
 def _nature_conv_stack(x: jnp.ndarray, use_vbn: bool = False,
@@ -165,7 +217,7 @@ class RecurrentNatureCNN(nn.Module):
             x = jnp.tanh(x) * self.action_scale
         return x, carry
 
-    def carry_init(self) -> jnp.ndarray:
+    def carry_init(self, params=None) -> jnp.ndarray:
         return jnp.zeros((self.gru_size,), jnp.float32)
 
 

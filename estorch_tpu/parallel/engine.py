@@ -395,7 +395,8 @@ class ESEngine:
                 # is bf16 throughout (a f32 init would flip dtypes between
                 # scan iterations)
                 base_carry_init = carry_init
-                carry_init = lambda: _cast_leaves(base_carry_init(), jnp.bfloat16)
+                carry_init = lambda params=None: _cast_leaves(
+                    base_carry_init(params), jnp.bfloat16)
             else:
                 policy_apply = _bf16_io_apply(policy_apply)
         self._carry_init = carry_init
@@ -437,6 +438,7 @@ class ESEngine:
         # whole generation (members + probe + center eval) normalizes with
         # one consistent snapshot
         rollout_apply = policy_apply
+        rollout_carry_init = carry_init
         if config.obs_norm:
             clip = float(config.obs_clip)
             base_apply = policy_apply
@@ -444,17 +446,25 @@ class ESEngine:
                 def rollout_apply(packed, obs, h):
                     p, stats = packed
                     return base_apply(p, normalize_obs(obs, stats, clip), h)
+
+                # the rollout's "params" are the packed (params, obs_stats)
+                # pair — a learned episode-start carry must read from the
+                # PARAMS half (models/policies.py learned_carry)
+                base_ci = carry_init
+
+                def rollout_carry_init(packed=None):
+                    return base_ci(None if packed is None else packed[0])
             else:
                 def rollout_apply(packed, obs):
                     p, stats = packed
                     return base_apply(p, normalize_obs(obs, stats, clip))
 
         self._rollout = make_rollout(
-            env, rollout_apply, config.horizon, carry_init=carry_init
+            env, rollout_apply, config.horizon, carry_init=rollout_carry_init
         )
         self._obs_probe = (
             make_obs_probe(env, rollout_apply, config.horizon,
-                           carry_init=carry_init)
+                           carry_init=rollout_carry_init)
             if config.obs_norm else None
         )
 

@@ -76,6 +76,8 @@ class TestCarryThreading:
             seen["h"] = h
             return jnp.zeros((1,)), h + 1.0
 
+        # deliberately the LEGACY zero-arg form: make_rollout must keep
+        # accepting it (inspect-based detection in envs/rollout.py)
         rollout = make_rollout(env, policy_apply, horizon=5,
                                carry_init=lambda: jnp.zeros(()))
         res = rollout({}, jax.random.PRNGKey(0))
@@ -90,7 +92,7 @@ class TestCarryThreading:
             return h[None], h + 1.0
 
         rollout2 = make_rollout(env, emit_h, horizon=5,
-                                carry_init=lambda: jnp.zeros(()))
+                                carry_init=lambda params=None: jnp.zeros(()))
         for key in range(4):
             res2 = rollout2({}, jax.random.PRNGKey(key))
             sign = float(env.reset(jax.random.PRNGKey(key))[0][0])
@@ -338,3 +340,124 @@ class TestRecurrentVision:
         es.train(1, verbose=False)
         assert np.isfinite(es.history[-1]["reward_mean"])
         assert es.engine.recurrent
+
+
+class TestStackedAndLearnedCarry:
+    """Round-5 ROADMAP item 6: stacked recurrent cells and a LEARNED
+    episode-start carry.  ``carry0_*`` are ordinary params — perturbed by
+    ES noise, moved by the update — and ``carry_init(params)`` reads the
+    member's values at episode start (envs/rollout.py passes the member's
+    perturbed tree).  The reference has no recurrent machinery at all
+    (SURVEY.md §3.3), so both are beyond-parity extensions."""
+
+    def test_stacked_carry_structure(self):
+        for cell in ("gru", "lstm"):
+            pk = dict(RECURRENT_PK, cell=cell, n_layers=2)
+            mod = RecurrentPolicy(**pk)
+            h0 = mod.carry_init()
+            assert isinstance(h0, tuple) and len(h0) == 2
+            obs = jnp.zeros((1,))
+            v = mod.init(jax.random.PRNGKey(0), obs, h0)
+            _, h1 = mod.apply(v, obs, h0)
+            assert (jax.tree_util.tree_structure(h1)
+                    == jax.tree_util.tree_structure(h0))
+            # layer 0 keeps the historic single-layer submodule name (so
+            # existing checkpoints/goldens stay valid); layer 1 is suffixed
+            assert cell in v["params"] and f"{cell}_1" in v["params"]
+
+    def test_stacked_trains(self):
+        es = _make_es(RecurrentPolicy, dict(RECURRENT_PK, n_layers=2),
+                      population_size=32)
+        es.train(2, verbose=False)
+        assert np.isfinite(es.history[-1]["reward_mean"])
+
+    def test_learned_carry_params_exist_and_are_read(self):
+        mod = RecurrentPolicy(**dict(RECURRENT_PK, learned_carry=True))
+        obs = jnp.zeros((1,))
+        v = mod.init(jax.random.PRNGKey(0), obs, mod.carry_init())
+        assert "carry0_0" in v["params"]
+        p = dict(v["params"])
+        p["carry0_0"] = jnp.full((8,), 0.5)
+        np.testing.assert_array_equal(np.asarray(mod.carry_init(p)),
+                                      np.full((8,), 0.5))
+        # variables-dict form and the zero-arg shape donor both work
+        np.testing.assert_array_equal(np.asarray(mod.carry_init({"params": p})),
+                                      np.full((8,), 0.5))
+        assert np.all(np.asarray(mod.carry_init()) == 0)
+
+    def test_learned_carry_trains_and_moves(self):
+        es = _make_es(RecurrentPolicy,
+                      dict(RECURRENT_PK, learned_carry=True),
+                      population_size=64)
+        c0 = np.asarray(
+            es._spec.unravel(es.state.params_flat)["carry0_0"]).copy()
+        es.train(3, verbose=False)
+        c1 = np.asarray(es._spec.unravel(es.state.params_flat)["carry0_0"])
+        assert np.isfinite(es.history[-1]["reward_mean"])
+        # the learned carry is a real parameter: the update moved it
+        assert not np.allclose(c0, c1)
+
+    def test_learned_carry_split_equals_fused(self):
+        from estorch_tpu.utils.fault import rank_weights_with_failures
+
+        pk = dict(RECURRENT_PK, learned_carry=True)
+        es = _make_es(RecurrentPolicy, pk, population_size=32)
+        ev = es.engine.evaluate(es.state)
+        w = rank_weights_with_failures(np.asarray(ev.fitness))
+        split_state, _ = es.engine.apply_weights(es.state, w)
+        es2 = _make_es(RecurrentPolicy, pk, population_size=32)
+        fused_state, _ = es2.engine.generation_step(es2.state)
+        np.testing.assert_array_equal(np.asarray(split_state.params_flat),
+                                      np.asarray(fused_state.params_flat))
+
+    def test_learned_carry_low_rank_is_dense_leaf(self):
+        es = _make_es(RecurrentPolicy,
+                      dict(RECURRENT_PK, learned_carry=True),
+                      low_rank=1, population_size=32)
+        # identify carry0_0's leaf INDEX (shape alone would collide with
+        # same-shaped biases) and assert that exact leaf gets dense noise
+        tree = es._spec.unravel(es.state.params_flat)
+        paths = jax.tree_util.tree_flatten_with_path(tree)[0]
+        carry_idx = [i for i, (path, _) in enumerate(paths)
+                     if any(getattr(k, "key", None) == "carry0_0"
+                            for k in path)]
+        assert len(carry_idx) == 1
+        dense_idx = {i for i, _, _, _ in es.engine.lr_spec.dense_leaves}
+        assert carry_idx[0] in dense_idx  # exact dense noise, never dropped
+        es.train(1, verbose=False)
+        assert np.isfinite(es.history[-1]["reward_mean"])
+
+    def test_lstm_stacked_learned_bf16_trains(self):
+        pk = dict(RECURRENT_PK, cell="lstm", n_layers=2, learned_carry=True)
+        es = _make_es(RecurrentPolicy, pk, population_size=32,
+                      compute_dtype="bfloat16")
+        es.train(1, verbose=False)
+        assert np.isfinite(es.history[-1]["reward_mean"])
+
+    def test_pooled_rejects_learned_carry(self):
+        from estorch_tpu import PooledAgent
+
+        with pytest.raises(ValueError, match="learned_carry"):
+            ES(
+                policy=RecurrentPolicy,
+                agent=PooledAgent,
+                optimizer=optax.adam,
+                population_size=8,
+                sigma=0.1,
+                policy_kwargs={"action_dim": 2, "hidden": (8,),
+                               "gru_size": 8, "discrete": True,
+                               "learned_carry": True},
+                agent_kwargs={"env_name": "cartpole", "horizon": 8},
+                optimizer_kwargs={"learning_rate": 1e-2},
+                seed=0,
+            )
+
+    def test_learned_carry_composes_with_obs_norm(self):
+        """obs_norm packs the rollout's params as (tree, obs_stats); the
+        engine's carry_init wrapper must read the learned carry from the
+        PARAMS half (parallel/engine.py rollout_carry_init)."""
+        es = _make_es(RecurrentPolicy,
+                      dict(RECURRENT_PK, learned_carry=True),
+                      population_size=32, obs_norm=True)
+        es.train(2, verbose=False)
+        assert np.isfinite(es.history[-1]["reward_mean"])
