@@ -60,6 +60,7 @@ def main():
     pop = int(sys.argv[2]) if len(sys.argv) > 2 else 512
     n_seeds = int(sys.argv[3]) if len(sys.argv) > 3 else 2
     valley_end_frac = float(sys.argv[4]) if len(sys.argv) > 4 else 0.75
+    seed_start = int(sys.argv[5]) if len(sys.argv) > 5 else 0
 
     import optax
 
@@ -123,7 +124,7 @@ def main():
     from estorch_tpu import NS_ES
 
     results = []
-    for seed in range(n_seeds):
+    for seed in range(seed_start, seed_start + n_seeds):
         for arm in ("es", "nses", "nsra"):
             t0 = time.perf_counter()
             if arm == "es":

@@ -1,0 +1,14 @@
+#!/bin/bash
+# Round-5 session-3 follow-up studies: valley seeds 2-3, learned-carry A/B.
+set -u
+cd /root/repo
+LOCK=/root/repo/.evidence.lock
+LOG=/root/repo/studies_r05e.log
+stage() {
+  echo "--- stage: $*" >> "$LOG"
+  flock "$LOCK" "$@" >> "$LOG" 2>&1
+  echo "exit $? $(date -u +%FT%TZ)" >> "$LOG"
+}
+stage /opt/venv/bin/python examples/deceptive_valley_novelty.py 400 512 2 0.55 2
+stage /opt/venv/bin/python examples/learned_carry_ab.py 120 256 2
+echo "queue done $(date -u +%FT%TZ)" >> "$LOG"
