@@ -1,0 +1,6 @@
+#!/bin/bash
+cd /root/repo
+LOG=/root/repo/studies_r05e.log
+echo "--- stage: /opt/venv/bin/python examples/capstone_run.py humanoid2d_device 1000 100 0  (probe-4 recipe)" >> "$LOG"
+flock /root/repo/.evidence.lock /opt/venv/bin/python examples/capstone_run.py humanoid2d_device 1000 100 0 >> "$LOG" 2>&1
+echo "exit $? $(date -u +%FT%TZ)" >> "$LOG"
