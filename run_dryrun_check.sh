@@ -1,0 +1,9 @@
+#!/bin/bash
+cd /root/repo
+LOG=/root/repo/validation_r05.log
+echo "--- stage: dryrun_multichip(8) post-recurrent-changes" >> "$LOG"
+flock /root/repo/.evidence.lock /opt/venv/bin/python -c "
+import __graft_entry__ as g
+g.dryrun_multichip(8)
+print('dryrun_multichip(8) OK')" >> "$LOG" 2>&1
+echo "exit $? $(date -u +%FT%TZ)" >> "$LOG"
