@@ -1,0 +1,19 @@
+#!/bin/bash
+# Final round-5 CPU ladder: capstone seed-robustness, then Humanoid-v5
+# extension in short resumable stages (lock releases between stages so a
+# TPU window can preempt the queue).
+set -u
+cd /root/repo
+LOCK=/root/repo/.evidence.lock
+LOG=/root/repo/studies_r05f.log
+stage() {
+  echo "--- stage: $*" >> "$LOG"
+  flock "$LOCK" "$@" >> "$LOG" 2>&1
+  echo "exit $? $(date -u +%FT%TZ)" >> "$LOG"
+}
+stage /opt/venv/bin/python examples/capstone_run.py humanoid2d_device 1000 100 1
+stage /opt/venv/bin/python examples/humanoid_v3_pooled.py 15 512 0 --resume
+stage /opt/venv/bin/python examples/humanoid_v3_pooled.py 30 512 0 --resume
+stage /opt/venv/bin/python examples/humanoid_v3_pooled.py 45 512 0 --resume
+stage /opt/venv/bin/python examples/humanoid_v3_pooled.py 60 512 0 --resume
+echo "queue done $(date -u +%FT%TZ)" >> "$LOG"
