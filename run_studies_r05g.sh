@@ -1,0 +1,13 @@
+#!/bin/bash
+set -u
+cd /root/repo
+LOCK=/root/repo/.evidence.lock
+LOG=/root/repo/studies_r05f.log
+stage() {
+  echo "--- stage: $*" >> "$LOG"
+  flock "$LOCK" "$@" >> "$LOG" 2>&1
+  echo "exit $? $(date -u +%FT%TZ)" >> "$LOG"
+}
+stage /opt/venv/bin/python examples/humanoid_v3_pooled.py 75 512 0 --resume
+stage /opt/venv/bin/python examples/humanoid_v3_pooled.py 90 512 0 --resume
+echo "g-queue done $(date -u +%FT%TZ)" >> "$LOG"
