@@ -865,6 +865,14 @@ class ES:
                                 self._obs_clip)
         if getattr(self, "_recurrent", False):
             if carry is None:
-                carry = self.module.carry_init(p)
+                # same compat contract as make_rollout: a custom module
+                # with the historical zero-arg carry_init() must work here
+                # exactly as it does in the rollout path
+                from ..envs.rollout import carry_init_takes_params
+
+                ci = self.module.carry_init
+                if not hasattr(self, "_ci_takes_params"):
+                    self._ci_takes_params = carry_init_takes_params(ci)
+                carry = ci(p) if self._ci_takes_params else ci()
             return self._policy_apply(p, obs, carry)
         return self._policy_apply(p, obs)

@@ -1,0 +1,37 @@
+"""esguard: JAX-aware static analysis for the estorch_tpu framework.
+
+The failure modes that matter here — reused PRNG keys breaking mirrored
+sampling, host syncs and impurity inside jitted hot paths, unbounded
+subprocess waits wedging a pod worker — are invisible to unit tests
+until real hardware makes them expensive.  esguard catches them at
+AST level, on CPU, in seconds:
+
+    python -m estorch_tpu.analysis estorch_tpu/          # human output
+    python -m estorch_tpu.analysis --json estorch_tpu/   # machine output
+
+Rules (docs/analysis.md has the full rationale per rule):
+
+* R01 prng-key-reuse          — same key consumed by >1 random op
+* R02 host-sync-in-hot-path   — .item()/np.array()/float() under trace
+* R03 impure-jit              — print/time/np.random/closure mutation under jit
+* R04 missing-donation        — jitted update without donate_argnums
+* R05 untimed-subprocess-wait — proc.wait()/communicate() without timeout
+* R06 signature-probe-default — inspect.signature fallback that guesses
+
+Nothing in this package imports jax or the analyzed modules — analysis
+is pure ``ast`` and safe to run where no accelerator exists.
+"""
+
+from .baseline import (ApplyResult, Baseline, BaselineEntry, load_baseline,
+                       save_baseline)
+from .config import EsguardConfig, load_config
+from .engine import (Rule, all_rules, analyze_paths, analyze_source,
+                     get_rule, iter_py_files, rule)
+from .findings import Finding, findings_to_json, sort_findings
+
+__all__ = [
+    "ApplyResult", "Baseline", "BaselineEntry", "EsguardConfig", "Finding",
+    "Rule", "all_rules", "analyze_paths", "analyze_source",
+    "findings_to_json", "get_rule", "iter_py_files", "load_baseline",
+    "load_config", "rule", "save_baseline", "sort_findings",
+]

@@ -1,0 +1,120 @@
+"""esguard CLI: ``python -m estorch_tpu.analysis [paths...]``.
+
+Exit codes: 0 clean; 1 unsuppressed findings; 2 baseline problems only
+(stale or unjustified entries with an otherwise-clean tree); 3 bad
+invocation.  ``--json`` emits a machine-readable report for CI.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .baseline import Baseline, load_baseline, save_baseline
+from .config import load_config
+from .engine import all_rules, analyze_paths
+from .findings import sort_findings
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m estorch_tpu.analysis",
+        description="esguard: JAX-aware static analysis "
+                    "(PRNG/trace/host hazards)")
+    p.add_argument("paths", nargs="*", default=["estorch_tpu"],
+                   help="files or directories (default: estorch_tpu)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="JSON report on stdout")
+    p.add_argument("--config", default=None, metavar="PYPROJECT",
+                   help="pyproject.toml with [tool.esguard] "
+                        "(default: ./pyproject.toml)")
+    p.add_argument("--baseline", default=None, metavar="PATH",
+                   help="baseline JSON (overrides config)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore any configured baseline")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write current findings to the baseline and exit 0")
+    p.add_argument("--select", default=None, metavar="IDS",
+                   help="comma-separated rule ids to run (e.g. R01,R05)")
+    p.add_argument("--ignore", default=None, metavar="IDS",
+                   help="comma-separated rule ids to skip")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule table and exit")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    rules = all_rules()
+
+    if args.list_rules:
+        for r in rules:
+            print(f"{r.id}  {r.name:26s} [{r.severity}] {r.description}")
+        return 0
+
+    cfg = load_config(args.config)
+    ids = cfg.rule_ids([r.id for r in rules])
+    if args.select:
+        ids = [i for i in ids if i in args.select.split(",")]
+    if args.ignore:
+        ids = [i for i in ids if i not in args.ignore.split(",")]
+    active = [r for r in rules if r.id in ids]
+    if not active:
+        print("esguard: no rules selected", file=sys.stderr)
+        return 3
+
+    findings = sort_findings(
+        analyze_paths(args.paths, rules=active, exclude=cfg.exclude))
+
+    baseline_path = args.baseline or cfg.baseline_path()
+    if args.no_baseline:
+        baseline_path = None
+
+    if args.write_baseline:
+        if baseline_path is None:
+            print("esguard: --write-baseline needs --baseline or a "
+                  "[tool.esguard] baseline entry", file=sys.stderr)
+            return 3
+        save_baseline(baseline_path, findings)
+        print(f"esguard: wrote {len(findings)} entr"
+              f"{'y' if len(findings) == 1 else 'ies'} to {baseline_path} "
+              "— add a `reason` to each before committing")
+        return 0
+
+    baseline = (load_baseline(baseline_path)
+                if baseline_path is not None else Baseline())
+    res = baseline.apply(findings)
+    unjustified = baseline.unjustified()
+
+    if args.as_json:
+        print(json.dumps({
+            "rules": ids,
+            "findings": [f.to_dict() for f in res.unsuppressed],
+            "suppressed": [f.to_dict() for f in res.suppressed],
+            "stale_baseline": [vars(e) for e in res.stale],
+            "unjustified_baseline": [vars(e) for e in unjustified],
+        }, indent=2, sort_keys=True))
+    else:
+        for f in res.unsuppressed:
+            print(f.render())
+        for e in res.stale:
+            print(f"STALE baseline entry: {e.rule} {e.file} [{e.symbol}] "
+                  f"`{e.snippet}` — the finding is gone; delete the entry")
+        for e in unjustified:
+            print(f"UNJUSTIFIED baseline entry: {e.rule} {e.file} "
+                  f"[{e.symbol}] — add a `reason`")
+        n = len(res.unsuppressed)
+        print(f"esguard: {n} finding{'' if n == 1 else 's'} "
+              f"({len(res.suppressed)} baselined, {len(res.stale)} stale, "
+              f"{len(findings)} total) across rules {','.join(ids)}")
+
+    if res.unsuppressed:
+        return 1
+    if res.stale or unjustified:
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,216 @@
+"""Per-module analysis context: AST, import aliases, traced functions.
+
+Built once per file and shared by every rule, so each rule stays a small
+visitor instead of re-deriving "is this call jax.random.split?" or "does
+this function body get traced?" on its own.
+
+Traced-function detection (the "hot path" of R02/R03) is deliberately
+conservative: a function counts as traced only when the module gives
+static evidence —
+
+* decorated with ``jit``/``vmap``/``pmap``/``shard_map`` (bare,
+  dotted, or wrapped in ``partial(jax.jit, ...)``), or
+* its NAME is passed to a tracing entry point in the same module
+  (``jax.jit(f)``, ``jax.vmap(f)``, ``jax.lax.scan(f, ...)``, ...), or
+* it is lexically nested inside a traced function (a ``step_fn``
+  defined inside a jitted body is traced with it).
+
+Anything the analyzer cannot prove traced is treated as host code —
+missed hazards are acceptable, false "host sync in hot path" noise on
+plain Python is not.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+# call/decorator heads that trace their function argument
+TRACING_ENTRY_POINTS = {
+    "jit", "vmap", "pmap", "shard_map", "checkpoint", "remat",
+    "scan", "while_loop", "fori_loop", "cond", "switch", "custom_jvp",
+    "custom_vjp", "grad", "value_and_grad",
+}
+# of those, the ones that take the traced callable as FIRST positional arg
+_CALLABLE_FIRST = TRACING_ENTRY_POINTS - {"fori_loop", "cond", "switch"}
+# names distinctive enough that ANY dotted/imported source counts as
+# tracing — this is what lets the analyzer see through local compat shims
+# like utils/backend.py::shard_map.  Generic names (scan, cond, checkpoint,
+# grad, ...) collide with ordinary host code and stay jax/flax/chex-only.
+_DISTINCTIVE_TAILS = {"jit", "vmap", "pmap", "shard_map"}
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """``jax.lax.scan`` -> "jax.lax.scan"; None for non-name expressions."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+@dataclass
+class ModuleContext:
+    path: str
+    source: str
+    tree: ast.Module
+    lines: list[str] = field(default_factory=list)
+    # import alias -> canonical dotted path ("jr" -> "jax.random")
+    aliases: dict[str, str] = field(default_factory=dict)
+    # function name -> def nodes with that name (module-wide, by name)
+    defs_by_name: dict[str, list[ast.AST]] = field(default_factory=dict)
+    # def nodes whose bodies are traced (see module docstring)
+    traced: set[ast.AST] = field(default_factory=set)
+    # def node -> enclosing qualname ("Engine._step.body")
+    qualnames: dict[ast.AST, str] = field(default_factory=dict)
+
+    def line_at(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def resolve(self, node: ast.AST) -> str | None:
+        """Canonical dotted path of a name/attribute expression, expanding
+        the module's import aliases: with ``import jax.random as jr``,
+        ``jr.split`` resolves to "jax.random.split"."""
+        dotted = dotted_name(node)
+        if dotted is None:
+            return None
+        head, _, rest = dotted.partition(".")
+        canon = self.aliases.get(head, head)
+        return canon + ("." + rest if rest else "")
+
+    def is_traced(self, fn: ast.AST) -> bool:
+        return fn in self.traced
+
+
+def _collect_aliases(tree: ast.Module, aliases: dict[str, str]) -> None:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.partition(".")[0]] = (
+                    a.name if a.asname else a.name.partition(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            # relative imports keep their dots ("..utils.backend.shard_map")
+            # — unresolvable to an absolute module, but enough for the
+            # distinctive-tail rule to see through in-repo shims
+            prefix = "." * node.level + (node.module or "")
+            for a in node.names:
+                aliases[a.asname or a.name] = (
+                    f"{prefix}.{a.name}" if prefix else a.name)
+
+
+_FN_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _is_tracing_head(ctx: ModuleContext, func: ast.AST) -> bool:
+    resolved = ctx.resolve(func)
+    if resolved is None:
+        return False
+    tail = resolved.rsplit(".", 1)[-1]
+    if tail not in TRACING_ENTRY_POINTS:
+        return False
+    # provably jax/flax/chex: `from jax import jit` arrives here as
+    # "jax.jit" via the alias map.  A BARE surviving name is a module-local
+    # helper that happens to be called scan/cond/checkpoint — treating it
+    # as tracing would flag pure host code (false R02/R03)
+    head = resolved.split(".", 1)[0]
+    if head in ("jax", "flax", "chex"):
+        return True
+    # distinctive tails (jit/vmap/pmap/shard_map) also count when they
+    # arrive through ANY import or dotted attribute — version-compat shims
+    # (`from ..utils.backend import shard_map`) must not blind the rules
+    # to the hot bodies they wrap
+    return tail in _DISTINCTIVE_TAILS and "." in resolved
+
+
+def _decorator_traces(ctx: ModuleContext, dec: ast.AST) -> bool:
+    if isinstance(dec, ast.Call):
+        # @partial(jax.jit, ...) / @jax.jit(static_argnums=...)
+        if _is_tracing_head(ctx, dec.func):
+            return True
+        head = ctx.resolve(dec.func)
+        if head is not None and head.rsplit(".", 1)[-1] == "partial":
+            return bool(dec.args) and _is_tracing_head(ctx, dec.args[0])
+        return False
+    return _is_tracing_head(ctx, dec)
+
+
+def build_context(path: str, source: str) -> ModuleContext:
+    tree = ast.parse(source, filename=path)
+    ctx = ModuleContext(path=path, source=source, tree=tree,
+                        lines=source.splitlines())
+    _collect_aliases(tree, ctx.aliases)
+
+    # ---- qualnames + defs_by_name ------------------------------------
+    def walk_defs(node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, _FN_NODES):
+                qn = f"{prefix}{child.name}"
+                ctx.qualnames[child] = qn
+                ctx.defs_by_name.setdefault(child.name, []).append(child)
+                walk_defs(child, qn + ".")
+            elif isinstance(child, ast.ClassDef):
+                walk_defs(child, f"{prefix}{child.name}.")
+            else:
+                walk_defs(child, prefix)
+
+    walk_defs(tree, "")
+
+    # ---- traced roots ------------------------------------------------
+    # lexical parent function of every node: name references at a tracing
+    # call site resolve against the call's enclosing scope chain, not
+    # module-wide — an unrelated host function that happens to share a
+    # closure name like `body`/`step_fn` must not become traced
+    parent_fn: dict[ast.AST, ast.AST | None] = {}
+
+    def walk_parents(node: ast.AST, fn: ast.AST | None) -> None:
+        for child in ast.iter_child_nodes(node):
+            parent_fn[child] = fn
+            walk_parents(child, child if isinstance(child, _FN_NODES) else fn)
+
+    walk_parents(tree, None)
+
+    def resolve_local_def(call: ast.Call, name: str) -> ast.AST | None:
+        chain = []
+        scope = parent_fn.get(call)
+        while scope is not None:
+            chain.append(scope)
+            scope = parent_fn.get(scope)
+        chain.append(None)  # module scope
+        candidates = ctx.defs_by_name.get(name, [])
+        for scope in chain:  # innermost enclosing scope wins
+            for fn in candidates:
+                if parent_fn.get(fn) is scope:
+                    return fn
+        return None
+
+    for fn in ctx.qualnames:
+        for dec in getattr(fn, "decorator_list", []):
+            if _decorator_traces(ctx, dec):
+                ctx.traced.add(fn)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_tracing_head(ctx, node.func):
+            resolved = ctx.resolve(node.func) or ""
+            if resolved.rsplit(".", 1)[-1] in _CALLABLE_FIRST:
+                cand = node.args[:1]
+            else:  # fori_loop/cond/switch: any callable argument
+                cand = list(node.args)
+            for arg in cand:
+                if isinstance(arg, ast.Name):
+                    fn = resolve_local_def(node, arg.id)
+                    if fn is not None:
+                        ctx.traced.add(fn)
+
+    # ---- propagate into lexically nested defs ------------------------
+    def mark_nested(fn: ast.AST) -> None:
+        for child in ast.walk(fn):
+            if child is not fn and isinstance(child, _FN_NODES):
+                ctx.traced.add(child)
+
+    for fn in list(ctx.traced):
+        mark_nested(fn)
+    return ctx

@@ -1,0 +1,186 @@
+"""esguard rule engine: registry, per-file driver, path expansion.
+
+A rule is a function ``(ModuleContext) -> Iterable[Finding]`` registered
+with :func:`rule`.  The driver parses each ``.py`` file once, builds one
+:class:`~estorch_tpu.analysis.context.ModuleContext`, and feeds it to
+every enabled rule — so adding a rule costs one function, not a new
+traversal pipeline.
+
+The engine itself never imports the analyzed code: everything is
+``ast``-level, runs on CPU in milliseconds, and is safe to point at
+modules whose import would grab an accelerator.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import os
+from dataclasses import dataclass
+from typing import Callable, Iterable, Iterator
+
+from .context import ModuleContext, build_context
+from .findings import Finding
+
+
+@dataclass(frozen=True)
+class Rule:
+    id: str  # "R01"
+    name: str  # "prng-key-reuse"
+    severity: str  # default severity for findings it emits
+    description: str
+    check: Callable[[ModuleContext], Iterable[Finding]]
+
+
+_REGISTRY: dict[str, Rule] = {}
+
+
+def rule(id: str, name: str, severity: str, description: str):
+    """Register ``check(ctx) -> Iterable[Finding]`` under a rule id."""
+
+    def deco(check: Callable[[ModuleContext], Iterable[Finding]]):
+        if id in _REGISTRY:
+            raise ValueError(f"duplicate rule id {id}")
+        _REGISTRY[id] = Rule(id, name, severity, description, check)
+        return check
+
+    return deco
+
+
+def all_rules() -> list[Rule]:
+    _load_builtin_rules()
+    return [_REGISTRY[k] for k in sorted(_REGISTRY)]
+
+
+def get_rule(rule_id: str) -> Rule:
+    _load_builtin_rules()
+    return _REGISTRY[rule_id]
+
+
+def _load_builtin_rules() -> None:
+    # import for side effect: each module registers its rules on import
+    from . import rules_host, rules_prng, rules_trace  # noqa: F401
+
+
+def _rebase(path: str) -> str:
+    """Cwd-relative spelling when the path lives under cwd, else as-is.
+    Findings, baseline identities, and exclude globs all see THIS form,
+    so `analysis /abs/repo/pkg` and `analysis pkg` (from the repo root)
+    exclude and suppress identically."""
+    rel = os.path.relpath(path)
+    return path if rel.startswith("..") else rel
+
+
+def iter_py_files(paths: Iterable[str],
+                  exclude: Iterable[str] = ()) -> Iterator[str]:
+    """Expand files/dirs to ``.py`` paths (cwd-relative where possible,
+    see :func:`_rebase`), skipping ``exclude`` globs (matched against the
+    normalized relative path AND its basename)."""
+    exclude = list(exclude)
+
+    def excluded(p: str) -> bool:
+        norm = _rebase(p).replace(os.sep, "/")
+        return any(
+            fnmatch.fnmatch(norm, pat) or fnmatch.fnmatch(
+                os.path.basename(norm), pat)
+            for pat in exclude
+        )
+
+    paths = [_rebase(p) for p in paths]
+    for path in paths:
+        if os.path.isfile(path):
+            if path.endswith(".py") and not excluded(path):
+                yield path
+        elif os.path.isdir(path):
+            for root, dirs, files in os.walk(path):
+                dirs[:] = sorted(
+                    d for d in dirs
+                    if d != "__pycache__"
+                    and not excluded(os.path.join(root, d)))
+                for f in sorted(files):
+                    full = os.path.join(root, f)
+                    if f.endswith(".py") and not excluded(full):
+                        yield full
+
+
+def analyze_source(path: str, source: str,
+                   rules: Iterable[Rule] | None = None) -> list[Finding]:
+    """Run rules over one module's source.  Syntax errors become a single
+    parse-error finding instead of aborting the whole run."""
+    if rules is None:
+        rules = all_rules()
+    try:
+        ctx = build_context(path, source)
+    except SyntaxError as e:
+        return [Finding(
+            rule="R00", file=path, line=e.lineno or 0, col=e.offset or 0,
+            severity="error", message=f"file does not parse: {e.msg}",
+            hint="fix the syntax error; esguard skipped this file",
+            symbol="<module>", snippet=(e.text or "").strip(),
+        )]
+    findings: list[Finding] = []
+    for r in rules:
+        findings.extend(r.check(ctx))
+    return findings
+
+
+def analyze_paths(paths: Iterable[str],
+                  rules: Iterable[Rule] | None = None,
+                  exclude: Iterable[str] = ()) -> list[Finding]:
+    if rules is None:
+        rules = all_rules()
+    rules = list(rules)
+    findings: list[Finding] = []
+    for path in iter_py_files(paths, exclude):
+        with open(path, encoding="utf-8") as fh:
+            findings.extend(analyze_source(path, fh.read(), rules))
+    return findings
+
+
+# ---------------------------------------------------------------------
+# shared helpers for the rule modules
+# ---------------------------------------------------------------------
+
+def enclosing_defs(tree: ast.Module) -> dict[ast.AST, ast.AST | None]:
+    """node -> nearest enclosing function def (None at module level)."""
+    parent_fn: dict[ast.AST, ast.AST | None] = {}
+
+    def walk(node: ast.AST, fn: ast.AST | None) -> None:
+        for child in ast.iter_child_nodes(node):
+            parent_fn[child] = fn
+            walk(child, child if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef)) else fn)
+
+    walk(tree, None)
+    return parent_fn
+
+
+def scope_nodes(scope: ast.AST):
+    """Nodes belonging to one function (or module) scope: walks the body
+    without descending into nested function defs, so a rule iterating
+    per-scope never double-reports a nested function's body."""
+    stack = list(ast.iter_child_nodes(scope))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def iter_scopes(ctx: ModuleContext):
+    """All (symbol, scope_node) pairs: the module plus every function."""
+    yield "<module>", ctx.tree
+    for fn, qualname in ctx.qualnames.items():
+        yield qualname, fn
+
+
+def make_finding(ctx: ModuleContext, rule_: Rule, node: ast.AST,
+                 message: str, hint: str, symbol: str,
+                 severity: str | None = None) -> Finding:
+    line = getattr(node, "lineno", 0)
+    return Finding(
+        rule=rule_.id, file=ctx.path, line=line,
+        col=getattr(node, "col_offset", 0),
+        severity=severity or rule_.severity, message=message, hint=hint,
+        symbol=symbol, snippet=ctx.line_at(line),
+    )

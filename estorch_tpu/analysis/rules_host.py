@@ -1,0 +1,181 @@
+"""Host-side robustness rules: R05 untimed-subprocess-wait,
+R06 signature-probe-default.
+
+R05 is the wedge class ``doctor.py`` exists to detect after the fact:
+a ``proc.wait()`` / ``proc.communicate()`` with no timeout turns a hung
+child into a hung training job — on a TPU pod that's a wedged tunnel
+window, not a stack trace.  Every wait on a subprocess must bound its
+patience and escalate (kill, requeue, raise) itself.
+
+R06 is the bug family from rollout's ``_ci_takes_params``: when
+``inspect.signature`` fails on an exotic callable, falling back to a
+*guessed* constant silently picks a calling convention; the wrong guess
+crashes at trace time far from the cause.  The fallback must PROBE
+(call the zero-arg form under ``except TypeError``) instead of guessing.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .context import ModuleContext
+from .engine import get_rule, iter_scopes, make_finding, rule, scope_nodes
+
+# ---------------------------------------------------------------------
+# R05 untimed-subprocess-wait
+# ---------------------------------------------------------------------
+
+_PROC_CTORS = {"subprocess.Popen", "multiprocessing.Process"}
+# one-shot helpers in the same hazard class: block until the child exits
+_RUN_HELPERS = {"subprocess.run", "subprocess.call", "subprocess.check_call",
+                "subprocess.check_output"}
+_PROCISH_NAME = re.compile(r"(^|_)(proc|process|popen|child)(es|s)?($|_)",
+                           re.IGNORECASE)
+
+
+def _has_timeout(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg == "timeout":
+            return not (isinstance(kw.value, ast.Constant)
+                        and kw.value.value is None)
+    # Popen.wait(timeout) may be positional; communicate(input, timeout)
+    # positional timeout is arg index 1
+    if isinstance(call.func, ast.Attribute):
+        if call.func.attr == "wait" and len(call.args) >= 1:
+            return True
+        if call.func.attr == "communicate" and len(call.args) >= 2:
+            return True
+    return False
+
+
+def _receiver_tail(func: ast.Attribute) -> str | None:
+    """Last name component of the receiver: `self.proc.wait` -> "proc"."""
+    base = func.value
+    if isinstance(base, ast.Attribute):
+        return base.attr
+    if isinstance(base, ast.Name):
+        return base.id
+    return None
+
+
+@rule("R05", "untimed-subprocess-wait", "error",
+      "subprocess wait/communicate without a timeout can wedge the host")
+def check_untimed_wait(ctx: ModuleContext):
+    r = get_rule("R05")
+    out = []
+    for symbol, scope in iter_scopes(ctx):
+        proc_names: set[str] = set()
+        # pass 1: names bound from Popen/Process constructors in this scope
+        for node in scope_nodes(scope):
+            if (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)):
+                resolved = ctx.resolve(node.value.func)
+                tail = (resolved or "").rsplit(".", 1)[-1]
+                if resolved in _PROC_CTORS or tail == "Popen":
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            proc_names.add(tgt.id)
+        # pass 2: unbounded waits on those names (or proc-ish receivers)
+        for node in scope_nodes(scope):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = ctx.resolve(node.func)
+            if resolved in _RUN_HELPERS and not any(
+                    kw.arg == "timeout"
+                    and not (isinstance(kw.value, ast.Constant)
+                             and kw.value.value is None)
+                    for kw in node.keywords):
+                out.append(make_finding(
+                    ctx, r, node,
+                    f"`{resolved}` without timeout — a hung child wedges "
+                    "this host forever",
+                    "pass timeout=... and handle "
+                    "subprocess.TimeoutExpired",
+                    symbol))
+                continue
+            if not isinstance(node.func, ast.Attribute):
+                continue
+            method = node.func.attr
+            if method not in ("wait", "communicate"):
+                continue
+            if _has_timeout(node):
+                continue
+            tail = _receiver_tail(node.func)
+            known = (isinstance(node.func.value, ast.Name)
+                     and node.func.value.id in proc_names)
+            procish = tail is not None and _PROCISH_NAME.search(tail)
+            # bare `.communicate()` is Popen-specific; `.wait()` needs a
+            # proc-ish receiver so DMA/thread/event waits stay quiet
+            if not (known or procish or method == "communicate"):
+                continue
+            out.append(make_finding(
+                ctx, r, node,
+                f"`.{method}()` without timeout — a hung child wedges "
+                "this host forever",
+                f"call `.{method}(timeout=...)` and kill/escalate on "
+                "subprocess.TimeoutExpired",
+                symbol))
+    return out
+
+
+# ---------------------------------------------------------------------
+# R06 signature-probe-default
+# ---------------------------------------------------------------------
+
+def _calls_signature(ctx: ModuleContext, stmts: list[ast.stmt]) -> bool:
+    for stmt in stmts:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call):
+                resolved = ctx.resolve(node.func)
+                if resolved in ("inspect.signature",
+                                "inspect.getfullargspec"):
+                    return True
+    return False
+
+
+def _guessing_assign(handler: ast.ExceptHandler) -> ast.stmt | None:
+    """The handler's constant-assignment, when the handler does nothing
+    but guess (assignments of constants, pass, or a comment)."""
+    guess: ast.stmt | None = None
+    for stmt in handler.body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if (isinstance(stmt, ast.Assign)
+                and isinstance(stmt.value, ast.Constant)):
+            guess = guess or stmt
+            continue
+        if (isinstance(stmt, ast.AnnAssign)
+                and isinstance(stmt.value, ast.Constant)):
+            guess = guess or stmt
+            continue
+        return None  # handler does real work (probes, raises, logs...)
+    return guess
+
+
+@rule("R06", "signature-probe-default", "warning",
+      "inspect.signature failure falls back to a guessed constant")
+def check_signature_probe(ctx: ModuleContext):
+    r = get_rule("R06")
+    parent_symbol = {}
+    for symbol, scope in iter_scopes(ctx):
+        for node in scope_nodes(scope):
+            parent_symbol[node] = symbol
+    out = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Try):
+            continue
+        if not _calls_signature(ctx, node.body):
+            continue
+        for handler in node.handlers:
+            guess = _guessing_assign(handler)
+            if guess is None:
+                continue
+            out.append(make_finding(
+                ctx, r, guess,
+                "signature introspection failed and the fallback GUESSES "
+                "a calling convention",
+                "probe once at build time instead: call the zero-arg form "
+                "under `except TypeError` and record which form worked",
+                parent_symbol.get(node, "<module>")))
+    return out

@@ -1,0 +1,248 @@
+"""R01 prng-key-reuse: one PRNG key, at most one consuming random op.
+
+The ES correctness contract (Salimans et al. 2017 mirrored sampling, and
+this repo's offset-derivation scheme) depends on every ``jax.random``
+consumer seeing a distinct key: feeding the same key to two consuming
+ops makes their "independent" noise identical, which silently breaks
+antithetic pairs and cross-member independence without any exception.
+
+The rule runs a small per-function abstract interpretation:
+
+* a name becomes a TRACKED key when assigned from ``PRNGKey``/``key``/
+  ``split``/``fold_in`` (tuple unpacking of ``split`` included) or when
+  it is a parameter with a key-ish name (``key``, ``rng``, ...);
+* a consuming ``jax.random.*`` call (``split``, ``normal``, ``uniform``,
+  anything except the constructors and ``fold_in``) marks its key
+  argument USED — a second consumption without re-assignment is the
+  finding;
+* passing a tracked key to any non-``jax.random`` call forfeits
+  tracking (ownership moved to the callee — the callee is analyzed on
+  its own), keeping helper-function plumbing quiet;
+* loop bodies are interpreted twice, so a key created outside a loop
+  and consumed inside it (the classic "same noise every iteration" bug)
+  is caught even though each textual consumption appears once.
+
+``fold_in`` is a deriver, not a consumer: ``fold_in(key, i)`` inside a
+loop is the idiomatic per-iteration stream and must stay clean.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .context import ModuleContext
+from .engine import get_rule, make_finding, rule
+
+# constructors / derivers: produce keys, never flagged as consumption
+_PRODUCER_TAILS = {"PRNGKey", "key", "wrap_key_data", "fold_in", "clone"}
+_KEY_PARAM_RE = re.compile(
+    r"^(key|rng|rng_key|prng_key|prngkey|subkey|sub_key|random_key)$")
+
+
+def _random_call_tail(ctx: ModuleContext, call: ast.Call) -> str | None:
+    """'split' for a call resolving under jax.random, else None."""
+    resolved = ctx.resolve(call.func)
+    if resolved is None:
+        return None
+    head, _, tail = resolved.rpartition(".")
+    if head in ("jax.random", "jax._src.random") or (
+            head.endswith(".random") and head.startswith("jax")):
+        return tail
+    return None
+
+
+def _names_in(node: ast.AST):
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            yield sub
+
+
+class _Interp:
+    """Linear abstract interpreter over one function body."""
+
+    def __init__(self, ctx: ModuleContext, symbol: str, out: list):
+        self.ctx = ctx
+        self.symbol = symbol
+        self.out = out
+        self.seen: set[tuple[int, str]] = set()  # dedup (line, name)
+        # name -> mutable status cell (["fresh"] / ["used"]); aliases share
+        self.state: dict[str, list[str]] = {}
+
+    # ---- events ------------------------------------------------------
+
+    def _flag(self, node: ast.Call, name: str) -> None:
+        if (node.lineno, name) in self.seen:
+            return
+        self.seen.add((node.lineno, name))
+        r = get_rule("R01")
+        self.out.append(make_finding(
+            self.ctx, r, node,
+            f"PRNG key `{name}` already consumed by an earlier random op",
+            f"split first: `{name}, sub = jax.random.split({name})` and "
+            "consume the fresh half",
+            self.symbol,
+        ))
+
+    def _consume(self, call: ast.Call, arg: ast.AST) -> None:
+        if isinstance(arg, ast.Name) and arg.id in self.state:
+            cell = self.state[arg.id]
+            if cell[0] == "used":
+                self._flag(call, arg.id)
+            cell[0] = "used"
+
+    def _forfeit(self, node: ast.AST) -> None:
+        """Untrack keys handed DIRECTLY to an unknown callee (the callee
+        owns them now).  Names inside nested calls stay tracked — in
+        ``outs.append(normal(key))`` the key was consumed by ``normal``,
+        not given away to ``append``."""
+        if isinstance(node, ast.Name):
+            self.state.pop(node.id, None)
+        elif isinstance(node, (ast.Tuple, ast.List, ast.Set, ast.Starred)):
+            for child in ast.iter_child_nodes(node):
+                self._forfeit(child)
+
+    # ---- expressions -------------------------------------------------
+
+    def eval_expr(self, node: ast.AST) -> None:
+        """Post-order walk emitting consume/forfeit events for calls."""
+        if isinstance(node, ast.Call):
+            for child in ast.iter_child_nodes(node):
+                if child is not node.func:
+                    self.eval_expr(child)
+            tail = _random_call_tail(self.ctx, node)
+            if tail is not None:
+                if tail not in _PRODUCER_TAILS:
+                    key_arg = node.args[0] if node.args else None
+                    for kw in node.keywords:
+                        if kw.arg == "key":
+                            key_arg = kw.value
+                    if key_arg is not None:
+                        self._consume(node, key_arg)
+            else:
+                # unknown callee: it now owns any key we hand it
+                for arg in node.args:
+                    self._forfeit(arg)
+                for kw in node.keywords:
+                    self._forfeit(kw.value)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.Lambda)):
+            return  # separate scope; analyzed on its own
+        else:
+            for child in ast.iter_child_nodes(node):
+                self.eval_expr(child)
+
+    # ---- statements --------------------------------------------------
+
+    def _bind_targets(self, targets: list[ast.AST], value: ast.AST) -> None:
+        producing = (isinstance(value, ast.Call)
+                     and (_random_call_tail(self.ctx, value) is not None))
+        for tgt in targets:
+            if isinstance(tgt, ast.Name):
+                if producing:
+                    self.state[tgt.id] = ["fresh"]
+                elif isinstance(value, ast.Name) and value.id in self.state:
+                    self.state[tgt.id] = self.state[value.id]  # alias
+                else:
+                    self.state.pop(tgt.id, None)
+            elif isinstance(tgt, (ast.Tuple, ast.List)):
+                for el in tgt.elts:
+                    if isinstance(el, ast.Name):
+                        if producing:
+                            self.state[el.id] = ["fresh"]
+                        else:
+                            self.state.pop(el.id, None)
+                    elif isinstance(el, ast.Starred) and isinstance(
+                            el.value, ast.Name):
+                        self.state.pop(el.value.id, None)
+
+    def exec_block(self, stmts: list[ast.stmt]) -> None:
+        for stmt in stmts:
+            self.exec_stmt(stmt)
+
+    def _snapshot(self) -> dict[str, list[str]]:
+        return {k: list(v) for k, v in self.state.items()}
+
+    def _merge(self, a: dict[str, list[str]],
+               b: dict[str, list[str]]) -> None:
+        merged: dict[str, list[str]] = {}
+        for name in set(a) & set(b):
+            # differing branch outcomes: assume the consuming path ran
+            merged[name] = ["used" if "used" in (a[name][0], b[name][0])
+                            else "fresh"]
+        self.state = merged
+
+    def exec_stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, ast.Assign):
+            self.eval_expr(stmt.value)
+            self._bind_targets(stmt.targets, stmt.value)
+        elif isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self.eval_expr(stmt.value)
+                self._bind_targets([stmt.target], stmt.value)
+        elif isinstance(stmt, ast.AugAssign):
+            self.eval_expr(stmt.value)
+            if isinstance(stmt.target, ast.Name):
+                self.state.pop(stmt.target.id, None)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self.eval_expr(stmt.iter)
+            if isinstance(stmt.target, ast.Name):
+                self.state.pop(stmt.target.id, None)
+            # two passes: catches out-of-loop keys consumed every iteration
+            self.exec_block(stmt.body)
+            self.exec_block(stmt.body)
+            self.exec_block(stmt.orelse)
+        elif isinstance(stmt, ast.While):
+            self.eval_expr(stmt.test)
+            self.exec_block(stmt.body)
+            self.exec_block(stmt.body)
+            self.exec_block(stmt.orelse)
+        elif isinstance(stmt, ast.If):
+            self.eval_expr(stmt.test)
+            before = self._snapshot()
+            self.exec_block(stmt.body)
+            after_body = self._snapshot()
+            self.state = before
+            self.exec_block(stmt.orelse)
+            self._merge(after_body, self._snapshot())
+        elif isinstance(stmt, ast.Try):
+            self.exec_block(stmt.body)
+            for handler in stmt.handlers:
+                self.exec_block(handler.body)
+            self.exec_block(stmt.orelse)
+            self.exec_block(stmt.finalbody)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self.eval_expr(item.context_expr)
+            self.exec_block(stmt.body)
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+            return  # separate scope
+        elif isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                self.eval_expr(stmt.value)
+        elif isinstance(stmt, ast.Expr):
+            self.eval_expr(stmt.value)
+        else:
+            for child in ast.iter_child_nodes(stmt):
+                self.eval_expr(child)
+
+
+@rule("R01", "prng-key-reuse", "error",
+      "the same PRNG key is consumed by more than one random op")
+def check_prng_reuse(ctx: ModuleContext):
+    out: list = []
+    scopes: list[tuple[str, list[ast.stmt], list[str]]] = [
+        ("<module>", ctx.tree.body, [])]
+    for fn, qualname in ctx.qualnames.items():
+        args = fn.args
+        params = [a.arg for a in (
+            args.posonlyargs + args.args + args.kwonlyargs)]
+        key_params = [p for p in params if _KEY_PARAM_RE.match(p)]
+        scopes.append((qualname, fn.body, key_params))
+    for symbol, body, key_params in scopes:
+        interp = _Interp(ctx, symbol, out)
+        for p in key_params:
+            interp.state[p] = ["fresh"]
+        interp.exec_block(body)
+    return out

@@ -1,0 +1,299 @@
+"""Trace-time rules: R02 host-sync-in-hot-path, R03 impure-jit,
+R04 missing-donation.
+
+All three key off the traced-function set computed in
+:mod:`~estorch_tpu.analysis.context`: code the module can prove runs
+under ``jit``/``vmap``/``pmap``/``shard_map``/``lax.scan``.  Host code
+is never flagged by R02/R03 — ``float(x)`` in a logging helper is fine;
+the same call inside a jitted body either retraces per value or drags a
+device sync into the hot path, which is exactly the throughput leak the
+hyperscale-ES setting cannot afford.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .context import ModuleContext
+from .engine import get_rule, make_finding, rule, scope_nodes
+
+_FN_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+# ---------------------------------------------------------------------
+# R02 host-sync-in-hot-path
+# ---------------------------------------------------------------------
+
+_SYNC_METHODS = {"item", "tolist", "block_until_ready", "numpy"}
+_SYNC_CALLS = {  # resolved dotted names that materialize on host
+    "numpy.array", "numpy.asarray", "numpy.asanyarray", "numpy.copy",
+    "jax.device_get",
+}
+_CAST_BUILTINS = {"float", "int", "bool", "complex"}
+_STATIC_ATTRS = {"shape", "ndim", "size", "dtype"}  # trace-time constants
+
+
+def _is_static_expr(node: ast.AST) -> bool:
+    """``x.shape[0]``-style expressions are Python ints at trace time —
+    casting them is shape arithmetic, not a host sync."""
+    if isinstance(node, ast.Subscript):
+        return _is_static_expr(node.value)
+    if isinstance(node, ast.Attribute):
+        return node.attr in _STATIC_ATTRS
+    if isinstance(node, ast.BinOp):
+        return _is_static_expr(node.left) and _is_static_expr(node.right)
+    if isinstance(node, ast.Call):  # len(x.shape), min(x.shape, ...)
+        res = node.func
+        return (isinstance(res, ast.Name)
+                and res.id in ("len", "min", "max", "prod")
+                and all(_is_static_expr(a) or isinstance(a, ast.Constant)
+                        for a in node.args))
+    return isinstance(node, ast.Constant)
+
+
+def _touches_traced_value(node: ast.AST) -> bool:
+    """Whether a cast argument references any plain name other than
+    ``self``/``cls`` — ``float(self.config.clip)`` reads static Python
+    config and is fine; ``float(loss)`` concretizes traced data."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id not in ("self", "cls"):
+            return True
+    return False
+
+
+def _traced_fns(ctx: ModuleContext):
+    for fn, qualname in ctx.qualnames.items():
+        if ctx.is_traced(fn):
+            yield fn, qualname
+
+
+@rule("R02", "host-sync-in-hot-path", "error",
+      "host synchronization inside jit/vmap/scan-traced code")
+def check_host_sync(ctx: ModuleContext):
+    r = get_rule("R02")
+    out = []
+    for fn, qualname in _traced_fns(ctx):
+        for node in scope_nodes(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if (isinstance(func, ast.Attribute)
+                    and func.attr in _SYNC_METHODS and not node.args):
+                out.append(make_finding(
+                    ctx, r, node,
+                    f"`.{func.attr}()` forces a host sync inside traced "
+                    "code",
+                    "keep values on device; move host reads outside the "
+                    "jitted region",
+                    qualname))
+                continue
+            resolved = ctx.resolve(func)
+            if resolved in _SYNC_CALLS:
+                out.append(make_finding(
+                    ctx, r, node,
+                    f"`{resolved}` materializes a device value on host "
+                    "inside traced code",
+                    "use jnp inside traced code; convert to numpy only "
+                    "after the jitted call returns",
+                    qualname))
+                continue
+            if (resolved in _CAST_BUILTINS and len(node.args) == 1
+                    and not isinstance(node.args[0], ast.Constant)
+                    and not _is_static_expr(node.args[0])
+                    and _touches_traced_value(node.args[0])):
+                out.append(make_finding(
+                    ctx, r, node,
+                    f"`{resolved}(...)` on a traced value concretizes it "
+                    "(host sync or ConcretizationTypeError)",
+                    "keep it as a jax scalar, or hoist the cast out of "
+                    "the traced function",
+                    qualname, severity="warning"))
+    return out
+
+
+# ---------------------------------------------------------------------
+# R03 impure-jit
+# ---------------------------------------------------------------------
+
+_IMPURE_CALLS = {
+    "time.time", "time.perf_counter", "time.monotonic", "time.sleep",
+    "datetime.datetime.now", "datetime.datetime.utcnow", "builtins.open",
+    "open", "input",
+}
+
+
+def _is_impure_call(resolved: str | None) -> str | None:
+    if resolved is None:
+        return None
+    if resolved in _IMPURE_CALLS:
+        return resolved
+    if resolved == "print":
+        return "print"
+    head = resolved.rsplit(".", 1)[0]
+    if head in ("numpy.random", "random"):
+        return resolved
+    return None
+
+
+def _local_bindings(fn: ast.AST) -> set[str]:
+    args = fn.args
+    bound = {a.arg for a in (args.posonlyargs + args.args
+                             + args.kwonlyargs)}
+    if args.vararg:
+        bound.add(args.vararg.arg)
+    if args.kwarg:
+        bound.add(args.kwarg.arg)
+    for node in scope_nodes(fn):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            bound.add(node.id)
+        elif isinstance(node, _FN_NODES):
+            bound.add(node.name)
+        elif isinstance(node, ast.ExceptHandler) and node.name:
+            bound.add(node.name)
+    return bound
+
+
+@rule("R03", "impure-jit", "error",
+      "side effect or hidden host state inside jit-traced code")
+def check_impure_jit(ctx: ModuleContext):
+    r = get_rule("R03")
+    out = []
+    for fn, qualname in _traced_fns(ctx):
+        local = _local_bindings(fn)
+        for node in scope_nodes(fn):
+            if isinstance(node, ast.Call):
+                impure = _is_impure_call(ctx.resolve(node.func))
+                if impure is not None:
+                    what = ("runs once at trace time, not per step"
+                            if impure == "print"
+                            else "is host state the trace bakes in")
+                    out.append(make_finding(
+                        ctx, r, node,
+                        f"`{impure}` under jit {what}",
+                        "use jax.debug.print / jax.random inside traced "
+                        "code; do host I/O outside the jitted region",
+                        qualname))
+            elif isinstance(node, (ast.Global, ast.Nonlocal)):
+                out.append(make_finding(
+                    ctx, r, node,
+                    f"`{'global' if isinstance(node, ast.Global) else 'nonlocal'}"
+                    f" {', '.join(node.names)}` mutated under jit only "
+                    "mutates at trace time",
+                    "thread the value through the function's inputs and "
+                    "outputs instead",
+                    qualname))
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for tgt in targets:
+                    base = tgt
+                    while isinstance(base, (ast.Attribute, ast.Subscript)):
+                        base = base.value
+                    if (tgt is not base and isinstance(base, ast.Name)
+                            and base.id not in local
+                            and base.id not in ctx.aliases):
+                        out.append(make_finding(
+                            ctx, r, node,
+                            f"mutation of closed-over `{base.id}` under "
+                            "jit happens at trace time only",
+                            "return the updated value from the traced "
+                            "function instead of mutating the closure",
+                            qualname))
+    return out
+
+
+# ---------------------------------------------------------------------
+# R04 missing-donation
+# ---------------------------------------------------------------------
+
+_STATEFUL_PARAMS = {
+    "params", "state", "opt_state", "optimizer_state", "theta", "weights",
+    "params_flat", "es_state",
+}
+_NEW_PREFIXES = ("new_", "next_", "updated_")
+
+
+def _donates(kwargs: list[ast.keyword]) -> bool:
+    return any(kw.arg in ("donate_argnums", "donate_argnames")
+               for kw in kwargs)
+
+
+def _jit_head(ctx: ModuleContext, node: ast.AST) -> bool:
+    resolved = ctx.resolve(node)
+    return resolved is not None and resolved.rsplit(".", 1)[-1] == "jit"
+
+
+def _jitted_without_donation(ctx: ModuleContext):
+    """Yield (def_node, report_node) for jit applications lacking
+    donate_argnums: decorator form and ``jax.jit(fname)`` call form."""
+    for fn in ctx.qualnames:
+        for dec in getattr(fn, "decorator_list", []):
+            if isinstance(dec, ast.Call):
+                head = ctx.resolve(dec.func)
+                is_partial = (head is not None
+                              and head.rsplit(".", 1)[-1] == "partial")
+                if is_partial and dec.args and _jit_head(ctx, dec.args[0]):
+                    if not _donates(dec.keywords):
+                        yield fn, fn
+                elif _jit_head(ctx, dec.func) and not _donates(dec.keywords):
+                    yield fn, fn
+            elif _jit_head(ctx, dec):
+                yield fn, fn
+    for node in ast.walk(ctx.tree):
+        if (isinstance(node, ast.Call) and _jit_head(ctx, node.func)
+                and not _donates(node.keywords)
+                and node.args and isinstance(node.args[0], ast.Name)):
+            for fn in ctx.defs_by_name.get(node.args[0].id, []):
+                yield fn, node
+
+
+def _updates_stateful(fn: ast.AST) -> str | None:
+    """Param name when fn takes AND returns a params/opt-state pytree."""
+    args = fn.args
+    params = {a.arg for a in (args.posonlyargs + args.args
+                              + args.kwonlyargs)}
+    stateful = params & _STATEFUL_PARAMS
+    if not stateful:
+        return None
+    returned: set[str] = set()
+    for node in scope_nodes(fn):
+        if isinstance(node, ast.Return) and node.value is not None:
+            vals = (node.value.elts
+                    if isinstance(node.value, (ast.Tuple, ast.List))
+                    else [node.value])
+            for v in vals:
+                if isinstance(v, ast.Name):
+                    returned.add(v.id)
+    for p in stateful:
+        if p in returned:
+            return p
+        for pre in _NEW_PREFIXES:
+            if f"{pre}{p}" in returned:
+                return p
+        if {f"{p}_new", f"{p}_next"} & returned:
+            return p
+    return None
+
+
+@rule("R04", "missing-donation", "info",
+      "jitted update takes and returns a state pytree without donation")
+def check_missing_donation(ctx: ModuleContext):
+    r = get_rule("R04")
+    out = []
+    seen: set[tuple[ast.AST, int]] = set()
+    for fn, report in _jitted_without_donation(ctx):
+        param = _updates_stateful(fn)
+        if param is None:
+            continue
+        key = (fn, getattr(report, "lineno", 0))
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append(make_finding(
+            ctx, r, report,
+            f"jitted `{ctx.qualnames[fn]}` takes and returns `{param}` "
+            "without donate_argnums — the old buffer stays live through "
+            "the update",
+            f"pass donate_argnums for `{param}` (safe when the caller "
+            "drops the old value, as update loops do)",
+            ctx.qualnames[fn]))
+    return out

@@ -14,10 +14,35 @@ genuinely-alive steps for honest env-steps/sec accounting.
 
 from __future__ import annotations
 
+import inspect
 from typing import Any, Callable, NamedTuple
 
 import jax
 import jax.numpy as jnp
+
+
+def carry_init_takes_params(carry_init: Callable[..., Any]) -> bool:
+    """Whether ``carry_init`` is the params-aware form (``carry_init(params)
+    -> carry``, the learned episode-start carry of models/policies.py) or
+    the historical zero-arg form (``carry_init() -> carry``).
+
+    Detected ONCE at build time and shared by every consumer of the compat
+    contract (make_rollout, the engine's bf16 carry wrapper, ES.predict) so
+    the two forms can never diverge between code paths.  When
+    ``inspect.signature`` cannot introspect the callable, the form is
+    PROBED — the zero-arg call is attempted under ``except TypeError`` —
+    instead of guessed, so a non-introspectable zero-arg callable works
+    rather than crashing at trace time with an unexpected argument.
+    """
+    try:
+        return bool(inspect.signature(carry_init).parameters)
+    except (TypeError, ValueError):
+        pass
+    try:
+        carry_init()
+        return False
+    except TypeError:
+        return True
 
 
 class RolloutResult(NamedTuple):
@@ -79,13 +104,7 @@ def make_rollout(
         # carry_init may be the historical zero-arg form (custom user
         # callables) or the params-aware form (learned episode-start
         # carry, models/policies.py) — detect once at build time
-        import inspect
-
-        try:
-            _ci_takes_params = bool(
-                inspect.signature(carry_init).parameters)
-        except (TypeError, ValueError):
-            _ci_takes_params = True
+        _ci_takes_params = carry_init_takes_params(carry_init)
     if with_env_metrics and with_obs_moments:
         raise ValueError("one aux channel per rollout: obs moments are the "
                          "training probe, env metrics the evaluation one")

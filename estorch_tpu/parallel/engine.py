@@ -36,7 +36,8 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..envs.rollout import make_obs_probe, make_rollout
+from ..envs.rollout import carry_init_takes_params, make_obs_probe, make_rollout
+from ..utils.backend import shard_map
 from ..ops.gradient import es_gradient, rank_weighted_noise_sum
 from ..ops.noise import NoiseTable, member_offsets, pair_signs, sample_pair_offsets
 from ..ops.params import ParamSpec
@@ -393,10 +394,14 @@ class ESEngine:
                 policy_apply = _bf16_io_apply_stateful(policy_apply)
                 # cast the episode-start carry ONCE so the scan carry dtype
                 # is bf16 throughout (a f32 init would flip dtypes between
-                # scan iterations)
+                # scan iterations); forward params only to the params-aware
+                # form — the legacy zero-arg form (still supported by
+                # make_rollout's detection) must keep working under bf16
                 base_carry_init = carry_init
+                _ci_takes_params = carry_init_takes_params(base_carry_init)
                 carry_init = lambda params=None: _cast_leaves(
-                    base_carry_init(params), jnp.bfloat16)
+                    base_carry_init(params) if _ci_takes_params
+                    else base_carry_init(), jnp.bfloat16)
             else:
                 policy_apply = _bf16_io_apply(policy_apply)
         self._carry_init = carry_init
@@ -522,7 +527,7 @@ class ESEngine:
         # All inputs/outputs are fully replicated (P()); the population axis
         # only exists INSIDE the program (axis_index-derived shards).
         self._generation_step = jax.jit(
-            jax.shard_map(
+            shard_map(
                 self._generation_body,
                 mesh=mesh,
                 in_specs=(P(),),
@@ -532,7 +537,7 @@ class ESEngine:
         )
         # split path: evaluate, then apply host-computed weights
         self._evaluate = jax.jit(
-            jax.shard_map(
+            shard_map(
                 self._evaluate_body,
                 mesh=mesh,
                 in_specs=(P(),),
@@ -556,7 +561,7 @@ class ESEngine:
 
     def _build_update_programs(self):
         self._apply_weights = jax.jit(
-            jax.shard_map(
+            shard_map(
                 self._apply_weights_body,
                 mesh=self.mesh,
                 in_specs=(P(), P()),
@@ -1049,7 +1054,7 @@ class ESEngine:
                 )
 
             self._noise_stats_progs[cache_n] = jax.jit(
-                jax.shard_map(
+                shard_map(
                     body, mesh=self.mesh, in_specs=(P(), P()),
                     out_specs=(P(), P()), check_vma=False,
                 )
@@ -1106,7 +1111,7 @@ class ESEngine:
                 return self._finish_update(state, grad_ascent)
 
             self._apply_weights_reuse_progs[cache_key] = jax.jit(
-                jax.shard_map(
+                shard_map(
                     body, mesh=self.mesh,
                     in_specs=(P(), P(), P(), P(), P(), P()),
                     out_specs=(P(), P()),

@@ -1,4 +1,5 @@
-from .backend import enable_compilation_cache, force_cpu_backend
+from .backend import (enable_compilation_cache, force_cpu_backend,
+                      set_host_device_count_flag)
 from .checkpoint import PeriodicCheckpointer, restore_checkpoint, save_checkpoint
 from .fault import mask_and_renormalize, rank_weights_with_failures, valid_mask
 from .metrics import JsonlWriter, MultiWriter, TensorBoardWriter
@@ -7,6 +8,7 @@ from .profiler import annotate, timed_generations, trace
 __all__ = [
     "enable_compilation_cache",
     "force_cpu_backend",
+    "set_host_device_count_flag",
     "PeriodicCheckpointer",
     "restore_checkpoint",
     "save_checkpoint",

@@ -75,6 +75,43 @@ def _reset_live_cache() -> None:
         pass
 
 
+def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True):
+    """Version-portable ``shard_map``: new jax exposes ``jax.shard_map``
+    (replication check kwarg ``check_vma``); 0.4.x only has
+    ``jax.experimental.shard_map.shard_map`` with the same check spelled
+    ``check_rep``.  Single shim so call sites never branch on version."""
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=check_vma)
+
+
+def set_host_device_count_flag(n_devices: int) -> None:
+    """Set (or REPLACE) ``--xla_force_host_platform_device_count`` in
+    ``XLA_FLAGS`` — the version-portable spelling of
+    ``jax_num_cpu_devices``.  Only effective before the backend
+    initializes.  Replacing an existing value matters: inheriting a
+    different count from the environment silently changes the mesh the
+    8-device sharding tests assert on."""
+    import os
+    import re
+
+    n = max(int(n_devices), 1)
+    flag = f"--xla_force_host_platform_device_count={n}"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" in flags:
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+", flag, flags)
+    else:
+        flags = (flags + " " + flag).strip()
+    os.environ["XLA_FLAGS"] = flags
+
+
 def force_cpu_backend(n_devices: int = 8) -> bool:
     """Best-effort switch to the CPU backend with ``n_devices`` virtual
     devices.  Returns True if the config took; False if the backend was
@@ -83,7 +120,14 @@ def force_cpu_backend(n_devices: int = 8) -> bool:
 
     try:
         jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", max(int(n_devices), 1))
-        return True
     except Exception:
         return False
+    try:
+        jax.config.update("jax_num_cpu_devices", max(int(n_devices), 1))
+    except AttributeError:
+        # older jax has no jax_num_cpu_devices; importing jax does not
+        # initialize a backend, so the env flag still takes effect here
+        set_host_device_count_flag(n_devices)
+    except Exception:
+        return False
+    return True

@@ -80,7 +80,14 @@ def run_step(label, argv, log_path, timeout_s, stdout=None):
             os.killpg(proc.pid, signal.SIGKILL)
         except ProcessLookupError:
             pass
-        proc.wait()
+        try:
+            # bounded reap even after SIGKILL: a child wedged in
+            # uninterruptible sleep (tunnel I/O) ignores the kill and an
+            # unbounded wait would wedge the WATCHER too
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            _log(log_path, f"{_now()} step={label} unreapable after "
+                           "SIGKILL (uninterruptible child?) — abandoning")
         _log(log_path, f"{_now()} step={label} TIMEOUT after {timeout_s}s "
                        f"(process group killed)")
         return False

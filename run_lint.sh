@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# Fast pre-test gate: esguard static analysis + bytecode compile check.
+# Pure AST + compileall — runs on CPU in seconds, touches no device
+# (JAX_PLATFORMS=cpu guards against the image's axon default even though
+# the analyzer imports neither jax nor the analyzed modules).
+set -euo pipefail
+cd "$(dirname "$0")"
+
+export JAX_PLATFORMS=cpu
+
+echo "== esguard =="
+python -m estorch_tpu.analysis estorch_tpu/
+
+echo "== compileall =="
+python -m compileall -q estorch_tpu/ tests/ examples/
+
+echo "lint gate: OK"

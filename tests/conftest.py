@@ -9,10 +9,29 @@ so env vars are not enough — we override via jax.config, which works because
 pytest imports this conftest before any test module touches a device.
 """
 
-import jax
+import os
 
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+# ONE implementation of the version-portable "CPU with 8 virtual devices"
+# switch (jax_num_cpu_devices on new jax, XLA_FLAGS replacement on old) —
+# utils/backend.py; importing estorch_tpu/jax here does not initialize a
+# backend, so the config still takes effect
+from estorch_tpu.utils import force_cpu_backend
+
+force_cpu_backend(8)
+
+import jax  # noqa: E402
+
+# XLA compile time dominates this suite (dozens of engine builds, each a
+# fresh closure jax's in-memory cache can't reuse).  The persistent cache
+# keys on HLO, so identical programs ACROSS tests and across runs load
+# from disk instead of recompiling.  Opt out with ESTORCH_TEST_NO_CACHE=1
+# (e.g. when hunting a miscompile).
+if not os.environ.get("ESTORCH_TEST_NO_CACHE"):
+    from estorch_tpu.utils import enable_compilation_cache
+
+    enable_compilation_cache(
+        os.path.join(os.path.expanduser("~"), ".cache", "estorch_tpu",
+                     "test_xla_cache"))
 
 import pytest  # noqa: E402
 

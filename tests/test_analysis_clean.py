@@ -1,0 +1,72 @@
+"""Tier-1 gate: the framework itself is esguard-clean modulo baseline.
+
+This is the self-application contract of the analyzer — every PR runs
+the same rules CI would run on user code against estorch_tpu's own
+``algo/``, ``parallel/``, ``envs/``, ``host/``, ``ops/``, ``utils/``,
+with the repo's checked-in pyproject config and baseline.  Three things
+fail it: a new unsuppressed finding, a stale baseline entry (the bug it
+suppressed was fixed — delete the entry), and a baseline entry with no
+justification.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+from estorch_tpu.analysis import (Baseline, all_rules, analyze_paths,
+                                  load_baseline, load_config,
+                                  sort_findings)
+
+REPO_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+@functools.lru_cache(maxsize=1)
+def _run_repo_analysis():
+    cfg = load_config(os.path.join(REPO_ROOT, "pyproject.toml"))
+    rules = [r for r in all_rules()
+             if r.id in cfg.rule_ids([r.id for r in all_rules()])]
+    findings = analyze_paths(
+        [os.path.join(REPO_ROOT, "estorch_tpu")],
+        rules=rules,
+        exclude=cfg.exclude,
+    )
+    # baseline entries are repo-relative; findings are cwd-relative (or
+    # absolute when run outside the repo) — rebase through abspath so
+    # matching is invocation-independent
+    rebased = [
+        f.__class__(**{**f.to_dict(),
+                       "file": os.path.relpath(os.path.abspath(f.file),
+                                               REPO_ROOT)})
+        for f in findings
+    ]
+    baseline_path = cfg.baseline_path()
+    baseline = (load_baseline(baseline_path)
+                if baseline_path and os.path.exists(baseline_path)
+                else Baseline())
+    return baseline, baseline.apply(sort_findings(rebased))
+
+
+def test_framework_is_esguard_clean():
+    baseline, res = _run_repo_analysis()
+    report = "\n".join(f.render() for f in res.unsuppressed)
+    assert not res.unsuppressed, (
+        f"esguard found new issues in estorch_tpu/ "
+        f"(fix them or baseline WITH a reason):\n{report}")
+
+
+def test_baseline_has_no_stale_entries():
+    _, res = _run_repo_analysis()
+    stale = "\n".join(
+        f"{e.rule} {e.file} [{e.symbol}] `{e.snippet}`" for e in res.stale)
+    assert not res.stale, (
+        f"baseline entries whose finding no longer exists — delete them:\n"
+        f"{stale}")
+
+
+def test_baseline_entries_are_justified():
+    baseline, _ = _run_repo_analysis()
+    unjust = [e for e in baseline.unjustified()]
+    assert not unjust, (
+        "baseline entries need a `reason`: "
+        + ", ".join(f"{e.rule}:{e.file}" for e in unjust))

@@ -132,6 +132,7 @@ class TestVBN:
         assert any("vbn_0" in n for n in names)  # affine present
 
 
+@pytest.mark.slow
 def test_evaluate_policy_return_details():
     """return_details adds per-episode rewards and (device path) BCs —
     the public surface locomotion studies use for displacement metrics."""
@@ -156,6 +157,7 @@ def test_evaluate_policy_return_details():
     assert "rewards" not in es.evaluate_policy(n_episodes=2)
 
 
+@pytest.mark.slow
 def test_evaluate_policy_pooled_batched():
     """Pooled-path evaluate_policy runs every episode through ONE pooled
     pass (round-3 VERDICT weak #6), is seed-deterministic, returns

@@ -131,6 +131,7 @@ class TestSpecAdjustment:
 
 
 class TestPooledIntegration:
+    @pytest.mark.slow
     def test_pong84_naturecnn_designed_input_end_to_end(self):
         """BASELINE config 5's machinery with the CNN's designed 84x84x4
         input: one pooled generation through the frame-stacked pong."""

@@ -32,6 +32,7 @@ class TestConfigs:
         assert es.backend == "device"
         assert len(es.history) == 2
 
+    @pytest.mark.slow
     def test_locomotion_configs_run_device_path(self):
         from estorch_tpu.configs import (
             cheetah2d_device,
@@ -50,6 +51,7 @@ class TestConfigs:
             assert es.backend == "device"
             assert np.isfinite(es.history[0]["reward_mean"])
 
+    @pytest.mark.slow
     def test_halfcheetah_vbn_runs_host_path(self):
         es = halfcheetah_vbn(population_size=16)
         es.train(1, verbose=False)
@@ -61,6 +63,7 @@ class TestConfigs:
                 if type(m).__name__ == "TorchVirtualBatchNorm":
                     assert bool(m.initialized)
 
+    @pytest.mark.slow
     def test_halfcheetah_nsres_runs_pooled_with_x_bc(self):
         """Config 4 on real MuJoCo: NSR-ES pooled, BC = final x-position."""
         from estorch_tpu.configs import halfcheetah_nsres
@@ -90,6 +93,7 @@ class TestConfigs:
         es.engine.pool.close()
         es.engine.center_pool.close()
 
+    @pytest.mark.slow
     def test_humanoid_pooled_runs_real_mujoco(self):
         """Config 3's pooled edition: Humanoid-v5 physics, obs_norm on,
         actions squashed to the env's ±0.4 bound (round-5)."""
@@ -114,6 +118,7 @@ class TestConfigs:
         with pytest.raises(ImportError, match="ale_py"):
             CONFIGS["atari_frostbite"]()
 
+    @pytest.mark.slow
     def test_cli_main(self, capsys):
         from estorch_tpu.configs import main
 

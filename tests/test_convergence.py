@@ -6,9 +6,12 @@ gymnasium's 'solved' bar (mean return ≥ 475) on held-out evaluation
 episodes.  ~18s on the 8-virtual-device CPU mesh.
 """
 
+import pytest
+
 from estorch_tpu.configs import cartpole_smoke
 
 
+@pytest.mark.slow
 def test_cartpole_solved():
     es = cartpole_smoke(population_size=128, seed=0)
     es.train(25, verbose=False)

@@ -6,6 +6,8 @@ the tests pin the classifier's behavior on controlled child processes and
 the report's shape, not the machine's health.
 """
 
+import pytest
+
 import json
 import sys
 
@@ -20,6 +22,7 @@ class TestProbeClassifier:
         assert out == {"status": "healthy", "platform": "cpu",
                        "n_devices": 8}
 
+    @pytest.mark.slow
     def test_wedge_detected_by_timeout_with_stderr_clue(self, monkeypatch):
         """A child that hangs past the timeout is classified wedged, and
         whatever it wrote to stderr before hanging survives in the report

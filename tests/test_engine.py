@@ -381,6 +381,7 @@ class TestNaNFitnessMasking:
         assert (w[~np.isfinite(fit)] == 0.0).all()
         assert abs(w.sum()) < 1e-4  # still centered over survivors
 
+    @pytest.mark.slow
     def test_all_invalid_generation_raises_via_api(self, setup):
         """Backend parity: host/pooled raise when <2 members survive; the
         device path must too (ES.train acts on the n_valid metric)."""

@@ -33,6 +33,7 @@ def _make(cls=IW_ES, n_pop=16, seed=7, **kw):
 
 
 class TestRatios:
+    @pytest.mark.slow
     def test_lambda_matches_density_ratio_oracle(self):
         """λ from engine noise_stats must equal the direct Gaussian density
         ratio computed from each materialized old member."""
@@ -76,6 +77,7 @@ class TestRatios:
 
 
 class TestUpdate:
+    @pytest.mark.slow
     def test_reuse_update_matches_dense_oracle(self):
         """engine.apply_weights_reuse == hand-built combined estimator on
         materialized noise, run through the same optax transform."""
@@ -162,6 +164,7 @@ class TestUpdate:
             rtol=0, atol=1e-6,
         )
 
+    @pytest.mark.slow
     def test_never_reusing_warns_once_with_heuristic(self):
         """20+ consecutive ESS rejections → one RuntimeWarning naming the
         lr ≲ σ/√dim fix; reuse-friendly runs stay silent."""
@@ -186,6 +189,7 @@ class TestUpdate:
                     and "ESS guard" in str(w.message)]
         assert any(r["reused_prev"] for r in es2.history)
 
+    @pytest.mark.slow
     def test_multi_generation_window(self):
         """reuse_window=3: the ring fills, multiple generations are admitted
         once moves settle, and effective_samples scales with reused_gens."""
@@ -197,6 +201,7 @@ class TestUpdate:
             assert r["effective_samples"] == 16 * (1 + r["reused_gens"])
         assert np.isfinite(es.history[-1]["reward_mean"])
 
+    @pytest.mark.slow
     def test_window_mesh_invariance(self):
         from estorch_tpu.parallel.mesh import population_mesh
 

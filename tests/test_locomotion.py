@@ -178,6 +178,7 @@ class TestSemantics:
 
 
 class TestLearnability:
+    @pytest.mark.slow
     def test_swimmer_es_improves(self):
         """ES on the device path must lift the swimmer's mean return well
         above the passive score within a small generation budget."""
@@ -380,6 +381,7 @@ class TestDeceptiveValley:
         with pytest.raises(ValueError, match="slope"):
             DeceptiveValley(Cheetah2D(), valley_slope=-1.0)
 
+    @pytest.mark.slow
     def test_trains_under_es_and_gait_metrics_pass_through(self):
         import optax
 

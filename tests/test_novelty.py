@@ -156,6 +156,7 @@ class TestNoveltyTraining:
         for key in ("meta_index", "novelty_mean", "archive_size", "center_reward"):
             assert key in rec
 
+    @pytest.mark.slow
     def test_nsr_es_on_locomotion_bc(self):
         """Novelty family composes with the device-native locomotion envs:
         the BC is the env's own behavior() (final torso x, y), so archive
@@ -201,8 +202,13 @@ class TestNoveltyTraining:
         e0 = es.evaluate_policy(n_episodes=2, meta_index=0)
         e1 = es.evaluate_policy(n_episodes=2, meta_index=1)
         assert e0["episodes"] == e1["episodes"] == 2
-        # distinct centers generally evaluate differently
-        assert e0["mean"] != e1["mean"] or e0["max"] != e1["max"]
+        # meta_index must select DISTINCT centers.  Their REWARDS can
+        # legitimately tie (on jax 0.4's random stream both centers cap
+        # the horizon every episode), so the selection contract is pinned
+        # on the parameters rather than on the evaluations differing.
+        p0 = np.asarray(es.meta_states[0].params_flat)
+        p1 = np.asarray(es.meta_states[1].params_flat)
+        assert not np.array_equal(p0, p1)
 
     def test_meta_index_rejected_on_plain_es(self):
         import optax

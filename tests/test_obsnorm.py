@@ -112,6 +112,7 @@ class TestNormalizeObsMath:
 
 
 class TestStatsAccounting:
+    @pytest.mark.slow
     def test_probe_count_is_exact(self):
         """Pendulum never terminates, so after G generations with E probe
         episodes of H steps each: count = 1 (init) + G*E*H, exactly."""
@@ -121,15 +122,19 @@ class TestStatsAccounting:
         assert float(cnt) == 1.0 + 3 * 2 * 100
         mean = np.asarray(mean)
         var = np.asarray(m2 / cnt)
-        # Pendulum obs = (cosθ, sinθ, θ̇): trig dims bounded, velocity not
-        assert np.all(np.abs(mean) < 1.5) and np.all(var > 0)
+        # Pendulum obs = (cosθ, sinθ, θ̇): trig dims bounded by 1, so only
+        # THEIR means are bounded; θ̇ is unbounded and its mean depends on
+        # the jax version's random stream (observed 1.95 on jax 0.4)
+        assert np.all(np.abs(mean[:2]) <= 1.0 + 1e-6) and np.all(var > 0)
         assert var[2] > var[0], "velocity variance should dominate trig dims"
 
+    @pytest.mark.slow
     def test_stats_only_when_enabled(self):
         es = _pendulum_es(obs_norm=False)
         es.train(1, verbose=False)
         assert es.state.obs_stats is None
 
+    @pytest.mark.slow
     def test_warmup_folds_init_probes_exactly(self):
         """obs_warmup_episodes=3 on Pendulum (h=100, never terminates):
         init count = 1 + 3·100, real (non-identity) moments before
@@ -167,6 +172,7 @@ class TestStatsAccounting:
 
 
 class TestSplitEqualsFused:
+    @pytest.mark.slow
     def test_split_path_matches_generation_step(self):
         """The novelty family's evaluate→rank→apply path must produce the
         SAME params and the SAME refreshed obs_stats as the fused program."""
@@ -187,6 +193,7 @@ class TestSplitEqualsFused:
 
 
 class TestCheckpointRoundtrip:
+    @pytest.mark.slow
     def test_bit_exact_resume_with_obs_norm(self, tmp_path):
         from estorch_tpu.utils import restore_checkpoint, save_checkpoint
 
@@ -229,6 +236,7 @@ class TestGuards:
                 obs_norm=True,
             )
 
+    @pytest.mark.slow
     def test_obs_norm_checkpoint_mismatch_rejected(self, tmp_path):
         from estorch_tpu.utils import restore_checkpoint, save_checkpoint
 
@@ -261,6 +269,7 @@ class _DummyHostAgent:
 
 
 class TestCombosAndLearning:
+    @pytest.mark.slow
     def test_recurrent_plus_obs_norm_runs(self):
         from estorch_tpu.envs import RecallEnv
 
@@ -277,6 +286,7 @@ class TestCombosAndLearning:
         assert np.isfinite(es.history[-1]["reward_mean"])
         assert es.state.obs_stats is not None
 
+    @pytest.mark.slow
     def test_cartpole_learns_with_obs_norm(self):
         es = ES(
             policy=MLPPolicy, agent=JaxAgent, optimizer=optax.adam,
@@ -289,6 +299,7 @@ class TestCombosAndLearning:
         es.train(25, verbose=False)
         assert es.history[-1]["reward_mean"] > 150, es.history[-1]
 
+    @pytest.mark.slow
     def test_bf16_obs_norm_runs(self):
         es = _pendulum_es(compute_dtype="bfloat16")
         es.train(2, verbose=False)
@@ -331,6 +342,7 @@ class TestObsNormModeCombos:
             np.testing.assert_allclose(np.asarray(sa), np.asarray(sb),
                                        rtol=1e-5, atol=1e-6)
 
+    @pytest.mark.slow
     def test_streamed_matches_decomposed(self):
         """streamed is the Pallas kernel form of decomposed — same math,
         obs normalized before the population-batched forward."""
@@ -461,6 +473,7 @@ class TestPooledObsNorm:
         kw.update(over)
         return ES(**kw)
 
+    @pytest.mark.slow
     def test_trains_and_stats_grow(self):
         es = self._pooled_es()
         es.train(2, verbose=False)
@@ -474,6 +487,7 @@ class TestPooledObsNorm:
         ev = es.evaluate_policy(n_episodes=2)
         assert np.isfinite(ev["mean"])
 
+    @pytest.mark.slow
     def test_split_equals_fused_pooled(self):
         """Two same-seeded instances (fresh pools → identical episode
         sequences): the fused generation_step must equal the explicit
@@ -496,6 +510,7 @@ class TestPooledObsNorm:
         for a, b in zip(split.obs_stats, fused.obs_stats):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
+    @pytest.mark.slow
     def test_checkpoint_roundtrip(self, tmp_path):
         from estorch_tpu.utils import restore_checkpoint, save_checkpoint
 
@@ -507,6 +522,7 @@ class TestPooledObsNorm:
         for a, b in zip(es.state.obs_stats, es2.state.obs_stats):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
+    @pytest.mark.slow
     def test_discarded_evaluation_moments_dropped(self):
         """A discarded evaluate() (eval-only probe, exception between the
         calls) must NOT fold its observations into a later, unrelated
@@ -528,6 +544,7 @@ class TestPooledObsNorm:
         assert eng._pending_moments is None
         assert float(new_state.obs_stats[0]) == float(es.state.obs_stats[0])
 
+    @pytest.mark.slow
     def test_double_buffer_runs(self):
         es = self._pooled_es(
             agent_kwargs={"env_name": "cartpole", "horizon": 32,
@@ -536,6 +553,7 @@ class TestPooledObsNorm:
         es.train(1, verbose=False)
         assert float(es.state.obs_stats[0]) > 1.0
 
+    @pytest.mark.slow
     def test_double_buffer_count_invariant(self):
         """Double-buffered stats must obey count == 1 + env_steps exactly
         like the sync path (moments accumulate at STEP time, not at

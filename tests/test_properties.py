@@ -1,8 +1,13 @@
 """Property-based tests (hypothesis) for the ES math core."""
 
 import numpy as np
-from hypothesis import given, settings, strategies as st
-from hypothesis.extra import numpy as hnp
+import pytest
+
+pytest.importorskip(
+    "hypothesis",
+    reason="hypothesis not installed in this image; property tests skip")
+from hypothesis import given, settings, strategies as st  # noqa: E402
+from hypothesis.extra import numpy as hnp  # noqa: E402
 
 import jax.numpy as jnp
 

@@ -100,6 +100,7 @@ class TestCarryThreading:
 
 
 class TestRecurrentTraining:
+    @pytest.mark.slow
     def test_learns_memory_task_where_memoryless_cannot(self):
         """RecallEnv: the ±1 signal is visible only at t=0; reward is
         action*signal each step.  Memoryless expected return caps at ~1
@@ -117,12 +118,51 @@ class TestRecurrentTraining:
         ev0 = base.evaluate_policy(n_episodes=64, seed=9)
         assert ev0["mean"] < 4.0, f"memoryless should cap near 1: {ev0}"
 
+    @pytest.mark.slow
     def test_bf16_recurrent_runs_and_learns(self):
         es = _make_es(RecurrentPolicy, RECURRENT_PK,
                       compute_dtype="bfloat16")
         es.train(25, verbose=False)
         assert es.history[-1]["reward_mean"] > es.history[0]["reward_mean"]
 
+    def test_bf16_legacy_zero_arg_carry_init(self):
+        """ADVICE regression: the engine's bf16 carry wrapper used to call
+        ``base_carry_init(params)`` unconditionally, so a legacy zero-arg
+        ``carry_init`` worked in f32 but raised TypeError under
+        compute_dtype='bfloat16'.  It must run (and cast the carry) in
+        both dtypes."""
+        import optax as _optax
+
+        from estorch_tpu.envs import CartPole
+        from estorch_tpu.ops import make_noise_table, make_param_spec
+        from estorch_tpu.parallel import (EngineConfig, ESEngine,
+                                          single_device_mesh)
+
+        def init_params(key):
+            return {
+                "w": jax.random.normal(key, (4, 8)) * 0.5,
+                "wo": jnp.zeros((8, 2)),
+            }
+
+        def apply(params, obs, h):
+            h_new = jnp.tanh(obs @ params["w"] + h)
+            return h_new @ params["wo"], h_new
+
+        flat, spec = make_param_spec(init_params(jax.random.PRNGKey(0)))
+        for dtype in ("float32", "bfloat16"):
+            eng = ESEngine(
+                CartPole(), apply, spec, make_noise_table(1 << 16, seed=0),
+                _optax.sgd(1e-2),
+                EngineConfig(population_size=8, sigma=0.1, horizon=10,
+                             compute_dtype=dtype),
+                single_device_mesh(),
+                carry_init=lambda: jnp.zeros((8,)),  # legacy zero-arg form
+            )
+            state = eng.init_state(flat, jax.random.PRNGKey(1))
+            state, metrics = eng.generation_step(state)
+            assert np.isfinite(float(np.asarray(metrics["fitness"]).mean()))
+
+    @pytest.mark.slow
     def test_mirrored_off_and_episodes_per_member(self):
         es = _make_es(RecurrentPolicy, RECURRENT_PK, mirrored=False,
                       episodes_per_member=2, population_size=64)
@@ -155,6 +195,7 @@ class TestRecurrentLowRank:
         # any no-saving shapes
         assert spec.noise_dim < es.engine.spec.dim  # the O(dim) state shrank
 
+    @pytest.mark.slow
     def test_trains_and_split_equals_fused(self):
         from estorch_tpu.utils.fault import rank_weights_with_failures
 
@@ -195,12 +236,14 @@ class TestRecurrentLowRank:
             fitness[i], abs=1e-4
         )
 
+    @pytest.mark.slow
     def test_lstm_low_rank_trains(self):
         pk = dict(RECURRENT_PK, cell="lstm")
         es = _make_es(RecurrentPolicy, pk, low_rank=1, population_size=32)
         es.train(2, verbose=False)
         assert np.isfinite(es.history[-1]["reward_mean"])
 
+    @pytest.mark.slow
     def test_bf16_runs(self):
         es = _make_es(RecurrentPolicy, RECURRENT_PK, low_rank=1,
                       population_size=32, compute_dtype="bfloat16")
@@ -231,6 +274,7 @@ class TestRecurrentPooled:
         kw.update(over)
         return ES(**kw)
 
+    @pytest.mark.slow
     def test_trains_and_is_finite(self):
         es = self._pooled_es()
         es.train(2, verbose=False)
@@ -278,8 +322,26 @@ class TestRecurrentPredict:
         out2, h2 = es.predict(jnp.zeros((1,)), carry=h)
         assert h2.shape == (8,)
 
+    def test_predict_zero_arg_carry_init_module(self):
+        """ADVICE regression: predict() used to call
+        ``self.module.carry_init(p)`` unconditionally; a custom recurrent
+        module with the historical zero-arg ``carry_init()`` worked in
+        the rollout path but broke in predict.  Both paths share the
+        compat contract now."""
+
+        class LegacyCarryPolicy(RecurrentPolicy):
+            def carry_init(self):  # historical zero-arg form
+                return super().carry_init(None)
+
+        es = _make_es(LegacyCarryPolicy, RECURRENT_PK, population_size=32)
+        out, h = es.predict(jnp.ones((1,)))
+        assert out.shape == (1,) and h.shape == (8,)
+        es.train(1, verbose=False)  # rollout path agrees
+        assert np.isfinite(es.history[-1]["reward_mean"])
+
 
 class TestLSTMCore:
+    @pytest.mark.slow
     def test_lstm_carry_is_tuple_and_trains(self):
         pk = {**RECURRENT_PK, "cell": "lstm"}
         mod = RecurrentPolicy(**pk)
@@ -289,6 +351,7 @@ class TestLSTMCore:
         es.train(3, verbose=False)
         assert np.isfinite(es.history[-1]["reward_mean"])
 
+    @pytest.mark.slow
     def test_lstm_learns_memory_task(self):
         pk = {**RECURRENT_PK, "cell": "lstm"}
         es = _make_es(RecurrentPolicy, pk, population_size=256)
@@ -300,6 +363,7 @@ class TestLSTMCore:
         with pytest.raises(ValueError, match="cell"):
             _make_es(RecurrentPolicy, {**RECURRENT_PK, "cell": "rnn"})
 
+    @pytest.mark.slow
     def test_lstm_bf16_runs(self):
         pk = {**RECURRENT_PK, "cell": "lstm"}
         es = _make_es(RecurrentPolicy, pk, population_size=32,
@@ -323,6 +387,7 @@ class TestRecurrentVision:
         out, h1 = mod.apply(variables, obs, h0)
         assert out.shape == (3,) and h1.shape == (32,)
 
+    @pytest.mark.slow
     def test_pooled_pong_trains(self):
         from estorch_tpu import PooledAgent, RecurrentNatureCNN
 
@@ -365,6 +430,7 @@ class TestStackedAndLearnedCarry:
             # existing checkpoints/goldens stay valid); layer 1 is suffixed
             assert cell in v["params"] and f"{cell}_1" in v["params"]
 
+    @pytest.mark.slow
     def test_stacked_trains(self):
         es = _make_es(RecurrentPolicy, dict(RECURRENT_PK, n_layers=2),
                       population_size=32)
@@ -385,6 +451,7 @@ class TestStackedAndLearnedCarry:
                                       np.full((8,), 0.5))
         assert np.all(np.asarray(mod.carry_init()) == 0)
 
+    @pytest.mark.slow
     def test_learned_carry_trains_and_moves(self):
         es = _make_es(RecurrentPolicy,
                       dict(RECURRENT_PK, learned_carry=True),
@@ -397,6 +464,7 @@ class TestStackedAndLearnedCarry:
         # the learned carry is a real parameter: the update moved it
         assert not np.allclose(c0, c1)
 
+    @pytest.mark.slow
     def test_learned_carry_split_equals_fused(self):
         from estorch_tpu.utils.fault import rank_weights_with_failures
 
@@ -410,6 +478,7 @@ class TestStackedAndLearnedCarry:
         np.testing.assert_array_equal(np.asarray(split_state.params_flat),
                                       np.asarray(fused_state.params_flat))
 
+    @pytest.mark.slow
     def test_learned_carry_low_rank_is_dense_leaf(self):
         es = _make_es(RecurrentPolicy,
                       dict(RECURRENT_PK, learned_carry=True),
@@ -427,6 +496,7 @@ class TestStackedAndLearnedCarry:
         es.train(1, verbose=False)
         assert np.isfinite(es.history[-1]["reward_mean"])
 
+    @pytest.mark.slow
     def test_lstm_stacked_learned_bf16_trains(self):
         pk = dict(RECURRENT_PK, cell="lstm", n_layers=2, learned_carry=True)
         es = _make_es(RecurrentPolicy, pk, population_size=32,
@@ -452,6 +522,7 @@ class TestStackedAndLearnedCarry:
                 seed=0,
             )
 
+    @pytest.mark.slow
     def test_learned_carry_composes_with_obs_norm(self):
         """obs_norm packs the rollout's params as (tree, obs_stats); the
         engine's carry_init wrapper must read the learned carry from the

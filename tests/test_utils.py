@@ -101,6 +101,7 @@ class TestCheckpointDevice:
                 np.asarray(sa.params_flat), np.asarray(sb.params_flat)
             )
 
+    @pytest.mark.slow
     def test_novelty_resume_is_exact(self, tmp_path):
         """Regression: the meta-selection RNG position must be checkpointed —
         without it the resumed run picks different meta-individuals."""
@@ -329,6 +330,7 @@ class TestProfiler:
         assert stats["env_steps_per_sec"] > 0
         assert stats["compile_time_s"] is not None
 
+    @pytest.mark.slow
     def test_trace_writes_profile(self, tmp_path):
         from estorch_tpu.utils import annotate, trace
 
@@ -399,6 +401,7 @@ class TestCompilationCache:
 
 
 class TestAsyncCheckpoint:
+    @pytest.mark.slow
     def test_async_save_restores_bit_exact(self, tmp_path):
         import optax
 
