@@ -37,7 +37,14 @@ measurement cannot take down the bench — round-1 lesson):
                                         AB_MATRIX; not a full cross — e.g.
                                         streamed is f32-only by design),
                                         one JSON line per config as it lands
+    bench.py --obs-ab                   telemetry-overhead A/B: spans on vs
+                                        off on the headline config (the <2%
+                                        observability acceptance gate)
     bench.py                            headline + extras, the driver entry
+
+Every stage child writes a heartbeat file (ESTORCH_OBS_HEARTBEAT →
+estorch_tpu/obs/recorder.py): a stage timeout reports the child's last
+phase + generation + heartbeat age instead of guessing at a tunnel wedge.
 """
 
 import contextlib
@@ -49,6 +56,33 @@ import tempfile
 import time
 
 import numpy as np
+
+
+def _load_obs_recorder():
+    """Load estorch_tpu/obs/recorder.py WITHOUT the package __init__.
+
+    The recorder module itself is jax-free, but `import estorch_tpu...`
+    executes the package init, which imports jax — and importing jax in
+    THIS process would touch the possibly-wedged device runtime before
+    the stage protocol's subprocess+timeout isolation can protect us
+    (the round-1 lesson the whole stage design exists for).  A direct
+    file load keeps one implementation of the heartbeat protocol while
+    keeping the bench driver accelerator-free."""
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "estorch_tpu", "obs", "recorder.py")
+    spec = importlib.util.spec_from_file_location("_estorch_obs_recorder",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+obs_recorder = _load_obs_recorder()
+HEARTBEAT_ENV = obs_recorder.HEARTBEAT_ENV
+describe_heartbeat = obs_recorder.describe_heartbeat
+read_heartbeat = obs_recorder.read_heartbeat
 
 V5E_BF16_PEAK = 197e12  # TPU v5e per-chip bf16 peak FLOP/s
 
@@ -187,6 +221,10 @@ def measure_one(cfg, force_cpu=False):
         streamed=cfg.get("streamed", False),
         low_rank=cfg.get("low_rank", 0),
         obs_norm=cfg.get("obs_norm", False),
+        # default None: spans on, heartbeat picked up from the env var the
+        # stage parent set.  The --obs-ab rows pass an explicit bool to
+        # measure the spans' own overhead
+        telemetry=cfg.get("telemetry"),
     )
     gens = cfg.get("gens", 5)
     es.train(1, verbose=False)  # warm-up generation (compile + AOT sanity)
@@ -258,17 +296,38 @@ def run_stage_detailed(cfg, timeout_s=480, force_cpu=False):
     returns a row dict with a "rate" key (None on failure, plus "error" /
     "stderr_tail" saying why) — the machine-readable form the on-chip A/B
     artifact records, so a wedged row's diagnosis survives in the artifact
-    instead of only on a long-gone stderr."""
+    instead of only on a long-gone stderr.
+
+    Every stage child runs with a heartbeat file (obs/recorder.py
+    protocol): on timeout the failure line carries the child's last
+    phase + generation + heartbeat age instead of a guess — "wedged in
+    phase=device at gen 0, silent for 470s" vs "slow but beating"."""
+    hb_path = os.path.join(
+        tempfile.gettempdir(),
+        f"bench_hb_{os.getpid()}_{abs(hash(json.dumps(cfg, sort_keys=True))) % 10**8}.json",
+    )
     try:
         argv = [sys.executable, __file__, "--stage-one", json.dumps(cfg)]
         if force_cpu:
             argv.append("--cpu")
-        r = subprocess.run(
-            argv, timeout=timeout_s, capture_output=True, text=True,
-        )
-    except subprocess.TimeoutExpired:
-        return {"rate": None, "cfg": cfg,
-                "error": f"timeout after {timeout_s}s (tunnel wedge?)"}
+        try:
+            r = subprocess.run(
+                argv, timeout=timeout_s, capture_output=True, text=True,
+                env={**os.environ, HEARTBEAT_ENV: hb_path},
+            )
+        except subprocess.TimeoutExpired:
+            row = {"rate": None, "cfg": cfg,
+                   "error": (f"timeout after {timeout_s}s "
+                             f"({describe_heartbeat(hb_path)})")}
+            hb = read_heartbeat(hb_path)
+            if hb is not None:
+                row["heartbeat"] = hb
+            return row
+    finally:
+        try:
+            os.remove(hb_path)
+        except OSError:
+            pass
     try:
         last = [ln for ln in r.stdout.strip().splitlines()
                 if ln.startswith("{")][-1]
@@ -376,6 +435,51 @@ def stage_ab(force_cpu=False):
         if label_spec:
             line["label_spec"] = label_spec
         print(json.dumps(line), flush=True)
+
+
+def stage_obs_ab(force_cpu=False, gens=3, repeats=3):
+    """Telemetry overhead A/B: the SAME config with default-on spans vs
+    telemetry disabled — the <2% observability acceptance gate.
+
+    This host's single-run rates swing far more than 2% (shared-core
+    load; the round-4 contamination lesson), so one pair of stages
+    cannot resolve a 2% effect: ``repeats`` INTERLEAVED on/off pairs are
+    measured (ABAB..., so slow drift hits both arms equally) and the
+    verdict compares the per-arm MEDIANS.  Per-run rows land as JSON
+    lines for the artifact; the ``obs/overhead`` line carries the
+    medians + the verdict."""
+    rates = {"spans_on": [], "spans_off": []}
+    for rep in range(repeats):
+        for label, tel in (("spans_on", True), ("spans_off", False)):
+            cfg = {**SMALL, "gens": gens, "telemetry": tel}
+            if force_cpu:
+                cfg["dtype"] = "float32"
+            r = run_stage(cfg, timeout_s=1200 if force_cpu else 600,
+                          force_cpu=force_cpu)
+            if r and r.get("rate"):
+                rates[label].append(r["rate"])
+            print(json.dumps({"label": f"obs/{label}", "rep": rep,
+                              **(r or {"rate": None, "cfg": cfg})}),
+                  flush=True)
+    on, off = sorted(rates["spans_on"]), sorted(rates["spans_off"])
+    if on and off:
+        # statistics.median averages the middle pair on even arm sizes —
+        # a timed-out repeat must not bias the gate toward either verdict
+        import statistics
+
+        med_on = statistics.median(on)
+        med_off = statistics.median(off)
+        # overhead = throughput lost with spans on (positive = spans cost)
+        overhead = (med_off - med_on) / med_off * 100.0
+        print(json.dumps({
+            "label": "obs/overhead",
+            "median_on": round(med_on, 1), "median_off": round(med_off, 1),
+            "runs_per_arm": len(on),
+            "spread_pct": round(
+                (max(on + off) - min(on + off)) / med_off * 100.0, 1),
+            "overhead_pct": round(overhead, 2),
+            "pass_lt_2pct": overhead < 2.0,
+        }), flush=True)
 
 
 class EvidenceLockBusy(Exception):
@@ -507,5 +611,8 @@ if __name__ == "__main__":
     elif "--stage-ab" in sys.argv:
         _lock_or_warn()
         stage_ab(force_cpu="--cpu" in sys.argv)
+    elif "--obs-ab" in sys.argv:
+        _lock_or_warn()
+        stage_obs_ab(force_cpu="--cpu" in sys.argv)
     else:
         main()

@@ -36,6 +36,7 @@ import optax
 
 from ..envs.agent import JaxAgent, collect_reference_batch
 from ..models.vbn import capture_reference_stats
+from ..obs.spans import resolve_telemetry
 from ..ops.noise import DEFAULT_TABLE_SIZE, make_noise_table
 from ..ops.params import make_param_spec
 from ..parallel.engine import EngineConfig, ESEngine
@@ -113,7 +114,15 @@ class ES:
         obs_clip: float = 5.0,
         obs_probe_episodes: int = 1,
         obs_warmup_episodes: int = 0,
+        telemetry=None,
     ):
+        # telemetry first: every backend-init path below runs with spans/
+        # counters available.  None → default-on honoring ESTORCH_OBS /
+        # ESTORCH_OBS_HEARTBEAT env vars; bool forces; or pass a Telemetry
+        self.obs = resolve_telemetry(telemetry)
+        # first beat BEFORE backend init: device bring-up is a known wedge
+        # point, and "last phase=init" beats "no heartbeat written"
+        self.obs.note("init")
         self.population_size = population_size
         self.sigma = sigma
         self.seed = seed
@@ -398,6 +407,10 @@ class ES:
         return self.module.init(key, self._obs0)
 
     def _post_engine_init(self):
+        # the engine shares the ES's telemetry hub so sub-generation spans
+        # (host sample/eval/update, pooled obsnorm merge, engine compile
+        # events) land in the same per-generation accumulator
+        self.engine.telemetry = self.obs
         self.best_reward = -np.inf
         self._best_flat: np.ndarray | None = None
         self._best_policy_host = None
@@ -564,17 +577,41 @@ class ES:
         reference's ``train(n_steps, n_proc)``.
         """
         self._setup_n_proc(n_proc)
+        obs = self.obs
+        # a previous generation that raised mid-phase (dead env,
+        # catch-and-resume) must not leak its partial spans into the
+        # first record of this call
+        obs.discard_phases()
         if self.compile_time_s is None:
             # AOT-compile outside the timed loop so env_steps_per_sec (the
             # primary metric) never includes XLA trace+compile time
+            obs.note("compile")
             self.compile_time_s = self.engine.compile(self.state)
         for _ in range(n_steps):
             t0 = time.perf_counter()
             prev_state = self.state
-            self.state, metrics = self.engine.generation_step(prev_state)
-            fitness = np.asarray(metrics["fitness"])
-            if self.backend != "host":
-                jax.block_until_ready(self.state.params_flat)
+            if self.backend == "device":
+                # the fused generation is ONE XLA program — the finest
+                # honest split is dispatch (host python + trace lookup) /
+                # device (fenced: everything up to the updated params) /
+                # host_sync (D2H of the metrics).  sample/eval/update
+                # live inside the program; the split-path algorithms
+                # (novelty family) and the host/pooled engines emit them
+                # as real spans (docs/observability.md span taxonomy)
+                with obs.phase("dispatch"):
+                    self.state, metrics = self.engine.generation_step(
+                        prev_state)
+                with obs.phase("device"):
+                    jax.block_until_ready(self.state.params_flat)
+                with obs.phase("host_sync"):
+                    fitness = np.asarray(metrics["fitness"])
+            else:
+                # host/pooled engines span their own sample/eval/update
+                self.state, metrics = self.engine.generation_step(
+                    prev_state)
+                fitness = np.asarray(metrics["fitness"])
+                if self.backend != "host":
+                    jax.block_until_ready(self.state.params_flat)
             dt = time.perf_counter() - t0
 
             # backend parity: host/pooled raise inside their weighting when
@@ -634,9 +671,12 @@ class ES:
         return gen_best, improved
 
     def _base_record(self, prev_state, fitness, steps, grad_norm, dt) -> dict:
-        gen_best, improved = self._track_best(prev_state, fitness)
+        with self.obs.phase("record"):
+            # best-member snapshot can dispatch a device program
+            # (member_params) — it deserves phase attribution too
+            gen_best, improved = self._track_best(prev_state, fitness)
         finite_any = np.isfinite(fitness).any()
-        return {
+        record = {
             "generation": self.generation,
             "reward_max": gen_best,
             "reward_mean": float(np.nanmean(fitness)) if finite_any else float("nan"),
@@ -652,6 +692,13 @@ class ES:
             else self.sigma,
             "wall_time_s": dt,
         }
+        # flush this generation's span accumulator into the record and
+        # export the run-level counters (obs/summarize.py consumes both)
+        record["phases"] = self.obs.take_phases()
+        self.obs.counters.inc("env_steps", steps)
+        if record["n_failed"]:
+            self.obs.counters.inc("rollout_failures", record["n_failed"])
+        return record
 
     def _emit_record(self, record: dict, log_fn, verbose: bool) -> None:
         self.history.append(record)
@@ -669,6 +716,37 @@ class ES:
             f"best {r['best_reward']:9.2f}  "
             f"steps/s {r['env_steps_per_sec']:,.0f}"
         )
+
+    # ----------------------------------------------------------- observability
+
+    def run_manifest(self, extra: dict | None = None) -> dict:
+        """Immutable facts of THIS run (obs/manifest.py): algorithm +
+        backend config, jax version, device topology, git sha.  Safe to
+        call any time after construction — the backend is already up, so
+        reading device attributes cannot wedge a cold runtime."""
+        from ..obs.manifest import collect_manifest
+
+        cfg = {
+            "algorithm": type(self).__name__,
+            "backend": self.backend,
+            "population_size": self.population_size,
+            "sigma": self.sigma,
+            "seed": self.seed,
+            "compute_dtype": self._compute_dtype,
+            "mirrored": self._mirrored,
+            "obs_norm": self._obs_norm,
+            "low_rank": self._low_rank,
+            "decomposed": self._decomposed,
+            "streamed": self._streamed,
+        }
+        mesh = getattr(self, "mesh", None)
+        devices = list(mesh.devices.flat) if mesh is not None else None
+        return collect_manifest(config=cfg, devices=devices, extra=extra)
+
+    def write_manifest(self, path: str, extra: dict | None = None) -> str:
+        from ..obs.manifest import write_manifest
+
+        return write_manifest(path, self.run_manifest(extra))
 
     # ------------------------------------------------------------- inspection
 

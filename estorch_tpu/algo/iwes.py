@@ -111,15 +111,19 @@ class IW_ES(ES):
         verbose: bool = True,
     ):
         self._setup_n_proc(n_proc)
+        obs = self.obs
+        obs.discard_phases()  # drop partial spans from an aborted generation
         if self.compile_time_s is None:
+            obs.note("compile")
             self.compile_time_s = self.engine.compile_split(self.state)
             self.compile_time_s += self._warm_reuse_programs()
         n = self.population_size
         for _ in range(n_steps):
             t0 = time.perf_counter()
             st = self.state
-            ev = self.engine.evaluate(st)
-            fitness = np.asarray(ev.fitness)
+            with obs.phase("eval"):
+                ev = self.engine.evaluate(st)
+                fitness = np.asarray(ev.fitness)  # fences the eval program
             # base-class parity BEFORE anything mutates: a dead env (fewer
             # than 2 valid FRESH members) must hard-fail with state intact —
             # reuse must not let stale samples train through a dead generation
@@ -131,35 +135,42 @@ class IW_ES(ES):
                 )
 
             # admit each buffered generation independently by its own ESS
-            accepted, best_ess = [], 0.0
-            for entry in self._prev:
-                lam, d_vec, c, offs = self._ratios(entry, st)
-                ess = (
-                    float(lam.sum() ** 2 / (lam**2).sum())
-                    if lam.sum() > 0 else 0.0
-                )
-                best_ess = max(best_ess, ess)
-                if ess >= self.ess_min * n:
-                    accepted.append((entry[3], lam, d_vec, c, offs))
+            with obs.phase("reuse_ratios"):
+                accepted, best_ess = [], 0.0
+                for entry in self._prev:
+                    lam, d_vec, c, offs = self._ratios(entry, st)
+                    ess = (
+                        float(lam.sum() ** 2 / (lam**2).sum())
+                        if lam.sum() > 0 else 0.0
+                    )
+                    best_ess = max(best_ess, ess)
+                    if ess >= self.ess_min * n:
+                        accepted.append((entry[3], lam, d_vec, c, offs))
             reused = bool(accepted)
-            if reused:
-                self._dry_gens = 0
-                self._dry_best_ess = 0.0
-                new_st, gnorm = self._reuse_update(st, fitness, accepted)
-            else:
-                if len(self._prev) == self.reuse_window:
-                    self._dry_gens += 1
-                    self._dry_best_ess = max(self._dry_best_ess, best_ess)
-                    self._maybe_warn_never_reusing()
-                weights = jnp.asarray(rank_weights_with_failures(fitness))
-                new_st, gnorm = self.engine.apply_weights(st, weights)
+            with obs.phase("update"):
+                if reused:
+                    self._dry_gens = 0
+                    self._dry_best_ess = 0.0
+                    new_st, gnorm = self._reuse_update(st, fitness, accepted)
+                else:
+                    if len(self._prev) == self.reuse_window:
+                        self._dry_gens += 1
+                        self._dry_best_ess = max(self._dry_best_ess, best_ess)
+                        self._maybe_warn_never_reusing()
+                    weights = jnp.asarray(rank_weights_with_failures(fitness))
+                    new_st, gnorm = self.engine.apply_weights(st, weights)
+                jnp.asarray(new_st.params_flat).block_until_ready()
 
             self.state = new_st
-            self._prev.append((
-                st.params_flat, float(np.asarray(st.sigma)),
-                self.engine.all_pair_offsets(st), fitness,
-            ))
-            jnp.asarray(new_st.params_flat).block_until_ready()
+            with obs.phase("sample"):
+                # buffer this generation for future reuse.  The offsets
+                # program is left async on purpose (its consumer is next
+                # generation's ratio pass) — this span clocks dispatch +
+                # the σ host copy, not the offsets compute
+                self._prev.append((
+                    st.params_flat, float(np.asarray(st.sigma)),
+                    self.engine.all_pair_offsets(st), fitness,
+                ))
             dt = time.perf_counter() - t0
 
             record = self._base_record(

@@ -138,34 +138,47 @@ class NS_ES(ES):
         verbose: bool = True,
     ):
         self._setup_n_proc(n_proc)
+        obs = self.obs
+        obs.discard_phases()  # drop partial spans from an aborted generation
         if self.compile_time_s is None:
             # AOT-compile the split-path programs outside the timed loop,
             # same invariant as ES.train for the primary metric
+            obs.note("compile")
             self.compile_time_s = self.engine.compile_split(self.meta_states[0])
         for _ in range(n_steps):
             t0 = time.perf_counter()
-            m = self._select_meta_index()
+            # the split path has REAL host-visible phase boundaries (unlike
+            # ES's fused program): each span below ends on a host
+            # materialization of its device outputs, so device time lands
+            # in the phase that spent it (esguard R07 fencing contract)
+            with obs.phase("select"):
+                m = self._select_meta_index()
             st = self.meta_states[m]
 
-            ev = self.engine.evaluate(st)
-            fitness = np.asarray(ev.fitness)
-            novelty = self.archive.novelty(np.asarray(ev.bc))
-            weights = self._weights_with_failures(fitness, novelty)
-            if self.backend == "device":
-                weights = jnp.asarray(weights)
+            with obs.phase("eval"):
+                ev = self.engine.evaluate(st)
+                fitness = np.asarray(ev.fitness)  # fences the eval program
+                bc = np.asarray(ev.bc)
+            with obs.phase("novelty_knn"):
+                novelty = self.archive.novelty(bc)
+                weights = self._weights_with_failures(fitness, novelty)
+                if self.backend == "device":
+                    weights = jnp.asarray(weights)
 
-            new_st, gnorm = self.engine.apply_weights(st, weights)
+            with obs.phase("update"):
+                new_st, gnorm = self.engine.apply_weights(st, weights)
+                if self.backend != "host":
+                    jax.block_until_ready(new_st.params_flat)
             self.meta_states[m] = new_st
             if m == 0:
                 self.state = new_st  # keep base-class accessors on meta[0]
 
             # center of the UPDATED policy: archive entry + meta bookkeeping
-            cres = self.engine.evaluate_center(new_st)
-            cbc = np.asarray(cres.bc)
-            self.archive.add(cbc)
-            self._center_bc[m] = cbc
-            if self.backend != "host":
-                jax.block_until_ready(new_st.params_flat)
+            with obs.phase("archive"):
+                cres = self.engine.evaluate_center(new_st)
+                cbc = np.asarray(cres.bc)
+                self.archive.add(cbc)
+                self._center_bc[m] = cbc
             dt = time.perf_counter() - t0
 
             record = self._base_record(

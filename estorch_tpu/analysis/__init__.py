@@ -17,6 +17,8 @@ Rules (docs/analysis.md has the full rationale per rule):
 * R04 missing-donation        — jitted update without donate_argnums
 * R05 untimed-subprocess-wait — proc.wait()/communicate() without timeout
 * R06 signature-probe-default — inspect.signature fallback that guesses
+* R07 unfenced-device-timing  — perf_counter delta around jitted dispatch
+                                without a block_until_ready fence
 
 Nothing in this package imports jax or the analyzed modules — analysis
 is pure ``ast`` and safe to run where no accelerator exists.

@@ -1,0 +1,168 @@
+"""Measurement-honesty rules: R07 unfenced-device-timing.
+
+JAX dispatch is asynchronous: a jitted call returns a future-like array
+immediately and the device executes in the background.  So
+
+    t0 = time.perf_counter()
+    out = jitted_fn(x)
+    dt = time.perf_counter() - t0        # measures DISPATCH, not compute
+
+silently reports microseconds for seconds of device work — the classic
+way a "10x speedup" enters a benchmark table and later evaporates.  The
+fix is a fence between the dispatch and the second clock read:
+``jax.block_until_ready(out)``, ``out.block_until_ready()``, or any
+host materialization of the outputs (``np.asarray``, ``.item()``, ...).
+
+R07 flags a ``perf_counter``/``time``/``monotonic`` delta whose window
+contains a *provably jitted* call with no fence between that call and
+the closing clock read.  "Provably jitted" is deliberately conservative
+(the R02/R03 philosophy — silence over noise): the called name must be
+bound from ``jax.jit(...)``/``shard_map(...)`` in this module (including
+``self.<attr>`` assignments) or be a def the module traces.  Calling
+``.lower()``/``.compile()`` ON a jitted object is synchronous AOT work,
+not dispatch, and stays clean.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .context import ModuleContext
+from .engine import get_rule, iter_scopes, make_finding, rule, scope_nodes
+
+_CLOCK_CALLS = {"time.time", "time.perf_counter", "time.monotonic"}
+
+# host materializations that force completion of pending device work.
+# np.asarray & friends only fence the arrays THEY are given — but flagging
+# any window with some materialization in it is the conservative choice
+# (false silence beats false noise; the baseline handles true positives)
+_FENCE_CALLS = {"jax.block_until_ready", "jax.device_get",
+                "numpy.asarray", "numpy.array", "numpy.asanyarray"}
+_FENCE_METHODS = {"block_until_ready", "item", "tolist", "numpy"}
+
+# methods of a jitted object that do NOT dispatch it (AOT pipeline)
+_NON_DISPATCH_ATTRS = {"lower", "compile", "trace", "eval_shape"}
+
+
+def _is_clock_call(ctx: ModuleContext, node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and ctx.resolve(node.func) in _CLOCK_CALLS)
+
+
+def _jit_binding_value(ctx: ModuleContext, value: ast.AST) -> bool:
+    """Is this assigned value a jit/shard_map application (possibly
+    wrapped, e.g. ``jax.jit(shard_map(...))``)?"""
+    while isinstance(value, ast.Call):
+        resolved = ctx.resolve(value.func)
+        if resolved is not None and resolved.rsplit(".", 1)[-1] in (
+                "jit", "pmap", "shard_map"):
+            return True
+        if not value.args:
+            return False
+        value = value.args[0]  # jax.jit(shard_map(body, ...)) nesting
+    return False
+
+
+def _jitted_names(ctx: ModuleContext) -> tuple[set[str], set[str]]:
+    """Module-wide (plain names, attribute names) bound to jitted values:
+    ``f = jax.jit(g)`` and ``self._step = jax.jit(...)``.  Attribute
+    names are collected module-wide — cross-method ``self._step(...)``
+    dispatch is the common engine idiom."""
+    names: set[str] = set()
+    attrs: set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not _jit_binding_value(ctx, node.value):
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                names.add(tgt.id)
+            elif isinstance(tgt, ast.Attribute):
+                attrs.add(tgt.attr)
+    # defs the module provably traces are dispatches too when called bare
+    for fn in ctx.traced:
+        name = getattr(fn, "name", None)
+        if name:
+            names.add(name)
+    return names, attrs
+
+
+def _call_kind(ctx: ModuleContext, node: ast.Call,
+               jit_names: set[str], jit_attrs: set[str]) -> str | None:
+    """"dispatch", "fence", or None for one Call node."""
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        if func.attr in _FENCE_METHODS and not node.args:
+            return "fence"
+        if ctx.resolve(func) in _FENCE_CALLS:
+            return "fence"
+        # self._generation_step(...) — dispatch; but .lower()/.compile()
+        # ON a jitted attr is the synchronous AOT path
+        if func.attr in jit_attrs and func.attr not in _NON_DISPATCH_ATTRS:
+            return "dispatch"
+        return None
+    if isinstance(func, ast.Name):
+        if ctx.resolve(func) in _FENCE_CALLS:
+            return "fence"
+        if func.id in jit_names:
+            return "dispatch"
+    return None
+
+
+@rule("R07", "unfenced-device-timing", "warning",
+      "wall-clock delta around a jitted call without a block_until_ready "
+      "fence measures dispatch, not compute")
+def check_unfenced_timing(ctx: ModuleContext):
+    r = get_rule("R07")
+    jit_names, jit_attrs = _jitted_names(ctx)
+    out = []
+    for symbol, scope in iter_scopes(ctx):
+        starts: list[tuple[str, int]] = []  # (timer var, lineno)
+        deltas: list[tuple[str, int, ast.AST]] = []  # (var, lineno, node)
+        calls: list[tuple[str, int]] = []  # (kind, lineno)
+        for node in scope_nodes(scope):
+            if (isinstance(node, ast.Assign)
+                    and _is_clock_call(ctx, node.value)):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        starts.append((tgt.id, node.lineno))
+            elif (isinstance(node, ast.BinOp)
+                    and isinstance(node.op, ast.Sub)
+                    and _is_clock_call(ctx, node.left)
+                    and isinstance(node.right, ast.Name)):
+                deltas.append((node.right.id, node.lineno, node))
+            elif isinstance(node, ast.Call):
+                kind = _call_kind(ctx, node, jit_names, jit_attrs)
+                if kind is not None:
+                    calls.append((kind, node.lineno))
+        for var, d_line, d_node in deltas:
+            t_lines = [ln for v, ln in starts if v == var and ln < d_line]
+            if not t_lines:
+                continue
+            t_line = max(t_lines)  # nearest start of THIS window
+            unfenced = None
+            # same-line tie-break: dispatch before fence, so the idiom
+            # `jitted(...).block_until_ready()` (fence wrapping dispatch
+            # on one line) counts as fenced
+            order = {"dispatch": 0, "fence": 1}
+            for kind, c_line in sorted(
+                    calls, key=lambda kc: (kc[1], order[kc[0]])):
+                if not (t_line < c_line <= d_line):
+                    continue
+                if kind == "dispatch":
+                    unfenced = c_line
+                elif kind == "fence":
+                    unfenced = None  # everything dispatched so far is fenced
+            if unfenced is not None:
+                out.append(make_finding(
+                    ctx, r, d_node,
+                    f"`{var}` delta spans a jitted dispatch (line "
+                    f"{unfenced}) with no fence before the second clock "
+                    "read — this measures async dispatch, not device "
+                    "compute",
+                    "call jax.block_until_ready(...) on the dispatched "
+                    "outputs (or materialize them with np.asarray/.item()) "
+                    "before taking the delta",
+                    symbol))
+    return out

@@ -15,7 +15,7 @@ Reference has no counterpart (estorch is pure CPU python); this is the
 aux-subsystem "failure detection" obligation (SURVEY.md §5) applied to the
 accelerator itself.
 
-Use:  python -m estorch_tpu.doctor [--timeout S]
+Use:  python -m estorch_tpu.doctor [--timeout S] [--run-dir DIR]
 """
 
 from __future__ import annotations
@@ -144,13 +144,71 @@ def check_host() -> dict:
     }
 
 
-def report(timeout_s: float = 45.0) -> dict:
+def check_obs(run_dir: str | None = None) -> dict:
+    """Observability plumbing health (estorch_tpu/obs/):
+
+    - is the trace/telemetry directory writable (JSONL sinks, jax
+      profiler traces, heartbeat files all land there)?
+    - is TensorBoard importable (TensorBoardSink), or is JsonlSink the
+      only option?
+    - given a run dir: heartbeat freshness — the liveness verdict for a
+      run that stopped printing ("wedged or dead" vs "slow but beating").
+    """
+    import os
+    import tempfile
+
+    from .obs.recorder import STALE_AFTER_S, read_heartbeat
+
+    trace_dir = os.environ.get("ESTORCH_OBS_DIR") or tempfile.gettempdir()
+    try:
+        probe = os.path.join(trace_dir, f".obs_write_probe_{os.getpid()}")
+        with open(probe, "w") as f:
+            f.write("ok")
+        os.remove(probe)
+        writable = True
+        err = None
+    except OSError as e:  # diagnostic tool: never crash the report
+        writable, err = False, repr(e)
+    out: dict = {
+        "trace_dir": {"path": trace_dir, "writable": writable,
+                      **({"error": err} if err else {})},
+    }
+    try:
+        tb = importlib.util.find_spec("torch.utils.tensorboard") is not None
+    except Exception:
+        tb = False
+    out["tensorboard"] = {
+        "available": tb,
+        "needed_for": "obs.TensorBoardSink (obs.JsonlSink needs nothing)",
+    }
+    if run_dir is not None:
+        hb_path = os.path.join(run_dir, "heartbeat.json")
+        hb = read_heartbeat(hb_path)
+        if hb is None:
+            out["heartbeat"] = {
+                "path": hb_path, "found": False,
+                "hint": "no heartbeat — run never started telemetry, "
+                        "finished long ago, or this is the wrong dir",
+            }
+        else:
+            out["heartbeat"] = {
+                "path": hb_path, "found": True,
+                "age_s": round(hb["age_s"], 1),
+                "stale": hb["age_s"] > STALE_AFTER_S,
+                "phase": hb.get("phase"),
+                "generation": hb.get("generation"),
+            }
+    return out
+
+
+def report(timeout_s: float = 45.0, run_dir: str | None = None) -> dict:
     dev = probe_device(timeout_s)
     rep = {
         "device": dev,
         "native": check_native_pool(),
         "optional": check_optional_deps(),
         "host": check_host(),
+        "obs": check_obs(run_dir),
     }
     cpu_recipe = (
         "run on the virtual CPU mesh instead — jax.config.update("
@@ -178,8 +236,11 @@ def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--timeout", type=float, default=45.0,
                    help="device probe timeout in seconds")
+    p.add_argument("--run-dir", default=None, metavar="DIR",
+                   help="training run directory: report heartbeat "
+                        "freshness for a run that stopped answering")
     args = p.parse_args(argv)
-    rep = report(args.timeout)
+    rep = report(args.timeout, run_dir=args.run_dir)
     print(json.dumps(rep, indent=2))
     return 0 if rep["device"]["status"] == "healthy" else 1
 

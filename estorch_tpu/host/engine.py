@@ -32,6 +32,7 @@ from typing import Any, Callable, NamedTuple
 
 import numpy as np
 
+from ..obs.spans import NULL_TELEMETRY
 from ..ops.ranks import centered_rank_np
 
 
@@ -76,6 +77,10 @@ class HostEngine:
     ``(reward, bc)`` — the reference's duck-typed contract (SURVEY.md
     Appendix A).
     """
+
+    # span telemetry hub; ES replaces this with its own (obs/spans.py).
+    # Class-level null default so instrumented paths never branch on None.
+    telemetry = NULL_TELEMETRY
 
     def __init__(
         self,
@@ -299,7 +304,7 @@ class HostEngine:
         steps = int(getattr(agent, "last_episode_steps", 0))
         return HostRolloutResult(float(reward), bc, steps)
 
-    def _proc_evaluate(self, state: HostState) -> HostEvalResult:
+    def _proc_evaluate(self, state: HostState, offs=None) -> HostEvalResult:
         from .procpool import ProcessPool
 
         if self._proc_pool is None or self._proc_pool.n_proc != self.n_proc:
@@ -311,16 +316,22 @@ class HostEngine:
                 master_state=self.master.state_dict(),
                 mirrored=self.mirrored,
             )
+        if offs is None:
+            offs = self._pair_offsets(state)
         fitness, bc, steps = self._proc_pool.evaluate(
-            state.params_flat, self._state_sigma(state), self._pair_offsets(state),
+            state.params_flat, self._state_sigma(state), offs,
             timeout_s=self.proc_timeout_s,
         )
         return HostEvalResult(fitness=fitness, bc=bc, steps=int(steps))
 
-    def evaluate(self, state: HostState) -> HostEvalResult:
+    def evaluate(self, state: HostState, offs=None) -> HostEvalResult:
+        """Population evaluation.  ``offs`` lets generation_step hand in
+        offsets it already derived under the ``sample`` span (the
+        default None re-derives them — same deterministic values)."""
         if self.worker_mode == "process":
-            return self._proc_evaluate(state)
-        offs = self._pair_offsets(state)
+            return self._proc_evaluate(state, offs)
+        if offs is None:
+            offs = self._pair_offsets(state)
         sigma = self._state_sigma(state)
         results: list[HostRolloutResult | None] = [None] * self.population_size
 
@@ -362,7 +373,8 @@ class HostEngine:
 
     # -------------------------------------------------------------- updates
 
-    def apply_weights(self, state: HostState, weights) -> tuple[HostState, float]:
+    def apply_weights(self, state: HostState, weights,
+                      offs=None) -> tuple[HostState, float]:
         """Folded mirrored-pair estimator + torch optimizer step (the
         reference's param.grad → optimizer.step() flow, SURVEY.md §3.2).
 
@@ -376,7 +388,8 @@ class HostEngine:
         import torch
 
         w = np.asarray(weights, dtype=np.float32)
-        offs = self._pair_offsets(state)
+        if offs is None:
+            offs = self._pair_offsets(state)
         sigma = self._state_sigma(state)
         grad_ascent = np.zeros(self.dim, dtype=np.float32)
         if self.mirrored:
@@ -425,9 +438,19 @@ class HostEngine:
     def generation_step(self, state: HostState):
         from ..utils.fault import rank_weights_with_failures
 
-        ev = self.evaluate(state)
-        weights = rank_weights_with_failures(ev.fitness)
-        new_state, gnorm = self.apply_weights(state, weights)
+        obs = self.telemetry
+        # span taxonomy (docs/observability.md): sample = per-generation
+        # noise-offset derivation (cheap BY DESIGN — the shared-table
+        # scheme regenerates ε instead of storing it; a fat sample span
+        # here means that design broke); eval = every member rollout;
+        # update = rank transform + folded estimator + optimizer step
+        with obs.phase("sample"):
+            offs = self._pair_offsets(state)
+        with obs.phase("eval"):
+            ev = self.evaluate(state, offs=offs)
+        with obs.phase("update"):
+            weights = rank_weights_with_failures(ev.fitness)
+            new_state, gnorm = self.apply_weights(state, weights, offs=offs)
         metrics = {
             "fitness": ev.fitness,
             "bc": ev.bc,

@@ -1,0 +1,67 @@
+"""estorch_tpu.obs — first-class observability for ES runs.
+
+Production ES is operationally opaque by default: a generation is one
+fused device program, a wedge surfaces as a supervisor timeout, and a
+regression shows up as a single slower steps/s number with no phase
+attribution.  This package makes every run, wedge, and regression
+explain itself (docs/observability.md):
+
+- **spans** (`spans.py`): per-phase timers (sample/eval/update/...) with
+  ``block_until_ready`` fencing, merged into each generation record;
+- **counters/gauges** (`counters.py`): recompiles, env-steps, rollout
+  failures, peak RSS — one snapshot per run;
+- **flight recorder + heartbeat** (`recorder.py`): ring buffer of recent
+  spans/events + an atomically-rewritten last-known-state file that
+  bench.py, tpu_watch, and doctor read when a run stops answering;
+- **sinks** (`sinks.py`): JSONL / TensorBoard / fan-out record writers
+  (absorbed from ``utils.metrics``; old names still importable there);
+- **manifest** (`manifest.py`): config + jax version + device topology +
+  git sha, written once per run;
+- **summarize** (`summarize.py`, ``python -m estorch_tpu.obs``): phase
+  time share, throughput trend, stall diagnosis from a run JSONL.
+
+``utils.metrics`` and ``utils.profiler`` remain as re-export shims for
+backward compatibility.
+"""
+
+from .counters import Counters, NullCounters
+from .manifest import collect_manifest, load_manifest, write_manifest
+from .recorder import (HEARTBEAT_ENV, STALE_AFTER_S, FlightRecorder,
+                       Heartbeat, describe_heartbeat, read_heartbeat)
+from .sinks import (JsonlSink, JsonlWriter, MultiSink, MultiWriter,
+                    TensorBoardSink, TensorBoardWriter)
+from .spans import NULL_TELEMETRY, Telemetry, resolve_telemetry
+from .summarize import (format_summary, load_records, selfcheck, summarize,
+                        validate_record)
+from .trace import annotate, timed_generations, trace
+
+__all__ = [
+    "Counters",
+    "NullCounters",
+    "FlightRecorder",
+    "Heartbeat",
+    "HEARTBEAT_ENV",
+    "STALE_AFTER_S",
+    "describe_heartbeat",
+    "read_heartbeat",
+    "JsonlSink",
+    "JsonlWriter",
+    "MultiSink",
+    "MultiWriter",
+    "TensorBoardSink",
+    "TensorBoardWriter",
+    "Telemetry",
+    "NULL_TELEMETRY",
+    "resolve_telemetry",
+    "collect_manifest",
+    "write_manifest",
+    "load_manifest",
+    "format_summary",
+    "load_records",
+    "selfcheck",
+    "summarize",
+    "validate_record",
+    "annotate",
+    "timed_generations",
+    "trace",
+]

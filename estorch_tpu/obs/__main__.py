@@ -1,0 +1,86 @@
+"""obs CLI: ``python -m estorch_tpu.obs summarize <run.jsonl>``.
+
+Subcommands:
+
+  summarize <run.jsonl> [--heartbeat PATH] [--json]
+      Per-phase time share, throughput trend, and stall diagnosis for a
+      training-run JSONL (the ``train(log_fn=JsonlSink(...))`` output).
+      ``--heartbeat`` folds a live run's last-known phase/age into the
+      diagnosis.  With no explicit path, a ``heartbeat.json`` next to
+      the JSONL is picked up automatically.
+
+  summarize --selfcheck
+      Validate the golden record against the record schema (CI gate —
+      record-schema drift fails fast here, not in a consumer).
+
+Exit codes: 0 ok; 1 selfcheck problems / unreadable input; 3 bad usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .summarize import format_summary, load_records, selfcheck, summarize
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m estorch_tpu.obs",
+        description="observability tooling (docs/observability.md)")
+    sub = p.add_subparsers(dest="cmd")
+    s = sub.add_parser("summarize",
+                       help="per-phase share + stall diagnosis of a run")
+    s.add_argument("jsonl", nargs="?", default=None,
+                   help="run JSONL (one generation record per line)")
+    s.add_argument("--heartbeat", default=None, metavar="PATH",
+                   help="heartbeat file for live-run stall diagnosis "
+                        "(default: heartbeat.json beside the JSONL)")
+    s.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable summary on stdout")
+    s.add_argument("--selfcheck", action="store_true",
+                   help="validate the golden record schema and exit")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.cmd != "summarize":
+        build_parser().print_help()
+        return 3
+
+    if args.selfcheck:
+        problems = selfcheck()
+        if problems:
+            for pr in problems:
+                print(f"selfcheck: {pr}", file=sys.stderr)
+            return 1
+        print("obs selfcheck: OK (record schema + summarize pipeline)")
+        return 0
+
+    if not args.jsonl:
+        print("summarize needs a run JSONL (or --selfcheck)",
+              file=sys.stderr)
+        return 3
+    try:
+        records = load_records(args.jsonl)
+    except (OSError, ValueError) as e:
+        print(f"cannot read {args.jsonl}: {e}", file=sys.stderr)
+        return 1
+    hb = args.heartbeat
+    if hb is None:
+        cand = os.path.join(os.path.dirname(os.path.abspath(args.jsonl)),
+                            "heartbeat.json")
+        hb = cand if os.path.exists(cand) else None
+    s = summarize(records, heartbeat_path=hb)
+    if args.as_json:
+        print(json.dumps(s, default=float))
+    else:
+        print(format_summary(s))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

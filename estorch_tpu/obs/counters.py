@@ -1,0 +1,73 @@
+"""Counters/gauges registry — the numeric facts of one run.
+
+Counters are monotone (``inc``), gauges are last-write-wins (``gauge``);
+both live in one flat name → value dict so exporting a run's telemetry
+is one ``snapshot()``.  Thread-safe: the host backend's worker threads
+increment rollout-failure counters concurrently with the training loop.
+
+Names follow a short dotted convention (no enforced schema — the
+registry is generic): ``env_steps``, ``generations``, ``recompiles``,
+``rollout_failures``, ``stage_timeouts``, ``peak_rss_mb``,
+``compile_time_s``.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class Counters:
+    """Flat registry of counters (monotone) and gauges (overwrite)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._values: dict[str, float] = {}
+
+    def inc(self, name: str, n: float = 1) -> None:
+        with self._lock:
+            self._values[name] = self._values.get(name, 0) + n
+
+    def gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._values[name] = value
+
+    def get(self, name: str, default: float = 0) -> float:
+        with self._lock:
+            return self._values.get(name, default)
+
+    def snapshot(self) -> dict[str, float]:
+        """Point-in-time copy (safe to serialize while workers run)."""
+        with self._lock:
+            return dict(self._values)
+
+    def sample_peak_rss(self) -> float:
+        """Record the process's peak RSS as the ``peak_rss_mb`` gauge.
+
+        ``getrusage`` is a single syscall (~1µs) — cheap enough to call
+        once per generation.  ru_maxrss is KiB on Linux, bytes on macOS.
+        """
+        import resource
+        import sys
+
+        div = 2**20 if sys.platform == "darwin" else 2**10
+        mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / div
+        self.gauge("peak_rss_mb", round(mb, 3))
+        return mb
+
+
+class NullCounters(Counters):
+    """Inert registry for disabled telemetry.  Engines increment
+    counters unconditionally (engine code must not branch on the hub's
+    state), so a DISABLED hub — in particular the process-wide shared
+    NULL_TELEMETRY default every engine starts with — must swallow
+    writes: otherwise unrelated engines in one process would aggregate
+    `recompiles` etc. into one global grab-bag."""
+
+    def inc(self, name: str, n: float = 1) -> None:
+        pass
+
+    def gauge(self, name: str, value: float) -> None:
+        pass
+
+    def sample_peak_rss(self) -> float:
+        return 0.0

@@ -1,0 +1,103 @@
+"""Run manifest: the immutable facts a regression hunt needs first.
+
+"Which jax? which devices? which commit? which config?" — questions a
+run's JSONL cannot answer about itself.  The manifest is one JSON file
+written at run start: config, versions, device topology, git sha,
+hostname/pid.  ``ES.run_manifest()`` builds it from a live ES (safe:
+the backend is already initialized, so reading device attributes cannot
+wedge a cold runtime — the reason this module never calls
+``jax.devices()`` on its own).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+MANIFEST_SCHEMA = 1
+
+
+def _git_sha(cwd: str | None = None) -> str | None:
+    """Best-effort HEAD sha; None outside a repo / without git.  Bounded:
+    a hung VCS helper must not block run startup (esguard R05)."""
+    try:
+        r = subprocess.run(
+            ["git", "rev-parse", "HEAD"], cwd=cwd, timeout=5.0,
+            capture_output=True, text=True,
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    sha = r.stdout.strip()
+    return sha if r.returncode == 0 and sha else None
+
+
+def collect_manifest(config: dict | None = None,
+                     devices=None,
+                     extra: dict | None = None) -> dict:
+    """Assemble the manifest dict.
+
+    ``devices``: an iterable of jax Device objects (e.g. ``es.mesh.
+    devices.flat``) — pass them from a context that already initialized
+    the backend; this function will not touch one itself.
+    """
+    import socket
+
+    man: dict = {
+        "schema": MANIFEST_SCHEMA,
+        "created_unix": time.time(),
+        "argv": list(sys.argv),
+        "python": sys.version.split()[0],
+        "hostname": socket.gethostname(),
+        "pid": os.getpid(),
+        "git_sha": _git_sha(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))),
+    }
+    try:
+        import jax
+
+        man["jax"] = jax.__version__
+    except Exception:  # manifest must assemble even on a broken install
+        man["jax"] = None
+    try:
+        import numpy as np
+
+        man["numpy"] = np.__version__
+    except Exception:
+        man["numpy"] = None
+    if devices is not None:
+        man["devices"] = [
+            {"id": int(getattr(d, "id", i)),
+             "platform": str(getattr(d, "platform", "?")),
+             "kind": str(getattr(d, "device_kind", "?")),
+             "process_index": int(getattr(d, "process_index", 0))}
+            for i, d in enumerate(devices)
+        ]
+    if config is not None:
+        man["config"] = config
+    if extra:
+        man.update(extra)
+    return man
+
+
+def write_manifest(path: str, manifest: dict) -> str:
+    """Atomic write (tmp + rename); returns the absolute path."""
+    path = os.path.abspath(path)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=2, default=float)
+    os.replace(tmp, path)
+    return path
+
+
+def load_manifest(path: str) -> dict:
+    with open(path) as f:
+        man = json.load(f)
+    if man.get("schema") != MANIFEST_SCHEMA:
+        raise ValueError(
+            f"manifest schema {man.get('schema')!r} != {MANIFEST_SCHEMA} "
+            f"(file: {path})")
+    return man

@@ -1,0 +1,171 @@
+"""Span telemetry: low-overhead phase timers for the training loop.
+
+A *span* is one timed phase of a generation — ``sample`` / ``eval`` /
+``update`` on the host and pooled backends, ``dispatch`` / ``device`` /
+``host_sync`` on the fused device path (whose single XLA program cannot
+be split finer without de-fusing it; docs/observability.md has the full
+taxonomy).  Spans nest: a phase entered inside another is recorded under
+``parent/child`` (e.g. ``update/obsnorm_merge``), and the parent's time
+includes its children — per-phase *share* therefore sums top-level names
+only.
+
+Device honesty: wall-clocking an async-dispatched jitted call measures
+dispatch, not compute (esguard R07).  Every device span either contains
+its own materialization (``np.asarray`` of an output) or passes
+``fence=`` — a callable run before the clock stops, typically
+``jax.block_until_ready`` on the program's outputs.
+
+Overhead budget: a disabled Telemetry's ``phase()`` yields a cached
+no-op context manager (two attribute loads); an enabled one costs two
+``perf_counter`` calls + dict update per span.  Heartbeat/file work only
+happens when a heartbeat path is configured (supervisors opt in via the
+``ESTORCH_OBS_HEARTBEAT`` env var).  Measured A/B: default-on spans are
+<2% of bench wall time (BENCHMARKS.md).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+
+from .counters import Counters, NullCounters
+from .recorder import HEARTBEAT_ENV, FlightRecorder, Heartbeat
+
+OBS_DISABLE_ENV = "ESTORCH_OBS"  # "0" disables default-on telemetry
+
+# shared stateless no-op context manager: the disabled path costs one
+# attribute check + one return, no generator construction per span
+_NULL_CM = contextlib.nullcontext()
+
+
+class Telemetry:
+    """Per-run telemetry hub: spans + counters + flight recorder + heartbeat.
+
+    One instance rides each ``ES`` (``es.obs``); engines receive it as
+    their ``telemetry`` attribute so sub-generation phases land in the
+    same accumulator the train loop flushes into the generation record.
+    """
+
+    def __init__(self, enabled: bool = True,
+                 heartbeat_path: str | None = None,
+                 recorder_capacity: int = 512):
+        self.enabled = bool(enabled)
+        # disabled hubs swallow counter writes too — engines inc
+        # unconditionally, and the shared NULL_TELEMETRY default must
+        # never aggregate state across unrelated engines (see NullCounters)
+        self.counters = Counters() if self.enabled else NullCounters()
+        self.recorder = FlightRecorder(recorder_capacity)
+        self.heartbeat = Heartbeat(heartbeat_path) if heartbeat_path else None
+        self.generation = 0
+        self._acc: dict[str, float] = {}
+        self._stack: list[str] = []
+
+    # ------------------------------------------------------------- factory
+
+    @classmethod
+    def from_env(cls) -> "Telemetry":
+        """Default-on construction honoring the env-var protocol:
+        ``ESTORCH_OBS=0`` disables, ``ESTORCH_OBS_HEARTBEAT=<path>``
+        (set by supervisors like bench.py stages) enables the heartbeat
+        file."""
+        enabled = os.environ.get(OBS_DISABLE_ENV, "1") != "0"
+        hb = os.environ.get(HEARTBEAT_ENV) or None
+        return cls(enabled=enabled, heartbeat_path=hb if enabled else None)
+
+    # --------------------------------------------------------------- spans
+
+    def phase(self, name: str, fence=None):
+        """Time one phase; ``fence()`` (if given) runs before the clock
+        stops — pass a ``block_until_ready`` closure for device work."""
+        if not self.enabled:
+            return _NULL_CM
+        return self._phase_cm(name, fence)
+
+    @contextlib.contextmanager
+    def _phase_cm(self, name: str, fence):
+        full = f"{self._stack[-1]}/{name}" if self._stack else name
+        self._stack.append(full)
+        if self.heartbeat is not None:
+            # beat on ENTRY: a wedge inside this phase leaves its name —
+            # not the previous phase's — as the last-known state
+            self.heartbeat.beat(full, self.generation,
+                                self.counters.snapshot())
+        t0 = time.perf_counter()
+        try:
+            yield
+            if fence is not None:
+                fence()
+        finally:
+            dt = time.perf_counter() - t0
+            self._stack.pop()
+            self._acc[full] = self._acc.get(full, 0.0) + dt
+            self.recorder.add("span", full, dur_s=dt,
+                              generation=self.generation)
+
+    def take_phases(self) -> dict[str, float]:
+        """Flush this generation's span accumulator (merged into the
+        generation record) and advance the generation counter."""
+        if not self.enabled:
+            return {}
+        out = {k: round(v, 6) for k, v in self._acc.items()}
+        self._acc.clear()
+        self.generation += 1
+        self.counters.inc("generations")
+        self.counters.sample_peak_rss()
+        if self.heartbeat is not None:
+            self.heartbeat.beat("between_generations", self.generation,
+                                self.counters.snapshot())
+        return out
+
+    def discard_phases(self) -> None:
+        """Drop accumulated spans without emitting them.  Train loops
+        call this on entry: a generation that aborted mid-phase (dead
+        env raising through the loop — the documented catch-and-resume
+        contract) leaves partial spans behind, which must not be merged
+        into the next successful generation's record.  The flight
+        recorder keeps the aborted spans for post-mortems."""
+        self._acc.clear()
+
+    def note(self, phase: str) -> None:
+        """Heartbeat-only marker for long un-spanned stretches (backend
+        init, XLA compile): a wedge there should still leave a last-known
+        phase behind, without polluting the span accumulator."""
+        if self.enabled and self.heartbeat is not None:
+            self.heartbeat.beat(phase, self.generation,
+                                self.counters.snapshot())
+
+    # -------------------------------------------------------------- events
+
+    def event(self, name: str, **extra) -> None:
+        """Record a non-span event (compile, retry, error) in the ring."""
+        if self.enabled:
+            self.recorder.add("event", name, generation=self.generation,
+                              **extra)
+
+
+class _NullTelemetry(Telemetry):
+    """Shared disabled instance — the default ``telemetry`` attribute of
+    every engine, so instrumented code never branches on None."""
+
+    def __init__(self):
+        super().__init__(enabled=False)
+
+
+NULL_TELEMETRY = _NullTelemetry()
+
+
+def resolve_telemetry(telemetry) -> Telemetry:
+    """ES's ``telemetry=`` kwarg → a Telemetry: None → env-driven
+    default-on, bool → forced on/off, instance → as-is."""
+    if telemetry is None:
+        return Telemetry.from_env()
+    if isinstance(telemetry, Telemetry):
+        return telemetry
+    if telemetry is True:
+        return Telemetry(enabled=True,
+                         heartbeat_path=os.environ.get(HEARTBEAT_ENV) or None)
+    if telemetry is False:
+        return Telemetry(enabled=False)
+    raise TypeError(
+        f"telemetry must be None, a bool, or a Telemetry, got {telemetry!r}")

@@ -1,0 +1,281 @@
+"""Run-JSONL summarizer: per-phase time share, throughput trend, stalls.
+
+``python -m estorch_tpu.obs summarize run.jsonl`` answers the three
+questions every perf PR and every wedged run raises:
+
+1. *Where does the time go?* — per-phase share aggregated from the span
+   breakdown each record carries (top-level phases only; nested
+   ``parent/child`` spans are listed under their parent).
+2. *Is it getting slower?* — first-half vs second-half env-steps/s.
+3. *Did it stall?* — generations whose wall time is a large multiple of
+   the median, plus (``--heartbeat``) the live last-phase/age of a run
+   that never finished.
+
+``--selfcheck`` validates the module's golden record against the record
+schema — run in CI (run_lint.sh) so ``ES._base_record`` drift and schema
+drift fail fast, before a consumer parses mismatched JSONL.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+
+from .recorder import STALE_AFTER_S, read_heartbeat
+
+# record schema: key -> (types, required).  Floats accept ints (JSON
+# round-trips 1.0 as 1); NaN/inf are legal values (failed generations).
+RECORD_SCHEMA: dict[str, tuple[tuple[type, ...], bool]] = {
+    "generation": ((int,), True),
+    "reward_max": ((float, int), True),
+    "reward_mean": ((float, int), True),
+    "reward_min": ((float, int), False),
+    "n_failed": ((int,), False),
+    "best_reward": ((float, int), True),
+    "improved_best": ((bool,), False),
+    "env_steps": ((int,), True),
+    "env_steps_per_sec": ((float, int), True),
+    "grad_norm": ((float, int), False),
+    "sigma": ((float, int), False),
+    "wall_time_s": ((float, int), True),
+    "phases": ((dict,), False),
+}
+
+# a record shaped exactly like ES._base_record + span merge emits — the
+# selfcheck fixture.  If _base_record changes shape, update BOTH (the
+# tier-1 test_obs.py run-produced-records check catches a one-sided edit).
+GOLDEN_RECORD = {
+    "generation": 0,
+    "reward_max": -120.5,
+    "reward_mean": -400.25,
+    "reward_min": -800.0,
+    "n_failed": 0,
+    "best_reward": -120.5,
+    "improved_best": True,
+    "env_steps": 819200,
+    "env_steps_per_sec": 512000.0,
+    "grad_norm": 0.731,
+    "sigma": 0.05,
+    "wall_time_s": 1.6,
+    "phases": {"sample": 0.01, "eval": 1.2, "update": 0.3,
+               "update/obsnorm_merge": 0.05},
+}
+
+
+def validate_record(rec: dict) -> list[str]:
+    """Schema problems in one record ([] when clean)."""
+    problems = []
+    if not isinstance(rec, dict):
+        return [f"record is {type(rec).__name__}, not an object"]
+    for key, (types, required) in RECORD_SCHEMA.items():
+        if key not in rec:
+            if required:
+                problems.append(f"missing required key {key!r}")
+            continue
+        v = rec[key]
+        # bool is an int subclass — don't let True satisfy an int field
+        if isinstance(v, bool) and bool not in types:
+            problems.append(f"{key!r} is bool, expected "
+                            f"{'/'.join(t.__name__ for t in types)}")
+        elif not isinstance(v, types):
+            problems.append(f"{key!r} is {type(v).__name__}, expected "
+                            f"{'/'.join(t.__name__ for t in types)}")
+    phases = rec.get("phases")
+    if isinstance(phases, dict):
+        for name, dur in phases.items():
+            if not isinstance(name, str):
+                problems.append(f"phase key {name!r} is not a string")
+            elif (not isinstance(dur, (int, float))
+                  or isinstance(dur, bool) or dur < 0):
+                problems.append(f"phase {name!r} duration {dur!r} is not a "
+                                "non-negative number")
+    return problems
+
+
+def load_records(path: str) -> list[dict]:
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def _median(xs: list[float]) -> float:
+    s = sorted(xs)
+    n = len(s)
+    if n == 0:
+        return float("nan")
+    return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
+
+
+STALL_FACTOR = 5.0  # a generation this many × the median wall time stalls
+
+
+def summarize(records: list[dict], heartbeat_path: str | None = None) -> dict:
+    """Aggregate a run's records into the summary dict the CLI prints."""
+    if not records:
+        return {"generations": 0, "diagnosis": "no records"}
+    walls = [float(r.get("wall_time_s", 0.0)) for r in records]
+    steps = [int(r.get("env_steps", 0)) for r in records]
+    wall_total = sum(walls)
+
+    # ---- per-phase aggregation (top-level vs nested) -------------------
+    top: dict[str, float] = {}
+    children: dict[str, dict[str, float]] = {}
+    for r in records:
+        for name, dur in (r.get("phases") or {}).items():
+            if "/" in name:
+                parent, _, child = name.partition("/")
+                children.setdefault(parent, {})
+                children[parent][child] = (
+                    children[parent].get(child, 0.0) + float(dur))
+            else:
+                top[name] = top.get(name, 0.0) + float(dur)
+    span_total = sum(top.values())
+    phase_share = {
+        name: {"seconds": round(sec, 4),
+               "share": round(sec / span_total, 4) if span_total else 0.0}
+        for name, sec in sorted(top.items(), key=lambda kv: -kv[1])
+    }
+    for parent, kids in children.items():
+        if parent in phase_share:
+            phase_share[parent]["children"] = {
+                k: round(v, 4) for k, v in kids.items()}
+
+    # ---- throughput trend ---------------------------------------------
+    half = len(records) // 2
+    trend = None
+    if half >= 1 and sum(walls[:half]) > 0 and sum(walls[half:]) > 0:
+        first = sum(steps[:half]) / sum(walls[:half])
+        second = sum(steps[half:]) / sum(walls[half:])
+        trend = {
+            "first_half_steps_per_s": round(first, 1),
+            "second_half_steps_per_s": round(second, 1),
+            "ratio": round(second / first, 4) if first > 0 else None,
+        }
+
+    # ---- stall detection ----------------------------------------------
+    med = _median(walls)
+    stalls = [
+        {"generation": int(r.get("generation", i)),
+         "wall_time_s": round(w, 3),
+         "x_median": round(w / med, 1)}
+        for i, (r, w) in enumerate(zip(records, walls))
+        if med > 0 and w > STALL_FACTOR * med
+    ]
+
+    diagnosis = []
+    if stalls:
+        worst = max(stalls, key=lambda s: s["x_median"])
+        diagnosis.append(
+            f"gen {worst['generation']} took {worst['x_median']}x the "
+            f"median generation ({worst['wall_time_s']}s vs {med:.3f}s)")
+    if trend and trend["ratio"] is not None and trend["ratio"] < 0.8:
+        diagnosis.append(
+            f"throughput decayed to {trend['ratio']:.0%} of the first half")
+    hb = None
+    if heartbeat_path:
+        hb = read_heartbeat(heartbeat_path)
+        if hb is None:
+            diagnosis.append(
+                f"heartbeat unreadable at {heartbeat_path} — run never "
+                "started telemetry, or the path is wrong")
+        else:
+            state = (f"last phase={hb.get('phase')} "
+                     f"gen={hb.get('generation')} "
+                     f"beat {hb['age_s']:.0f}s ago")
+            if hb["age_s"] > STALE_AFTER_S:
+                diagnosis.append(f"STALE heartbeat: {state} — the run is "
+                                 "wedged or dead, not slow")
+            else:
+                diagnosis.append(f"heartbeat fresh: {state}")
+    if not diagnosis:
+        diagnosis.append("steady: no stalls, no throughput decay")
+
+    out = {
+        "generations": len(records),
+        "wall_time_s": round(wall_total, 3),
+        "env_steps": sum(steps),
+        "env_steps_per_sec": (round(sum(steps) / wall_total, 1)
+                              if wall_total > 0 else None),
+        "span_coverage": (round(span_total / wall_total, 4)
+                          if wall_total > 0 and span_total else 0.0),
+        "phase_share": phase_share,
+        "throughput": trend,
+        "stalls": stalls,
+        "diagnosis": "; ".join(diagnosis),
+    }
+    if hb is not None:
+        out["heartbeat"] = hb
+    return out
+
+
+def format_summary(s: dict) -> str:
+    """Human rendering of :func:`summarize`'s dict."""
+    if not s.get("generations"):
+        return "no records"
+    lines = [
+        f"generations      {s['generations']}",
+        f"wall time        {s['wall_time_s']:.3f}s",
+        f"env steps        {s['env_steps']:,}",
+        f"env steps/s      {s['env_steps_per_sec']:,}"
+        if s["env_steps_per_sec"] is not None else "env steps/s      n/a",
+    ]
+    if s["phase_share"]:
+        lines.append(f"phase share      (covers "
+                     f"{s['span_coverage']:.0%} of wall)")
+        for name, row in s["phase_share"].items():
+            bar = "#" * max(1, int(40 * row["share"]))
+            lines.append(f"  {name:<14} {row['share']:7.1%}  "
+                         f"{row['seconds']:9.3f}s  {bar}")
+            for child, sec in row.get("children", {}).items():
+                lines.append(f"    └ {child:<12} {'':7}  {sec:9.3f}s")
+    else:
+        lines.append("phase share      none recorded (telemetry disabled?)")
+    t = s.get("throughput")
+    if t:
+        lines.append(
+            f"throughput       {t['first_half_steps_per_s']:,} → "
+            f"{t['second_half_steps_per_s']:,} steps/s "
+            f"(x{t['ratio']})")
+    lines.append(f"diagnosis        {s['diagnosis']}")
+    return "\n".join(lines)
+
+
+def selfcheck() -> list[str]:
+    """Schema self-validation for CI ([] = healthy).
+
+    Checks the golden record against the schema, that a synthetic run
+    through :func:`summarize` produces the promised keys, and that the
+    stall detector fires on an obvious stall.
+    """
+    problems = list(validate_record(GOLDEN_RECORD))
+    # a deliberately-broken record must FAIL validation (the validator
+    # itself could silently rot into accepting everything)
+    broken = dict(GOLDEN_RECORD, env_steps="many")
+    broken.pop("reward_mean")
+    if not validate_record(broken):
+        problems.append("validator accepted a broken record")
+    recs = []
+    for g in range(6):
+        r = dict(GOLDEN_RECORD, generation=g,
+                 wall_time_s=1.0 if g != 4 else 30.0)
+        recs.append(json.loads(json.dumps(r)))  # via-JSON: CLI-equivalent
+    s = summarize(recs)
+    for key in ("generations", "wall_time_s", "env_steps",
+                "env_steps_per_sec", "phase_share", "throughput",
+                "stalls", "diagnosis"):
+        if key not in s:
+            problems.append(f"summary missing {key!r}")
+    if not s.get("stalls"):
+        problems.append("stall detector missed a 30x-median generation")
+    share = s.get("phase_share", {})
+    for phase in ("sample", "eval", "update"):
+        if phase not in share:
+            problems.append(f"phase_share missing {phase!r}")
+    if "update" in share and "obsnorm_merge" not in share["update"].get(
+            "children", {}):
+        problems.append("nested span update/obsnorm_merge not aggregated")
+    total_share = sum(row["share"] for row in share.values())
+    if share and not math.isclose(total_share, 1.0, abs_tol=1e-3):
+        problems.append(f"top-level shares sum to {total_share}, not 1")
+    if format_summary(s) == "no records":
+        problems.append("format_summary rendered nothing")
+    return problems

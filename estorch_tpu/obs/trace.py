@@ -1,0 +1,65 @@
+"""Device-trace hooks (migrated from ``utils/profiler.py``, which remains
+a re-export shim).
+
+Span telemetry (obs/spans.py) answers "which phase got slower" for free
+on every run; these helpers are the heavyweight next step when a phase
+needs opening up:
+
+- ``trace(logdir)``: context manager around ``jax.profiler`` producing a
+  Perfetto/XPlane trace of the compiled generation programs;
+- ``timed_generations(es, n)``: per-generation wall/device split using
+  ``block_until_ready`` fences — the cheap always-available option;
+- ``annotate(name)`` via ``jax.profiler.TraceAnnotation`` for host-side
+  phases (novelty k-NN, archive ops) so they show up inside device
+  traces.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+
+@contextlib.contextmanager
+def trace(logdir: str):
+    """jax.profiler trace of everything inside the with-block."""
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Host-phase annotation visible in device traces (no-op off-trace)."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)
+
+
+def timed_generations(es, n: int = 5, warmup: int = 1) -> dict:
+    """Run ``n`` timed generations; returns aggregate timing stats.
+
+    Forces AOT compile (via train's first call) and a ``warmup``
+    generation first so results measure steady-state execution only.
+    The wall clock is fenced: ``es.train`` blocks on the updated
+    parameters every generation, so the delta below measures executed
+    compute, not async dispatch (esguard R07 contract).
+    """
+    es.train(warmup, verbose=False)
+    t0 = time.perf_counter()
+    es.train(n, verbose=False)
+    wall = time.perf_counter() - t0
+    recs = es.history[-n:]
+    steps = sum(r["env_steps"] for r in recs)
+    return {
+        "generations": n,
+        "wall_s": wall,
+        "gen_per_sec": n / wall,
+        "env_steps": steps,
+        "env_steps_per_sec": steps / wall,
+        "mean_gen_wall_s": wall / n,
+        "compile_time_s": es.compile_time_s,
+    }

@@ -37,6 +37,7 @@ import optax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..envs.rollout import carry_init_takes_params, make_obs_probe, make_rollout
+from ..obs.spans import NULL_TELEMETRY
 from ..utils.backend import shard_map
 from ..ops.gradient import es_gradient, rank_weighted_noise_sum
 from ..ops.noise import NoiseTable, member_offsets, pair_signs, sample_pair_offsets
@@ -289,6 +290,11 @@ NOISE_KERNEL_MAX_DIM = 1_000_000  # 3·dim f32 ≈ 12 MiB of ~16 MiB v5e VMEM
 
 class ESEngine:
     """Compiles and caches the per-generation XLA programs for one setup."""
+
+    # span telemetry hub; ES replaces this with its own (obs/spans.py).
+    # The fused generation program cannot be phase-split host-side — the
+    # engine's contributions are compile events + recompile counters
+    telemetry = NULL_TELEMETRY
 
     def __init__(
         self,
@@ -973,7 +979,11 @@ class ESEngine:
 
         t0 = _time.perf_counter()
         self._generation_step.lower(state).compile()
-        return _time.perf_counter() - t0
+        dt = _time.perf_counter() - t0
+        self.telemetry.counters.inc("recompiles")
+        self.telemetry.counters.gauge("compile_time_s", dt)
+        self.telemetry.event("compile", what="generation_step", dur_s=dt)
+        return dt
 
     def compile_split(self, state: ESState) -> float:
         """AOT-compile the split-path programs (evaluate, apply_weights,
@@ -985,7 +995,11 @@ class ESEngine:
         dummy_w = jnp.zeros((self.config.population_size,), jnp.float32)
         self._apply_weights.lower(state, dummy_w).compile()
         self._center_eval.lower(state).compile()
-        return _time.perf_counter() - t0
+        dt = _time.perf_counter() - t0
+        self.telemetry.counters.inc("recompiles", 3)
+        self.telemetry.counters.gauge("compile_time_s", dt)
+        self.telemetry.event("compile", what="split_path", dur_s=dt)
+        return dt
 
     def generation_step(self, state: ESState):
         """Fused ES generation: returns (new_state, metrics dict)."""

@@ -50,6 +50,7 @@ def initialize(
     coordinator_address: str | None = None,
     num_processes: int | None = None,
     process_id: int | None = None,
+    telemetry=None,
 ) -> bool:
     """Bring up the JAX multi-process runtime.  Returns True if distributed
     init actually happened, False for a single-process fallback.
@@ -63,15 +64,28 @@ def initialize(
     device use (no jax API that touches backends runs before the attempt).
     """
     explicit = any(a is not None for a in (coordinator_address, num_processes, process_id))
+    import time as _time
+
+    if telemetry is None:
+        from ..obs.spans import NULL_TELEMETRY as telemetry  # noqa: N811
+    t0 = _time.perf_counter()
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,
             process_id=process_id,
         )
+        # cluster bring-up is the multi-host wedge point (a peer that
+        # never dials in hangs everyone here) — record how long it took
+        # and who we are, so a pod post-mortem can see which hosts made
+        # it through and when
+        telemetry.event("distributed_init",
+                        dur_s=_time.perf_counter() - t0, **process_info())
         return True
     except Exception as e:
         if explicit:
+            telemetry.event("distributed_init_failed",
+                            dur_s=_time.perf_counter() - t0, error=repr(e))
             raise
         # not a cluster → single-process run; but say WHY, so an operator on
         # a real pod can tell "not a cluster" from "cluster init failed"
@@ -85,6 +99,8 @@ def initialize(
             "independently.",
             stacklevel=2,
         )
+        telemetry.event("distributed_init_fallback",
+                        dur_s=_time.perf_counter() - t0, error=repr(e))
         return False
 
 

@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 
 from ..envs.gym_vec_pool import make_pool
+from ..obs.spans import NULL_TELEMETRY
 from ..ops.noise import member_offsets, pair_signs
 from ..utils.fault import rank_weights_with_failures
 from .engine import ESEngine, ESState
@@ -39,6 +40,9 @@ class PooledEvalResult:
 
 class PooledEngine:
     """Same engine interface as ESEngine/HostEngine, pooled evaluation."""
+
+    # span telemetry hub; ES replaces this with its own (obs/spans.py)
+    telemetry = NULL_TELEMETRY
 
     def __init__(
         self,
@@ -293,7 +297,11 @@ class PooledEngine:
             self._batch_actions(thetas[:warm_n], obs).block_until_ready()
         dummy_w = jnp.zeros((self.config.population_size,), jnp.float32)
         self.core._apply_weights.lower(state, dummy_w).compile()
-        return _time.perf_counter() - t0
+        dt = _time.perf_counter() - t0
+        self.telemetry.counters.inc("recompiles", 2)
+        self.telemetry.counters.gauge("compile_time_s", dt)
+        self.telemetry.event("compile", what="pooled_forward+update", dur_s=dt)
+        return dt
 
     compile_split = compile
 
@@ -301,8 +309,14 @@ class PooledEngine:
         return self.core.member_params(state, member_index)
 
     def evaluate(self, state: ESState) -> PooledEvalResult:
-        pair_offs = self.core.all_pair_offsets(state)
-        thetas = self._materialize(state.params_flat, state.sigma, pair_offs)
+        with self.telemetry.phase("sample"):
+            pair_offs = self.core.all_pair_offsets(state)
+            thetas = self._materialize(state.params_flat, state.sigma,
+                                       pair_offs)
+            # fence: materialization is device work — unfenced, this span
+            # would clock dispatch only and the first batched forward of
+            # the step loop would absorb the compute (esguard R07)
+            jax.block_until_ready(thetas)
         norm = self._norm_params(state) if self.obs_norm else None
         if self.obs_norm:
             # raw-moment accumulators for this generation's alive steps —
@@ -547,26 +561,32 @@ class PooledEngine:
             # cancel catastrophically in the f32 in-program merge
             from .engine import merge_obs_moments_np
 
-            c1, s1, q1 = self._pending_moments
-            self._pending_moments = None
-            if c1 > 0:
-                new_state = new_state._replace(
-                    obs_stats=merge_obs_moments_np(
-                        new_state.obs_stats, c1, s1, q1
+            with self.telemetry.phase("obsnorm_merge"):
+                c1, s1, q1 = self._pending_moments
+                self._pending_moments = None
+                if c1 > 0:
+                    new_state = new_state._replace(
+                        obs_stats=merge_obs_moments_np(
+                            new_state.obs_stats, c1, s1, q1
+                        )
                     )
-                )
         else:
             # stale moments from a discarded evaluation: drop, never merge
             self._pending_moments = None
         return new_state, gnorm
 
     def generation_step(self, state: ESState):
-        ev = self.evaluate(state)
-        fit = np.asarray(ev.fitness)
+        obs = self.telemetry
+        with obs.phase("eval"):
+            ev = self.evaluate(state)
+            fit = np.asarray(ev.fitness)
         # NaN-safe: a crashed/diverged rollout must not win the top rank
         # (np.argsort sorts NaN last) — drop it and renormalize survivors
-        weights = rank_weights_with_failures(fit)
-        new_state, gnorm = self.apply_weights(state, weights)
+        with obs.phase("update"):
+            weights = rank_weights_with_failures(fit)
+            new_state, gnorm = self.apply_weights(state, weights)
+            # fence the psum/optax program so the span is device time
+            jax.block_until_ready(new_state.params_flat)
         metrics = {
             "fitness": ev.fitness,
             "bc": ev.bc,

@@ -1,84 +1,13 @@
-"""Structured metrics writers (SURVEY.md §5 'Metrics / logging').
-
-The reference prints per-generation max/mean reward and nothing else.  Here
-every generation emits a structured record (see ES._base_record — reward
-stats, env-steps/sec, grad norm, novelty stats for the NS family), and these
-writers plug into ``train(log_fn=...)``:
-
-- JsonlWriter: one JSON object per line, append-only, crash-safe.
-- TensorBoardWriter: optional (gated on torch.utils.tensorboard).
-- MultiWriter: fan-out to several writers + optional console echo.
+"""Backward-compat shim: the metrics writers moved to
+:mod:`estorch_tpu.obs.sinks` (the observability subsystem,
+docs/observability.md).  Import from ``estorch_tpu.obs`` in new code;
+this module keeps the historical ``utils.metrics`` surface alive.
 """
 
 from __future__ import annotations
 
-import json
-import os
-from typing import Callable, Sequence
+from ..obs.sinks import (JsonlSink, JsonlWriter, MultiSink,  # noqa: F401
+                         MultiWriter, TensorBoardSink, TensorBoardWriter)
 
-
-class JsonlWriter:
-    """Append each generation record as one JSON line."""
-
-    def __init__(self, path: str):
-        self.path = os.path.abspath(path)
-        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
-        self._fh = open(self.path, "a", buffering=1)
-
-    def __call__(self, record: dict) -> None:
-        self._fh.write(json.dumps(record, default=float) + "\n")
-
-    def close(self) -> None:
-        self._fh.close()
-
-    @staticmethod
-    def read(path: str) -> list[dict]:
-        with open(path) as f:
-            return [json.loads(line) for line in f if line.strip()]
-
-
-class TensorBoardWriter:
-    """Scalars to TensorBoard via torch.utils.tensorboard (optional dep)."""
-
-    def __init__(self, logdir: str):
-        try:
-            from torch.utils.tensorboard import SummaryWriter
-        except ImportError as e:  # tensorboard not installed in this image
-            raise ImportError(
-                "TensorBoardWriter needs the tensorboard package; use "
-                "JsonlWriter in this environment"
-            ) from e
-        self._w = SummaryWriter(logdir)
-
-    def __call__(self, record: dict) -> None:
-        step = record.get("generation", 0)
-        for k, v in record.items():
-            if isinstance(v, (int, float)) and k != "generation":
-                self._w.add_scalar(f"es/{k}", v, step)
-
-    def close(self) -> None:
-        self._w.close()
-
-
-class MultiWriter:
-    """Fan a record out to several writers; optionally echo to stdout."""
-
-    def __init__(self, writers: Sequence[Callable[[dict], None]], echo: bool = False):
-        self.writers = list(writers)
-        self.echo = echo
-
-    def __call__(self, record: dict) -> None:
-        for w in self.writers:
-            w(record)
-        if self.echo:
-            print(
-                f"gen {record.get('generation', '?'):>4}  "
-                f"max {record.get('reward_max', float('nan')):9.2f}  "
-                f"mean {record.get('reward_mean', float('nan')):9.2f}  "
-                f"steps/s {record.get('env_steps_per_sec', 0):,.0f}"
-            )
-
-    def close(self) -> None:
-        for w in self.writers:
-            if hasattr(w, "close"):
-                w.close()
+__all__ = ["JsonlWriter", "TensorBoardWriter", "MultiWriter",
+           "JsonlSink", "TensorBoardSink", "MultiSink"]

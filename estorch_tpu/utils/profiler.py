@@ -1,58 +1,11 @@
-"""Profiling hooks (SURVEY.md §5 'Tracing / profiling').
-
-The reference has no profiling.  estorch_tpu provides:
-
-- ``trace(logdir)``: context manager around ``jax.profiler`` producing a
-  Perfetto/XPlane trace of the compiled generation programs;
-- ``timed_generations(es, n)``: per-generation wall/device split using
-  ``block_until_ready`` fences — the cheap always-available option;
-- annotations via ``jax.profiler.TraceAnnotation`` for host-side phases
-  (novelty k-NN, archive ops) so they show up inside device traces.
+"""Backward-compat shim: the profiling hooks moved to
+:mod:`estorch_tpu.obs.trace` (the observability subsystem,
+docs/observability.md).  Import from ``estorch_tpu.obs`` in new code;
+this module keeps the historical ``utils.profiler`` surface alive.
 """
 
 from __future__ import annotations
 
-import contextlib
-import time
+from ..obs.trace import annotate, timed_generations, trace  # noqa: F401
 
-
-@contextlib.contextmanager
-def trace(logdir: str):
-    """jax.profiler trace of everything inside the with-block."""
-    import jax
-
-    jax.profiler.start_trace(logdir)
-    try:
-        yield
-    finally:
-        jax.profiler.stop_trace()
-
-
-def annotate(name: str):
-    """Host-phase annotation visible in device traces (no-op off-trace)."""
-    import jax
-
-    return jax.profiler.TraceAnnotation(name)
-
-
-def timed_generations(es, n: int = 5, warmup: int = 1) -> dict:
-    """Run ``n`` timed generations; returns aggregate timing stats.
-
-    Forces AOT compile (via train's first call) and a ``warmup`` generation
-    first so results measure steady-state execution only.
-    """
-    es.train(warmup, verbose=False)
-    t0 = time.perf_counter()
-    es.train(n, verbose=False)
-    wall = time.perf_counter() - t0
-    recs = es.history[-n:]
-    steps = sum(r["env_steps"] for r in recs)
-    return {
-        "generations": n,
-        "wall_s": wall,
-        "gen_per_sec": n / wall,
-        "env_steps": steps,
-        "env_steps_per_sec": steps / wall,
-        "mean_gen_wall_s": wall / n,
-        "compile_time_s": es.compile_time_s,
-    }
+__all__ = ["trace", "annotate", "timed_generations"]

@@ -42,7 +42,15 @@ def main(argv=None):
 
     enable_compilation_cache()
     os.makedirs(args.workdir, exist_ok=True)
+    # heartbeat in the workdir (unless a supervisor already set a path):
+    # the watcher / doctor --run-dir read it when this run stops answering
+    os.environ.setdefault(
+        "ESTORCH_OBS_HEARTBEAT",
+        os.path.join(args.workdir, "heartbeat.json"))
     es = configs.CONFIGS[args.config]()
+    # run manifest beside the curve: which config/jax/devices/sha this was
+    es.write_manifest(os.path.join(args.workdir, "manifest.json"),
+                      extra={"example_config": args.config})
     ck = PeriodicCheckpointer(es, os.path.join(args.workdir, "ckpts"),
                               every=args.ckpt_every, max_to_keep=3)
     if args.resume and ck.latest():

@@ -33,7 +33,11 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
-import bench  # noqa: E402  (acquire_evidence_lock — one lock protocol)
+import bench  # noqa: E402  (acquire_evidence_lock — one lock protocol;
+# bench also loads the heartbeat helpers WITHOUT importing jax into this
+# process — the watcher must stay accelerator-free to survive wedges)
+HEARTBEAT_ENV = bench.HEARTBEAT_ENV
+describe_heartbeat = bench.describe_heartbeat
 
 PROBE = ("import jax; d = jax.devices(); "
          "print(d[0].platform, len(d), flush=True)")
@@ -65,8 +69,12 @@ def run_step(label, argv, log_path, timeout_s, stdout=None):
     burn the single host core unbounded and contaminate the next
     window's serialized measurements (the round-4 lesson)."""
     _log(log_path, f"{_now()} step={label} start")
-    # children must not re-take the evidence flock we already hold
-    env = {**os.environ, "EVIDENCE_LOCK_HELD": "1"}
+    # children must not re-take the evidence flock we already hold.
+    # Heartbeat: any ES the step constructs beats into this per-step file
+    # (bench stages override with their own per-stage path), so a timeout
+    # below reports the last-known phase/generation, not just "TIMEOUT"
+    hb_path = f"{log_path}.{label}.heartbeat.json"
+    env = {**os.environ, "EVIDENCE_LOCK_HELD": "1", HEARTBEAT_ENV: hb_path}
     proc = subprocess.Popen(argv, cwd=REPO, start_new_session=True,
                             stdout=stdout, stderr=None, env=env)
     try:
@@ -89,7 +97,7 @@ def run_step(label, argv, log_path, timeout_s, stdout=None):
             _log(log_path, f"{_now()} step={label} unreapable after "
                            "SIGKILL (uninterruptible child?) — abandoning")
         _log(log_path, f"{_now()} step={label} TIMEOUT after {timeout_s}s "
-                       f"(process group killed)")
+                       f"(process group killed; {describe_heartbeat(hb_path)})")
         return False
 
 

@@ -11,6 +11,12 @@ export JAX_PLATFORMS=cpu
 echo "== esguard =="
 python -m estorch_tpu.analysis estorch_tpu/
 
+echo "== obs selfcheck =="
+# record-schema validation of the golden generation record + summarize
+# pipeline (estorch_tpu/obs/summarize.py) — schema drift fails fast here,
+# before a JSONL consumer parses mismatched records
+python -m estorch_tpu.obs summarize --selfcheck
+
 echo "== compileall =="
 python -m compileall -q estorch_tpu/ tests/ examples/
 

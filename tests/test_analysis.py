@@ -512,6 +512,156 @@ class TestR06:
 
 
 # ---------------------------------------------------------------------
+# R07 unfenced-device-timing
+# ---------------------------------------------------------------------
+
+class TestR07:
+    def test_unfenced_jitted_call_flagged(self):
+        found = findings("""
+            import time
+            import jax
+
+            step = jax.jit(lambda x: x * 2)
+
+            def bench(x):
+                t0 = time.perf_counter()
+                y = step(x)
+                return time.perf_counter() - t0
+        """, "R07")
+        assert len(found) == 1
+        assert "dispatch" in found[0].message
+
+    def test_fenced_call_clean(self):
+        assert not findings("""
+            import time
+            import jax
+
+            step = jax.jit(lambda x: x * 2)
+
+            def bench(x):
+                t0 = time.perf_counter()
+                y = step(x)
+                jax.block_until_ready(y)
+                return time.perf_counter() - t0
+        """, "R07")
+
+    def test_method_fence_clean(self):
+        assert not findings("""
+            import time
+            import jax
+
+            step = jax.jit(lambda x: x * 2)
+
+            def bench(x):
+                t0 = time.perf_counter()
+                y = step(x)
+                y.block_until_ready()
+                return time.perf_counter() - t0
+        """, "R07")
+
+    def test_same_line_fence_wrap_clean(self):
+        """`jitted(...).block_until_ready()` — the fence wraps the
+        dispatch on one line and must count as fenced."""
+        assert not findings("""
+            import time
+            import jax
+
+            step = jax.jit(lambda x: x * 2)
+
+            def bench(x):
+                t0 = time.perf_counter()
+                step(x).block_until_ready()
+                return time.perf_counter() - t0
+        """, "R07")
+
+    def test_self_attr_dispatch_flagged(self):
+        """The engine idiom: self._step bound to jax.jit in __init__,
+        dispatched (and timed) in another method."""
+        found = findings("""
+            import time
+            import jax
+
+            class Engine:
+                def __init__(self, fn):
+                    self._step = jax.jit(fn)
+
+                def bench(self, x):
+                    t0 = time.perf_counter()
+                    y = self._step(x)
+                    dt = time.perf_counter() - t0
+                    return y, dt
+        """, "R07")
+        assert len(found) == 1
+        assert found[0].symbol == "Engine.bench"
+
+    def test_lower_compile_is_not_dispatch(self):
+        """AOT .lower().compile() on a jitted object is synchronous —
+        timing it is exactly how compile time SHOULD be measured."""
+        assert not findings("""
+            import time
+            import jax
+
+            class Engine:
+                def __init__(self, fn):
+                    self._step = jax.jit(fn)
+
+                def compile(self, x):
+                    t0 = time.perf_counter()
+                    self._step.lower(x).compile()
+                    return time.perf_counter() - t0
+        """, "R07")
+
+    def test_materialization_fence_clean(self):
+        """np.asarray of the outputs forces completion — honest timing."""
+        assert not findings("""
+            import time
+            import jax
+            import numpy as np
+
+            step = jax.jit(lambda x: x * 2)
+
+            def bench(x):
+                t0 = time.perf_counter()
+                y = step(x)
+                out = np.asarray(y)
+                return out, time.perf_counter() - t0
+        """, "R07")
+
+    def test_plain_host_call_clean(self):
+        """Timing a non-jitted call is ordinary profiling, not a hazard."""
+        assert not findings("""
+            import time
+
+            def work(x):
+                return x * 2
+
+            def bench(x):
+                t0 = time.perf_counter()
+                y = work(x)
+                return time.perf_counter() - t0
+        """, "R07")
+
+    def test_jit_wrapped_shard_map_attr_flagged(self):
+        """jax.jit(shard_map(...)) nesting still marks the bound attr."""
+        found = findings("""
+            import time
+            import jax
+            from jax.experimental.shard_map import shard_map
+
+            class Engine:
+                def __init__(self, body, mesh):
+                    self._gen = jax.jit(shard_map(body, mesh=mesh))
+
+                def bench(self, state):
+                    t0 = time.perf_counter()
+                    out = self._gen(state)
+                    dt = time.perf_counter() - t0
+                    return out, dt
+        """, "R07")
+        assert len(found) == 1
+
+
+# ---------------------------------------------------------------------
 # engine / CLI / config / baseline mechanics
 # ---------------------------------------------------------------------
 
@@ -535,7 +685,7 @@ def launch(cmd):
 class TestEngine:
     def test_every_rule_registered(self):
         ids = [r.id for r in all_rules()]
-        assert ids == ["R01", "R02", "R03", "R04", "R05", "R06"]
+        assert ids == ["R01", "R02", "R03", "R04", "R05", "R06", "R07"]
 
     def test_syntax_error_becomes_finding(self):
         found = analyze_source("bad.py", "def broken(:\n")
@@ -668,7 +818,7 @@ class TestConfig:
         cfg = load_config(os.path.join(root, "pyproject.toml"))
         assert cfg.baseline == "esguard_baseline.json"
         assert cfg.rule_ids([r.id for r in all_rules()]) == [
-            "R01", "R02", "R03", "R04", "R05", "R06"]
+            "R01", "R02", "R03", "R04", "R05", "R06", "R07"]
 
 
 class TestCLI:

@@ -69,6 +69,48 @@ class TestOptionalDeps:
         assert out["gymnasium"]["available"] is True
 
 
+class TestObsCheck:
+    def test_trace_dir_and_tensorboard_reported(self, tmp_path,
+                                                monkeypatch):
+        monkeypatch.setenv("ESTORCH_OBS_DIR", str(tmp_path))
+        out = doctor.check_obs()
+        assert out["trace_dir"]["path"] == str(tmp_path)
+        assert out["trace_dir"]["writable"] is True
+        assert isinstance(out["tensorboard"]["available"], bool)
+        assert "heartbeat" not in out  # no run dir given
+
+    def test_unwritable_trace_dir_never_crashes(self, tmp_path,
+                                                monkeypatch):
+        monkeypatch.setenv("ESTORCH_OBS_DIR",
+                           str(tmp_path / "does" / "not" / "exist"))
+        out = doctor.check_obs()
+        assert out["trace_dir"]["writable"] is False
+        assert "error" in out["trace_dir"]
+
+    def test_heartbeat_fresh_vs_stale_vs_missing(self, tmp_path):
+        import time
+
+        from estorch_tpu.obs import Heartbeat
+        from estorch_tpu.obs.recorder import STALE_AFTER_S
+
+        out = doctor.check_obs(str(tmp_path))
+        assert out["heartbeat"]["found"] is False
+        assert "hint" in out["heartbeat"]
+
+        Heartbeat(str(tmp_path / "heartbeat.json")).beat("eval", 5)
+        out = doctor.check_obs(str(tmp_path))
+        hb = out["heartbeat"]
+        assert hb["found"] is True and hb["stale"] is False
+        assert hb["phase"] == "eval" and hb["generation"] == 5
+
+        with open(tmp_path / "heartbeat.json", "w") as f:
+            json.dump({"ts": time.time() - 10 * STALE_AFTER_S,
+                       "pid": 1, "phase": "device", "generation": 2}, f)
+        out = doctor.check_obs(str(tmp_path))
+        assert out["heartbeat"]["stale"] is True
+        assert out["heartbeat"]["age_s"] > STALE_AFTER_S
+
+
 class TestReport:
     def test_report_shape_and_hints(self, monkeypatch):
         monkeypatch.setattr(doctor, "probe_device",
@@ -79,6 +121,19 @@ class TestReport:
         assert "cpu" in rep["hint"]
         assert isinstance(rep["native"]["cpp_pool"], bool)
         assert rep["optional"]["gymnasium"]["available"] is True
+        assert rep["obs"]["trace_dir"]["writable"] in (True, False)
+
+    def test_report_run_dir_flows_to_obs_check(self, tmp_path,
+                                               monkeypatch):
+        from estorch_tpu.obs import Heartbeat
+
+        monkeypatch.setattr(doctor, "probe_device",
+                            lambda timeout_s: {"status": "healthy",
+                                               "platform": "cpu",
+                                               "n_devices": 8})
+        Heartbeat(str(tmp_path / "heartbeat.json")).beat("update", 11)
+        rep = doctor.report(run_dir=str(tmp_path))
+        assert rep["obs"]["heartbeat"]["generation"] == 11
 
     def test_cli_json_and_exit_code(self, monkeypatch, capsys):
         monkeypatch.setattr(doctor, "probe_device",

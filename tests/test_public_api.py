@@ -42,6 +42,16 @@ def test_extended_surface_imports():
         restore_checkpoint,
         save_checkpoint,
     )
+    from estorch_tpu.obs import (  # noqa: F401
+        FlightRecorder,
+        Heartbeat,
+        JsonlSink,
+        MultiSink,
+        Telemetry,
+        read_heartbeat,
+        summarize,
+        write_manifest,
+    )
 
 
 def test_es_constructor_signature_matches_reference():
