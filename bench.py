@@ -40,6 +40,16 @@ measurement cannot take down the bench — round-1 lesson):
     bench.py --obs-ab                   telemetry-overhead A/B: spans on vs
                                         off on the headline config (the <2%
                                         observability acceptance gate)
+    bench.py --chaos [--selfcheck]      recovery-overhead A/B: a host
+                                        process-worker run with a 1-worker-
+                                        kill-per-20-generations chaos plan
+                                        vs the same run clean — measures
+                                        what respawn+retry cost, and proves
+                                        participation stays full under
+                                        faults.  --selfcheck shrinks it to
+                                        the run_lint.sh gate: nonzero exit
+                                        when recovery did not actually
+                                        recover
     bench.py                            headline + extras, the driver entry
 
 Every stage child writes a heartbeat file (ESTORCH_OBS_HEARTBEAT →
@@ -482,6 +492,137 @@ def stage_obs_ab(force_cpu=False, gens=3, repeats=3):
         }), flush=True)
 
 
+def measure_chaos_one(cfg):
+    """Child body for --stage-chaos-one: a tiny host-backend ES with fork
+    workers, optionally under a kill-one-worker-every-K-generations chaos
+    plan, measured in generations/sec.  Host path only: construction
+    imports jax but never touches the device runtime, so this stays safe
+    on a wedged-tunnel machine (run_lint exports JAX_PLATFORMS=cpu on
+    top)."""
+    import torch
+
+    from estorch_tpu import ES
+    from estorch_tpu.resilience.chaos import CHAOS_ENV, ChaosPlan
+
+    class TinyPolicy(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.net = torch.nn.Sequential(
+                torch.nn.Linear(4, 8), torch.nn.Tanh(), torch.nn.Linear(8, 2)
+            )
+
+        def forward(self, x):
+            return self.net(x)
+
+    class QuadAgent:
+        def rollout(self, policy):
+            with torch.no_grad():
+                v = torch.nn.utils.parameters_to_vector(policy.parameters())
+                r = -float((v**2).sum())
+            self.last_episode_steps = 1
+            return r
+
+    gens = int(cfg.get("gens", 60))
+    n_proc = int(cfg.get("n_proc", 2))
+    if cfg.get("chaos"):
+        plan = ChaosPlan.generate(
+            seed=0, n_generations=gens, kill_every=int(cfg["kill_every"]),
+            n_workers=n_proc,
+        )
+        os.environ[CHAOS_ENV] = plan.to_json()
+    es = ES(TinyPolicy, QuadAgent, torch.optim.Adam,
+            population_size=int(cfg.get("population", 16)), sigma=0.05,
+            seed=0, optimizer_kwargs={"lr": 0.01}, table_size=1 << 12,
+            worker_mode="process")
+    es.train(1, n_proc=n_proc, verbose=False)  # warm-up: fork the pool
+    t0 = time.perf_counter()
+    es.train(gens, n_proc=n_proc, verbose=False)
+    dt = time.perf_counter() - t0
+    counters = es.obs.counters.snapshot()
+    out = {
+        "gps": round(gens / dt, 2),
+        "generations": len(es.history),
+        "n_failed_total": int(sum(r["n_failed"] for r in es.history)),
+        "workers_respawned": int(counters.get("workers_respawned", 0)),
+        "members_retried": int(counters.get("members_retried", 0)),
+        "chaos_worker_kills": int(counters.get("chaos_worker_kills", 0)),
+        "generations_rejected": int(counters.get("generations_rejected", 0)),
+        "cfg": cfg,
+    }
+    es.engine.close()
+    return out
+
+
+def stage_chaos(selfcheck=False):
+    """Recovery-overhead A/B (chaos vs clean) via the stage protocol; the
+    selfcheck form is the run_lint.sh gate.  Returns the process exit
+    code: 0 when recovery actually recovered (full participation under
+    worker kills), 1 otherwise."""
+    gens = 24 if selfcheck else 60
+    kill_every = 8 if selfcheck else 20
+    base = {"gens": gens, "kill_every": kill_every, "population": 16,
+            "n_proc": 2}
+    rows = {}
+    for label, chaos in (("clean", False), ("chaos", True)):
+        cfg = {**base, "chaos": chaos}
+        argv = [sys.executable, __file__, "--stage-chaos-one",
+                json.dumps(cfg)]
+        # a pre-existing ESTORCH_CHAOS in the caller's environment
+        # (resilience.chaos.CHAOS_ENV; literal here — the bench driver
+        # stays import-free) would contaminate the CLEAN leg and turn the
+        # A/B into chaos-vs-chaos; the chaos leg sets its own plan
+        child_env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        child_env.pop("ESTORCH_CHAOS", None)
+        try:
+            r = subprocess.run(argv, timeout=600, capture_output=True,
+                               text=True, env=child_env)
+        except subprocess.TimeoutExpired:
+            print(json.dumps({"label": f"chaos/{label}", "gps": None,
+                              "error": "timeout after 600s"}), flush=True)
+            continue
+        try:
+            last = [ln for ln in r.stdout.strip().splitlines()
+                    if ln.startswith("{")][-1]
+            rows[label] = json.loads(last)
+        except (IndexError, ValueError):
+            print(json.dumps({"label": f"chaos/{label}", "gps": None,
+                              "error": f"stage exited {r.returncode}",
+                              "stderr_tail": r.stderr[-800:]}), flush=True)
+            continue
+        print(json.dumps({"label": f"chaos/{label}", **rows[label]}),
+              flush=True)
+    clean, chaos = rows.get("clean"), rows.get("chaos")
+    if not clean or not chaos:
+        print(json.dumps({"label": "chaos/recovery", "error":
+                          "one or both stages failed"}), flush=True)
+        return 1
+    overhead = (clean["gps"] - chaos["gps"]) / clean["gps"] * 100.0
+    expected_kills = gens // kill_every
+    # full recovery means: every generation trained, every kill respawned
+    # (a kill at the FINAL generation has no next boundary to respawn at —
+    # hence the -1), and NO member lost — the same-generation retry path
+    # covered every killed worker's slice
+    recovered = (
+        chaos["generations"] == gens + 1  # incl. warm-up generation
+        and chaos["chaos_worker_kills"] >= expected_kills
+        and chaos["workers_respawned"] >= expected_kills - 1
+        and chaos["n_failed_total"] == 0
+    )
+    print(json.dumps({
+        "label": "chaos/recovery",
+        "clean_gps": clean["gps"],
+        "chaos_gps": chaos["gps"],
+        "overhead_pct": round(overhead, 1),
+        "worker_kills": chaos["chaos_worker_kills"],
+        "workers_respawned": chaos["workers_respawned"],
+        "members_retried": chaos["members_retried"],
+        "n_failed_total": chaos["n_failed_total"],
+        "full_participation": chaos["n_failed_total"] == 0,
+        "pass": recovered,
+    }), flush=True)
+    return 0 if recovered else 1
+
+
 class EvidenceLockBusy(Exception):
     """The evidence flock is held by another measurement/study process."""
 
@@ -614,5 +755,15 @@ if __name__ == "__main__":
     elif "--obs-ab" in sys.argv:
         _lock_or_warn()
         stage_obs_ab(force_cpu="--cpu" in sys.argv)
+    elif "--stage-chaos-one" in sys.argv:
+        cfg = json.loads(sys.argv[sys.argv.index("--stage-chaos-one") + 1])
+        print(json.dumps(measure_chaos_one(cfg)))
+    elif "--chaos" in sys.argv:
+        # the selfcheck form runs inside run_lint.sh (single tiny host
+        # config, no device): skip the evidence lock a full measurement
+        # would take
+        if "--selfcheck" not in sys.argv:
+            _lock_or_warn()
+        sys.exit(stage_chaos(selfcheck="--selfcheck" in sys.argv))
     else:
         main()

@@ -14,7 +14,7 @@ classes are re-exported here as they land:
 
 __version__ = "0.3.0"
 
-from . import envs, models, obs, ops, parallel, utils  # noqa: F401
+from . import envs, models, obs, ops, parallel, resilience, utils  # noqa: F401
 from .algo import ES, IW_ES, NS_ES, NSR_ES, NSRA_ES, NoveltyArchive
 from .envs.agent import JaxAgent, PooledAgent
 from .models import (MLPPolicy, NatureCNN, RecurrentNatureCNN,
@@ -39,6 +39,7 @@ __all__ = [
     "obs",
     "ops",
     "parallel",
+    "resilience",
     "utils",
     "__version__",
 ]

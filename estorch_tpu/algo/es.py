@@ -568,6 +568,7 @@ class ES:
         n_proc: int = 1,
         log_fn: Callable[[dict], None] | None = None,
         verbose: bool = True,
+        max_consecutive_rejections: int = 3,
     ):
         """Run ``n_steps`` generations (reference: ``es.train(n_steps, n_proc)``).
 
@@ -575,6 +576,17 @@ class ES:
         mesh already parallelizes — SURVEY.md §2 'Parallelism strategies');
         on the host path it sizes the worker pool, exactly like the
         reference's ``train(n_steps, n_proc)``.
+
+        Rejection policy (docs/resilience.md): a generation whose
+        population collapsed (<2 valid members) or whose post-update
+        parameters/norm came out non-finite is REJECTED — the state is
+        restored to the pre-generation snapshot, ``generations_rejected``
+        is counted, and the same generation re-runs (the noise stream is
+        keyed on ``(key, generation)``, so a transient fault's re-run is
+        bit-identical to a run that never faulted).  Up to
+        ``max_consecutive_rejections`` consecutive rejections are
+        retried; one more marks the fault persistent, not transient, and
+        raises — with the pre-fault state intact.
         """
         self._setup_n_proc(n_proc)
         obs = self.obs
@@ -587,7 +599,9 @@ class ES:
             # primary metric) never includes XLA trace+compile time
             obs.note("compile")
             self.compile_time_s = self.engine.compile(self.state)
-        for _ in range(n_steps):
+        done = 0
+        rejected_streak = 0
+        while done < n_steps:
             t0 = time.perf_counter()
             prev_state = self.state
             if self.backend == "device":
@@ -614,27 +628,42 @@ class ES:
                     jax.block_until_ready(self.state.params_flat)
             dt = time.perf_counter() - t0
 
-            # backend parity: host/pooled raise inside their weighting when
-            # fewer than 2 members survive (utils/fault.py); the fused device
-            # program cannot raise, so it reports n_valid and we fail HERE
-            # rather than let a dead env train on zero-weight updates
+            # ---- anomaly guards: reject instead of training on poison ----
+            # population collapse (every backend reports n_valid) and the
+            # post-update non-finite check (metrics["update_finite"]) both
+            # restore the pre-generation state; silently keeping a NaN
+            # update would poison every subsequent generation
             n_valid = metrics.get("n_valid")
+            reason = None
             if n_valid is not None and int(n_valid) < 2:
-                # roll back: host/pooled raise BEFORE mutating state, so a
-                # caller that catches + checkpoints must not see the
-                # dead-generation state here either
-                self.state = prev_state
-                raise RuntimeError(
+                reason = (
                     f"only {int(n_valid)}/{self.population_size} population "
-                    "members produced valid fitness — cannot form an update; "
-                    "check env/rollout health"
+                    "members produced valid fitness — cannot form an update"
                 )
+            elif not bool(np.asarray(metrics.get("update_finite", True))):
+                reason = ("non-finite parameters/update norm after the "
+                          "optimizer step")
+            if reason is not None:
+                self.state = prev_state
+                rejected_streak += 1
+                obs.counters.inc("generations_rejected")
+                obs.event("generation_rejected", reason=reason,
+                          n_valid=int(n_valid) if n_valid is not None else -1)
+                obs.discard_phases()  # the rejected generation's spans
+                if rejected_streak > max_consecutive_rejections:
+                    raise RuntimeError(
+                        f"{reason}; {rejected_streak} consecutive "
+                        "generations rejected — check env/rollout health"
+                    )
+                continue  # re-run the SAME generation (deterministic noise)
+            rejected_streak = 0
 
             record = self._base_record(
                 prev_state, fitness, int(metrics["steps"]),
                 float(np.asarray(metrics["grad_norm"])), dt,
             )
             self._emit_record(record, log_fn, verbose)
+            done += 1
         return self
 
     def _setup_n_proc(self, n_proc: int) -> None:

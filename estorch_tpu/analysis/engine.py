@@ -59,7 +59,8 @@ def get_rule(rule_id: str) -> Rule:
 
 def _load_builtin_rules() -> None:
     # import for side effect: each module registers its rules on import
-    from . import rules_host, rules_perf, rules_prng, rules_trace  # noqa: F401
+    from . import (rules_host, rules_perf, rules_prng,  # noqa: F401
+                   rules_resilience, rules_trace)
 
 
 def _rebase(path: str) -> str:

@@ -16,6 +16,7 @@ aux-subsystem "failure detection" obligation (SURVEY.md §5) applied to the
 accelerator itself.
 
 Use:  python -m estorch_tpu.doctor [--timeout S] [--run-dir DIR]
+      [--resilience-probe]
 """
 
 from __future__ import annotations
@@ -53,16 +54,21 @@ def probe_device(timeout_s: float = 45.0) -> dict:
             proc.wait(timeout=timeout_s)
         except subprocess.TimeoutExpired:
             proc.kill()
+            unreapable = False
             try:
                 proc.wait(timeout=5)
             except subprocess.TimeoutExpired:
                 # child stuck in uninterruptible sleep (D state — a wedged
                 # device driver can do this): SIGKILL cannot reap it, and
-                # the doctor must not hang on the very wedge it detects
-                pass
+                # the doctor must not hang on the very wedge it detects —
+                # report the un-reapable child, it is itself a finding
+                unreapable = True
             fe.seek(0)
-            return {"status": "wedged", "timeout_s": timeout_s,
-                    "stderr_tail": fe.read()[-500:]}
+            out = {"status": "wedged", "timeout_s": timeout_s,
+                   "stderr_tail": fe.read()[-500:]}
+            if unreapable:
+                out["unreapable_child"] = True
+            return out
         fo.seek(0), fe.seek(0)
         out, err = fo.read(), fe.read()
     for line in out.splitlines():
@@ -201,7 +207,151 @@ def check_obs(run_dir: str | None = None) -> dict:
     return out
 
 
-def report(timeout_s: float = 45.0, run_dir: str | None = None) -> dict:
+# tiny host-backend ES save/restore round trip, run in a SUBPROCESS with a
+# hard timeout (the orbax/jax import chain inits a backend — on a wedged
+# machine that hang must not take the doctor down with it).  __ROOT__ is
+# substituted (plain replace — str.format would trip on the dict braces)
+# with the repr of the checkpoint root under test.
+_RESILIENCE_PROBE = """
+import os, shutil
+import numpy as np
+import torch
+from estorch_tpu.utils import force_cpu_backend
+force_cpu_backend(1)
+from estorch_tpu import ES
+from estorch_tpu.utils.checkpoint import restore_checkpoint, save_checkpoint
+
+class P(torch.nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.l = torch.nn.Linear(2, 1)
+    def forward(self, x):
+        return self.l(x)
+
+class A:
+    def rollout(self, policy):
+        with torch.no_grad():
+            v = torch.nn.utils.parameters_to_vector(policy.parameters())
+        return -float((v ** 2).sum())
+
+def make():
+    return ES(P, A, torch.optim.Adam, population_size=4, sigma=0.1, seed=0,
+              optimizer_kwargs={"lr": 1e-2}, table_size=1 << 10,
+              telemetry=False)
+
+root = os.path.join(__ROOT__, "doctor_resilience_probe_%d" % os.getpid())
+try:
+    es = make()
+    es.train(1, verbose=False)
+    save_checkpoint(es, root)
+    es2 = make()
+    restore_checkpoint(es2, root)
+    assert es2.generation == 1, es2.generation
+    np.testing.assert_array_equal(np.asarray(es.state.params_flat),
+                                  np.asarray(es2.state.params_flat))
+finally:
+    shutil.rmtree(root, ignore_errors=True)
+print("RESILIENCE_PROBE_OK")
+"""
+
+
+def _roundtrip_probe(root: str, timeout_s: float = 180.0) -> dict:
+    """Save/restore a tiny ES under ``root`` in a timed-out subprocess."""
+    import tempfile
+
+    with tempfile.TemporaryFile("w+") as fo, \
+            tempfile.TemporaryFile("w+") as fe:
+        proc = subprocess.Popen(
+            [sys.executable, "-c",
+             _RESILIENCE_PROBE.replace("__ROOT__", repr(root))],
+            stdout=fo, stderr=fe, text=True)
+        try:
+            proc.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            try:
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                fe.seek(0)
+                return {"status": "wedged", "timeout_s": timeout_s,
+                        "unreapable_child": True,
+                        "stderr_tail": fe.read()[-500:]}
+            fe.seek(0)
+            return {"status": "wedged", "timeout_s": timeout_s,
+                    "stderr_tail": fe.read()[-500:]}
+        fo.seek(0), fe.seek(0)
+        out, err = fo.read(), fe.read()
+    if "RESILIENCE_PROBE_OK" in out:
+        return {"status": "ok"}
+    return {"status": "error", "returncode": proc.returncode,
+            "stderr_tail": err[-500:]}
+
+
+def check_resilience(ckpt_root: str | None = None,
+                     probe: bool = False,
+                     probe_timeout_s: float = 180.0) -> dict:
+    """Can a run here actually survive faults?  (docs/resilience.md)
+
+    - is the checkpoint root (``ESTORCH_CKPT_ROOT`` or tempdir) writable
+      — without it the Supervisor has nothing to resume from;
+    - ``probe=True``: a full save/restore round trip on a tiny host ES
+      in a timed-out subprocess — the end-to-end proof that resume works
+      on THIS machine's orbax/torch/jax install;
+    - is fork available — worker respawn (host/procpool.py) needs it;
+    - heartbeat-watchdog config sanity: a heartbeat path with telemetry
+      disabled means a supervisor would see no beats and kill healthy
+      runs.
+    """
+    import os
+    import tempfile
+
+    from .obs.recorder import HEARTBEAT_ENV, STALE_AFTER_S
+    from .obs.spans import OBS_DISABLE_ENV
+
+    root = (ckpt_root or os.environ.get("ESTORCH_CKPT_ROOT")
+            or tempfile.gettempdir())
+    try:
+        probe_file = os.path.join(root, f".ckpt_write_probe_{os.getpid()}")
+        with open(probe_file, "w") as f:
+            f.write("ok")
+        os.remove(probe_file)
+        writable, err = True, None
+    except OSError as e:  # diagnostic tool: never crash the report
+        writable, err = False, repr(e)
+    out: dict = {
+        "ckpt_root": {"path": root, "writable": writable,
+                      **({"error": err} if err else {})},
+    }
+    if probe and writable:
+        out["roundtrip"] = _roundtrip_probe(root, probe_timeout_s)
+    import multiprocessing as mp
+
+    out["fork"] = {
+        "available": os.name == "posix" and "fork" in mp.get_all_start_methods(),
+        "needed_for": "host process workers + respawn (host/procpool.py)",
+    }
+    hb_path = os.environ.get(HEARTBEAT_ENV)
+    obs_enabled = os.environ.get(OBS_DISABLE_ENV, "1") != "0"
+    watchdog: dict = {
+        "heartbeat_env_set": bool(hb_path),
+        "telemetry_enabled": obs_enabled,
+        "stale_after_s": STALE_AFTER_S,
+    }
+    if hb_path and not obs_enabled:
+        watchdog["warning"] = (
+            f"{HEARTBEAT_ENV} is set but {OBS_DISABLE_ENV}=0 disables "
+            "telemetry — a staleness watchdog would see no beats and kill "
+            "healthy runs"
+        )
+    if hb_path:
+        hb_dir = os.path.dirname(os.path.abspath(hb_path)) or "."
+        watchdog["heartbeat_dir_writable"] = os.access(hb_dir, os.W_OK)
+    out["heartbeat_watchdog"] = watchdog
+    return out
+
+
+def report(timeout_s: float = 45.0, run_dir: str | None = None,
+           resilience_probe: bool = False) -> dict:
     dev = probe_device(timeout_s)
     rep = {
         "device": dev,
@@ -209,6 +359,7 @@ def report(timeout_s: float = 45.0, run_dir: str | None = None) -> dict:
         "optional": check_optional_deps(),
         "host": check_host(),
         "obs": check_obs(run_dir),
+        "resilience": check_resilience(probe=resilience_probe),
     }
     cpu_recipe = (
         "run on the virtual CPU mesh instead — jax.config.update("
@@ -239,8 +390,12 @@ def main(argv=None):
     p.add_argument("--run-dir", default=None, metavar="DIR",
                    help="training run directory: report heartbeat "
                         "freshness for a run that stopped answering")
+    p.add_argument("--resilience-probe", action="store_true",
+                   help="also run the checkpoint save/restore round-trip "
+                        "probe (a tiny ES in a timed-out subprocess)")
     args = p.parse_args(argv)
-    rep = report(args.timeout, run_dir=args.run_dir)
+    rep = report(args.timeout, run_dir=args.run_dir,
+                 resilience_probe=args.resilience_probe)
     print(json.dumps(rep, indent=2))
     return 0 if rep["device"]["status"] == "healthy" else 1
 

@@ -305,6 +305,7 @@ class HostEngine:
         return HostRolloutResult(float(reward), bc, steps)
 
     def _proc_evaluate(self, state: HostState, offs=None) -> HostEvalResult:
+        from ..resilience.chaos import kill_workers
         from .procpool import ProcessPool
 
         if self._proc_pool is None or self._proc_pool.n_proc != self.n_proc:
@@ -316,11 +317,21 @@ class HostEngine:
                 master_state=self.master.state_dict(),
                 mirrored=self.mirrored,
             )
+        self._proc_pool.telemetry = self.telemetry
+        # generation boundary: workers lost last generation come back now,
+        # restoring full population participation (docs/resilience.md)
+        self._proc_pool.respawn_dead()
+        killed = kill_workers(state.generation, self._proc_pool.worker_pids)
+        if killed:
+            self.telemetry.counters.inc("chaos_worker_kills", len(killed))
+            self.telemetry.event("chaos_worker_kill", pids=killed,
+                                 gen=int(state.generation))
         if offs is None:
             offs = self._pair_offsets(state)
         fitness, bc, steps = self._proc_pool.evaluate(
             state.params_flat, self._state_sigma(state), offs,
             timeout_s=self.proc_timeout_s,
+            generation=int(state.generation),
         )
         return HostEvalResult(fitness=fitness, bc=bc, steps=int(steps))
 
@@ -335,6 +346,8 @@ class HostEngine:
         sigma = self._state_sigma(state)
         results: list[HostRolloutResult | None] = [None] * self.population_size
 
+        from ..resilience.chaos import member_fault
+
         def run_slice(w: int):
             policy, agent = self._workers[w]
             for i in range(w, self.population_size, self.n_proc):
@@ -342,6 +355,7 @@ class HostEngine:
                 theta = state.params_flat + sigma * sign * self._eps(off)
                 self._load(policy, theta)
                 try:
+                    member_fault(state.generation, i)  # chaos injection
                     results[i] = self._call_rollout(agent, policy)
                 except Exception:  # noqa: BLE001 — a dead member must not
                     # kill the generation (reference behavior: one worker
@@ -403,10 +417,22 @@ class HostEngine:
         if self.weight_decay > 0.0:
             # same L2 pull as the device engine's _update_from_weights
             grad_ascent = grad_ascent - self.weight_decay * state.params_flat
+        from ..resilience.chaos import poison_update
+
+        if poison_update(state.generation):
+            # chaos: a poisoned update direction — the post-update anomaly
+            # guard (ES.train on metrics["update_finite"]) must catch this
+            grad_ascent = np.full_like(grad_ascent, np.nan)
 
         self._load(self.master, state.params_flat)
         if state.opt_state is not None:
-            self.optimizer.load_state_dict(state.opt_state)
+            # deepcopy is load-bearing: load_state_dict keeps the INPUT
+            # tensors when dtype/device already match, so the live
+            # optimizer would alias state.opt_state and step() would
+            # mutate the caller's (immutable-by-contract) state in place —
+            # corrupting any rollback/rejection path that re-applies from
+            # the same state (docs/resilience.md)
+            self.optimizer.load_state_dict(copy.deepcopy(state.opt_state))
         else:
             # fresh center: reset any moments left by another state
             self.optimizer = self._optimizer_ctor(
@@ -436,6 +462,7 @@ class HostEngine:
         return new_state, float(np.linalg.norm(grad_ascent))
 
     def generation_step(self, state: HostState):
+        from ..resilience.chaos import mutate_fitness
         from ..utils.fault import rank_weights_with_failures
 
         obs = self.telemetry
@@ -448,14 +475,28 @@ class HostEngine:
             offs = self._pair_offsets(state)
         with obs.phase("eval"):
             ev = self.evaluate(state, offs=offs)
+        fitness = mutate_fitness(state.generation, ev.fitness)
+        n_valid = int(np.isfinite(np.asarray(fitness)).sum())
+        base = {"fitness": fitness, "bc": ev.bc, "steps": ev.steps,
+                "n_valid": n_valid}
+        if n_valid < 2:
+            # population collapse: not this layer's call to crash or retry —
+            # state is untouched, n_valid reports it, and ES.train owns the
+            # reject/re-run policy (docs/resilience.md failure model)
+            return state, {**base, "grad_norm": float("nan"),
+                           "update_finite": True}
         with obs.phase("update"):
-            weights = rank_weights_with_failures(ev.fitness)
+            weights = rank_weights_with_failures(fitness)
             new_state, gnorm = self.apply_weights(state, weights, offs=offs)
         metrics = {
-            "fitness": ev.fitness,
-            "bc": ev.bc,
-            "steps": ev.steps,
+            **base,
             "grad_norm": gnorm,
-            "n_valid": int(np.isfinite(np.asarray(ev.fitness)).sum()),
+            # post-update anomaly guard input: a non-finite parameter or
+            # update norm means this generation must be rejected upstream,
+            # not trained on
+            "update_finite": bool(
+                np.isfinite(gnorm)
+                and np.isfinite(new_state.params_flat).all()
+            ),
         }
         return new_state, metrics

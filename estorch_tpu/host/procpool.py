@@ -11,19 +11,44 @@ pool forks real processes instead:
   (no pickling of user objects, no shared stateful envs);
 - per generation each worker receives only (params_flat, sigma, offsets)
   once and evaluates its member slice; results return as
-  (indices, fitness, bc, steps) arrays;
-- a worker that dies mid-generation marks its whole slice NaN — the
-  straggler-drop path (utils/fault.py) renormalizes the update, exactly the
-  recovery SURVEY.md §5 prescribes (the reference hangs forever here).
+  (indices, fitness, bc, steps) arrays.
+
+Failure model (docs/resilience.md) — worker death is expected, not fatal:
+
+- detection: results are collected in SHORT poll slices against one
+  generation-level deadline (``timeout_s``), and a worker that is gone
+  with nothing buffered is dropped immediately — a corpse never makes the
+  pool sit out the full timeout on a silent pipe;
+- same-generation retry: a dead worker's un-evaluated slice is
+  redistributed over the surviving workers before the generation
+  returns, so a single worker death costs latency, not population
+  participation (the noise indexing is member-keyed, so any worker can
+  evaluate any member);
+- respawn: dead workers are replaced at the next generation boundary
+  (:meth:`ProcessPool.respawn_dead`) with fresh forks carrying the same
+  factories/master buffers;
+- last resort: slices that still have no result by the deadline (alive
+  stragglers, retry failures) stay NaN — the straggler-drop path
+  (utils/fault.py) renormalizes the update, exactly the recovery
+  SURVEY.md §5 prescribes (the reference hangs forever here).
 """
 
 from __future__ import annotations
 
 import multiprocessing as mp
+import multiprocessing.connection as mpc
 import os
+import time
 from typing import Any, Callable
 
 import numpy as np
+
+from ..obs.spans import NULL_TELEMETRY
+
+# poll slice for result collection: long enough to stay off the CPU,
+# short enough that a worker dying mid-generation is noticed in ~this
+# time rather than after the full generation deadline
+POLL_SLICE_S = 0.1
 
 
 def _worker_main(
@@ -38,7 +63,12 @@ def _worker_main(
     master_state,  # master policy state_dict (fork-inherited) — syncs BUFFERS
     mirrored: bool = True,
 ):
-    """Worker loop: build policy/agent once, evaluate member slices forever."""
+    """Worker loop: build policy/agent once, evaluate member slices forever.
+
+    Messages are ``(seq, generation, params_flat, sigma, offsets, indices)``;
+    ``indices=None`` means the worker's own round-robin slice, an explicit
+    array is a retry assignment for another (dead) worker's members.
+    """
     import torch
 
     torch.set_num_threads(1)  # workers parallelize across processes, not BLAS
@@ -56,14 +86,18 @@ def _worker_main(
             )
 
     # reuse the duck-typed rollout parsing + the single noise-indexing rule
+    from ..resilience.chaos import member_fault
     from .engine import HostEngine, member_sign_offset
 
     while True:
         msg = conn.recv()
         if msg is None:
             return
-        seq, params_flat, sigma, offsets = msg
-        indices = list(range(worker_id, population_size, n_proc))
+        seq, generation, params_flat, sigma, offsets, indices = msg
+        if indices is None:
+            indices = list(range(worker_id, population_size, n_proc))
+        else:
+            indices = [int(i) for i in indices]
         fitness = np.full(len(indices), np.nan, np.float32)
         bcs: list[np.ndarray] = []
         steps = 0
@@ -72,6 +106,7 @@ def _worker_main(
             theta = params_flat + sigma * sign * table[off : off + dim]
             load(theta)
             try:
+                member_fault(generation, i)  # deterministic chaos injection
                 res = HostEngine._call_rollout(agent, policy)
             except Exception:  # noqa: BLE001 — NaN marks the member failed
                 bcs.append(np.zeros(0, np.float32))
@@ -90,6 +125,10 @@ def _worker_main(
 class ProcessPool:
     """Persistent fork-based worker team for HostEngine."""
 
+    # span/counter hub; HostEngine points this at its own telemetry so
+    # respawn/retry counters land in the run's registry
+    telemetry = NULL_TELEMETRY
+
     def __init__(
         self,
         policy_factory,
@@ -103,57 +142,157 @@ class ProcessPool:
     ):
         if os.name != "posix":
             raise RuntimeError("process workers need fork (posix)")
-        ctx = mp.get_context("fork")
+        self._ctx = mp.get_context("fork")
         self.n_proc = int(n_proc)
         self.population_size = population_size
+        self.dim = dim
         self._seq = 0
         if master_state is None:
             master_state = policy_factory().state_dict()
-        self._procs = []
-        self._conns = []
+        self._spawn_args = (policy_factory, agent_factory, self.n_proc,
+                           population_size, dim, table, master_state,
+                           mirrored)
+        self._procs: list[Any] = []
+        self._conns: list[Any] = []
+        self._retired: list[Any] = []  # replaced dead workers, joined at close
         for w in range(self.n_proc):
-            parent, child = ctx.Pipe()
-            p = ctx.Process(
-                target=_worker_main,
-                args=(child, policy_factory, agent_factory, w, self.n_proc,
-                      population_size, dim, table, master_state, mirrored),
-                daemon=True,
-            )
-            p.start()
-            child.close()
-            self._procs.append(p)
-            self._conns.append(parent)
+            self._procs.append(None)
+            self._conns.append(None)
+            self._spawn(w)
 
-    def evaluate(self, params_flat: np.ndarray, sigma: float, offsets: np.ndarray,
-                 timeout_s: float = 600.0):
-        """Fan one generation out; returns (fitness, bc, steps) with dead
-        workers' slices left NaN (straggler-drop handles them upstream)."""
-        self._seq += 1
-        seq = self._seq
-        msg = (seq, np.asarray(params_flat, np.float32), float(sigma),
-               np.asarray(offsets))
-        for c in self._conns:
-            try:
-                c.send(msg)
-            except (BrokenPipeError, OSError):
-                pass  # dead worker: its slice stays NaN
+    def _spawn(self, w: int) -> None:
+        (policy_factory, agent_factory, n_proc, population_size, dim, table,
+         master_state, mirrored) = self._spawn_args
+        parent, child = self._ctx.Pipe()
+        p = self._ctx.Process(
+            target=_worker_main,
+            args=(child, policy_factory, agent_factory, w, n_proc,
+                  population_size, dim, table, master_state, mirrored),
+            daemon=True,
+        )
+        p.start()
+        child.close()
+        self._procs[w] = p
+        self._conns[w] = parent
 
-        fitness = np.full(self.population_size, np.nan, np.float32)
-        parts = []
-        for w, c in enumerate(self._conns):
-            if not self._procs[w].is_alive() and not c.poll(0):
+    @property
+    def worker_pids(self) -> list[int]:
+        return [p.pid for p in self._procs]
+
+    def respawn_dead(self) -> int:
+        """Replace dead workers with fresh forks (generation-boundary call).
+        The dead worker's pipe is closed (any buffered stale result is
+        dropped with it) and the corpse parked for ``close()`` to join."""
+        n = 0
+        for w, p in enumerate(self._procs):
+            if p.is_alive():
                 continue
-            # drain: a straggler from a PREVIOUS generation may have queued a
-            # stale result — sequence tags keep generations from mixing
-            while c.poll(timeout_s):
+            try:
+                self._conns[w].close()
+            except OSError:
+                self.telemetry.event("respawn_conn_close_failed", worker=w)
+            self._retired.append(p)
+            self._spawn(w)
+            n += 1
+            self.telemetry.counters.inc("workers_respawned")
+            self.telemetry.event("worker_respawned", worker=w,
+                                 pid=self._procs[w].pid)
+        return n
+
+    # ------------------------------------------------------------ evaluate
+
+    def _send(self, w: int, msg) -> bool:
+        try:
+            self._conns[w].send(msg)
+            return True
+        except (BrokenPipeError, OSError):
+            # dead worker: its slice is handled by the retry/NaN-drop path
+            self.telemetry.counters.inc("worker_send_failures")
+            return False
+
+    def _collect(self, seq: int, pending: dict[int, Any], deadline: float,
+                 parts: list) -> None:
+        """Drain results for ``seq`` from ``pending`` (worker id → conn)
+        until all answered, each dead-with-empty-pipe worker is dropped,
+        or the shared generation deadline passes.  Stale results from
+        earlier sequences (late stragglers) are discarded by tag."""
+        conn_to_w = {id(c): w for w, c in pending.items()}
+        while pending:
+            left = deadline - time.monotonic()
+            if left <= 0:
+                return  # generation deadline: leftovers stay NaN/retryable
+            ready = mpc.wait(list(pending.values()),
+                             timeout=min(left, POLL_SLICE_S))
+            if not ready:
+                # nothing buffered: drop workers that are gone — a corpse
+                # with an empty pipe will never answer, and waiting the
+                # full deadline on it is the exact hang this loop replaces
+                for w in [w for w, c in pending.items()
+                          if not self._procs[w].is_alive() and not c.poll(0)]:
+                    del pending[w]
+                continue
+            for c in ready:
+                w = conn_to_w[id(c)]
                 try:
                     got = c.recv()
                 except (EOFError, OSError):
-                    break
+                    del pending[w]  # pipe closed under us: worker died
+                    continue
                 if got[0] == seq:
                     parts.append(got[1:])
-                    break
-                # got[0] < seq: stale straggler result — discard, keep polling
+                    del pending[w]
+                # got[0] < seq: stale straggler result — discard, keep going
+
+    def evaluate(self, params_flat: np.ndarray, sigma: float,
+                 offsets: np.ndarray, timeout_s: float = 600.0,
+                 generation: int = 0):
+        """Fan one generation out; returns (fitness, bc, steps).
+
+        ``timeout_s`` bounds the whole GENERATION (one shared deadline),
+        not each worker's pipe.  Slices owned by workers that died are
+        retried once on the survivors within the same generation; only
+        what is still unanswered at the deadline (or after the retry)
+        stays NaN for the straggler-drop path upstream.
+        """
+        self._seq += 1
+        seq = self._seq
+        deadline = time.monotonic() + timeout_s
+        msg = (seq, int(generation), np.asarray(params_flat, np.float32),
+               float(sigma), np.asarray(offsets), None)
+        pending = {w: self._conns[w] for w in range(self.n_proc)
+                   if self._send(w, msg)}
+
+        parts: list = []
+        self._collect(seq, pending, deadline, parts)
+
+        # same-generation retry: members owned by DEAD workers never got
+        # evaluated — survivors can cover them (member-keyed noise indexing
+        # means any worker computes the identical theta).  Alive stragglers
+        # are NOT retried: their results may still arrive, and duplicating
+        # them would only double the load that made them late.
+        covered: set[int] = set()
+        for indices, _f, _b, _s in parts:
+            covered.update(int(i) for i in indices)
+        missing = [i for i in range(self.population_size) if i not in covered
+                   and not self._procs[i % self.n_proc].is_alive()]
+        alive = [w for w in range(self.n_proc) if self._procs[w].is_alive()]
+        if missing and alive and deadline - time.monotonic() > 0:
+            self.telemetry.counters.inc("slice_retries")
+            self.telemetry.counters.inc("members_retried", len(missing))
+            self.telemetry.event("slice_retry", members=len(missing),
+                                 survivors=len(alive), gen=int(generation))
+            self._seq += 1
+            rseq = self._seq
+            retry_pending: dict[int, Any] = {}
+            for k, w in enumerate(alive):
+                chunk = missing[k::len(alive)]
+                if chunk and self._send(w, (rseq, int(generation),
+                                            msg[2], msg[3], msg[4],
+                                            np.asarray(chunk, np.int64))):
+                    retry_pending[w] = self._conns[w]
+            self._collect(rseq, retry_pending, deadline, parts)
+
+        fitness = np.full(self.population_size, np.nan, np.float32)
         bc_dim = max((p[2].shape[1] for p in parts), default=0)
         bc = np.zeros((self.population_size, bc_dim), np.float32)
         steps = 0
@@ -164,17 +303,29 @@ class ProcessPool:
             steps += st
         return fitness, bc, steps
 
+    # --------------------------------------------------------------- close
+
     def close(self) -> None:
         for c in self._conns:
             try:
-                c.send(None)
-                c.close()
+                if not c.closed:
+                    c.send(None)
             except (BrokenPipeError, OSError):
+                pass  # worker already dead: nothing to tell — the close
+                # below still reclaims the parent end's fd
+            try:
+                if not c.closed:
+                    c.close()
+            except OSError:
                 pass
-        for p in self._procs:
+        # join everything ever spawned — including workers replaced by
+        # respawn_dead — so long chaos runs leak neither zombies nor fds
+        for p in (*self._procs, *self._retired):
             p.join(timeout=5)
             if p.is_alive():
                 p.terminate()
+                p.join(timeout=5)
+        self._retired.clear()
 
     def __del__(self):
         try:

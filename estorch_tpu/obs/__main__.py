@@ -38,6 +38,10 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--heartbeat", default=None, metavar="PATH",
                    help="heartbeat file for live-run stall diagnosis "
                         "(default: heartbeat.json beside the JSONL)")
+    s.add_argument("--manifest", default=None, metavar="PATH",
+                   help="run manifest for supervisor restart provenance "
+                        "and resilience counters (default: manifest.json "
+                        "beside the JSONL)")
     s.add_argument("--json", action="store_true", dest="as_json",
                    help="machine-readable summary on stdout")
     s.add_argument("--selfcheck", action="store_true",
@@ -69,12 +73,16 @@ def main(argv: list[str] | None = None) -> int:
     except (OSError, ValueError) as e:
         print(f"cannot read {args.jsonl}: {e}", file=sys.stderr)
         return 1
+    run_dir = os.path.dirname(os.path.abspath(args.jsonl))
     hb = args.heartbeat
     if hb is None:
-        cand = os.path.join(os.path.dirname(os.path.abspath(args.jsonl)),
-                            "heartbeat.json")
+        cand = os.path.join(run_dir, "heartbeat.json")
         hb = cand if os.path.exists(cand) else None
-    s = summarize(records, heartbeat_path=hb)
+    mf = args.manifest
+    if mf is None:
+        cand = os.path.join(run_dir, "manifest.json")
+        mf = cand if os.path.exists(cand) else None
+    s = summarize(records, heartbeat_path=hb, manifest_path=mf)
     if args.as_json:
         print(json.dumps(s, default=float))
     else:

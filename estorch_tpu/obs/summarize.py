@@ -107,11 +107,53 @@ def _median(xs: list[float]) -> float:
 
 STALL_FACTOR = 5.0  # a generation this many × the median wall time stalls
 
+# counters surfaced in the summary/diagnosis when nonzero — the
+# resilience layer's evidence that a run survived faults rather than
+# never seeing any (docs/resilience.md)
+RESILIENCE_COUNTERS = (
+    "generations_rejected",
+    "generations_skipped",
+    "workers_respawned",
+    "members_retried",
+    "rollout_failures",
+    "supervisor_resumes",
+    "chaos_worker_kills",
+)
 
-def summarize(records: list[dict], heartbeat_path: str | None = None) -> dict:
+
+def _load_manifest_resilience(manifest_path: str | None) -> dict | None:
+    """The run manifest's ``resilience`` section (supervisor-written
+    restart provenance + cross-restart counter totals), or None."""
+    if not manifest_path:
+        return None
+    try:
+        with open(manifest_path) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return None
+    res = data.get("resilience")
+    return res if isinstance(res, dict) else None
+
+
+def summarize(records: list[dict], heartbeat_path: str | None = None,
+              manifest_path: str | None = None) -> dict:
     """Aggregate a run's records into the summary dict the CLI prints."""
     if not records:
         return {"generations": 0, "diagnosis": "no records"}
+    # supervisor-replayed generations (the gap between the last checkpoint
+    # and a crash) appear twice in an append-only run JSONL — keep the
+    # LAST occurrence per generation (the replay that actually counted)
+    # so totals/medians/trend describe the run, not the run plus replays.
+    # Records without a generation key are kept as-is.
+    seen_gens = [r.get("generation") for r in records]
+    n_replayed = 0
+    if len(set(g for g in seen_gens if g is not None)) < sum(
+            1 for g in seen_gens if g is not None):
+        last_idx = {g: i for i, g in enumerate(seen_gens) if g is not None}
+        kept = [r for i, r in enumerate(records)
+                if seen_gens[i] is None or last_idx[seen_gens[i]] == i]
+        n_replayed = len(records) - len(kept)
+        records = kept
     walls = [float(r.get("wall_time_s", 0.0)) for r in records]
     steps = [int(r.get("env_steps", 0)) for r in records]
     wall_total = sum(walls)
@@ -170,6 +212,8 @@ def summarize(records: list[dict], heartbeat_path: str | None = None) -> dict:
     if trend and trend["ratio"] is not None and trend["ratio"] < 0.8:
         diagnosis.append(
             f"throughput decayed to {trend['ratio']:.0%} of the first half")
+    manifest_res = _load_manifest_resilience(manifest_path)
+    run_completed = bool(manifest_res and manifest_res.get("completed"))
     hb = None
     if heartbeat_path:
         hb = read_heartbeat(heartbeat_path)
@@ -181,11 +225,53 @@ def summarize(records: list[dict], heartbeat_path: str | None = None) -> dict:
             state = (f"last phase={hb.get('phase')} "
                      f"gen={hb.get('generation')} "
                      f"beat {hb['age_s']:.0f}s ago")
-            if hb["age_s"] > STALE_AFTER_S:
+            if hb["age_s"] > STALE_AFTER_S and run_completed:
+                # the supervisor recorded clean completion: an old beat is
+                # the FINAL child's last state, not a wedge
+                diagnosis.append(f"run completed (supervised); {state}")
+            elif hb["age_s"] > STALE_AFTER_S:
                 diagnosis.append(f"STALE heartbeat: {state} — the run is "
                                  "wedged or dead, not slow")
             else:
                 diagnosis.append(f"heartbeat fresh: {state}")
+
+    # ---- resilience: counters + supervisor restart provenance ----------
+    # manifest counters are cross-restart totals (the supervisor sums each
+    # child's last heartbeat) — prefer them over the live heartbeat's,
+    # which only covers the CURRENT child
+    counter_src = None
+    if manifest_res and isinstance(manifest_res.get("counters"), dict):
+        counter_src = manifest_res["counters"]
+    elif hb and isinstance(hb.get("counters"), dict):
+        counter_src = hb["counters"]
+    counters = None
+    if counter_src is not None:
+        counters = {k: counter_src[k] for k in RESILIENCE_COUNTERS
+                    if counter_src.get(k)}
+        hits = [f"{int(counters[k])} {k}" for k in counters]
+        if hits:
+            diagnosis.append("resilience: " + ", ".join(hits))
+    restarts = None
+    if manifest_res is not None:
+        n_restarts = int(manifest_res.get("restart_count", 0))
+        restarts = {
+            "count": n_restarts,
+            "completed": manifest_res.get("completed"),
+            "reasons": [r.get("reason") for r in
+                        manifest_res.get("restarts", [])],
+        }
+        if n_restarts:
+            # reasons may be absent/truncated in a hand-edited or partial
+            # manifest — diagnostics must degrade, never crash
+            last = (f" (last: {restarts['reasons'][-1]})"
+                    if restarts["reasons"] else "")
+            diagnosis.append(
+                f"supervisor restarted the run {n_restarts}x{last}")
+    if n_replayed:
+        diagnosis.append(
+            f"{n_replayed} replayed generation record"
+            f"{'s' if n_replayed != 1 else ''} deduped (re-run after a "
+            "restart resumed from an earlier checkpoint)")
     if not diagnosis:
         diagnosis.append("steady: no stalls, no throughput decay")
 
@@ -204,6 +290,10 @@ def summarize(records: list[dict], heartbeat_path: str | None = None) -> dict:
     }
     if hb is not None:
         out["heartbeat"] = hb
+    if counters:
+        out["counters"] = counters
+    if restarts is not None:
+        out["restarts"] = restarts
     return out
 
 
@@ -235,6 +325,12 @@ def format_summary(s: dict) -> str:
             f"throughput       {t['first_half_steps_per_s']:,} → "
             f"{t['second_half_steps_per_s']:,} steps/s "
             f"(x{t['ratio']})")
+    if s.get("counters"):
+        lines.append("resilience       " + "  ".join(
+            f"{k}={int(v)}" for k, v in s["counters"].items()))
+    if s.get("restarts") and s["restarts"]["count"]:
+        lines.append(f"restarts         {s['restarts']['count']} "
+                     f"(completed={s['restarts']['completed']})")
     lines.append(f"diagnosis        {s['diagnosis']}")
     return "\n".join(lines)
 
@@ -278,4 +374,40 @@ def selfcheck() -> list[str]:
         problems.append(f"top-level shares sum to {total_share}, not 1")
     if format_summary(s) == "no records":
         problems.append("format_summary rendered nothing")
+
+    # resilience surfacing: a chaos run's rejected-generation counters and
+    # the supervisor's restart provenance must show up in the summary —
+    # validated against synthetic heartbeat/manifest files so drift fails
+    # here, not in a post-mortem
+    import os
+    import tempfile
+    import time as _time
+
+    with tempfile.TemporaryDirectory() as d:
+        hb_path = os.path.join(d, "heartbeat.json")
+        with open(hb_path, "w") as f:
+            json.dump({"ts": _time.time(), "pid": 1, "phase": "eval",
+                       "generation": 3,
+                       "counters": {"generations_rejected": 2,
+                                    "workers_respawned": 1}}, f)
+        mf_path = os.path.join(d, "manifest.json")
+        with open(mf_path, "w") as f:
+            json.dump({"resilience": {
+                "restart_count": 1, "completed": True,
+                "restarts": [{"reason": "child died with exit code -9"}],
+                "counters": {"generations_rejected": 2,
+                             "generations_skipped": 1}}}, f)
+        sr = summarize(recs, heartbeat_path=hb_path, manifest_path=mf_path)
+        if sr.get("counters", {}).get("generations_rejected") != 2:
+            problems.append("summary missed generations_rejected counter")
+        if sr.get("restarts", {}).get("count") != 1:
+            problems.append("summary missed supervisor restart count")
+        if "restarted" not in sr["diagnosis"]:
+            problems.append("diagnosis missed the supervisor restart")
+        if "resilience" not in format_summary(sr):
+            problems.append("format_summary dropped resilience counters")
+        # heartbeat-only fallback (no supervisor/manifest in the run)
+        sh = summarize(recs, heartbeat_path=hb_path)
+        if sh.get("counters", {}).get("workers_respawned") != 1:
+            problems.append("heartbeat counters not surfaced sans manifest")
     return problems

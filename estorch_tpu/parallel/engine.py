@@ -910,6 +910,14 @@ class ESEngine:
             "steps": steps,
             "grad_norm": gnorm,
             "n_valid": n_valid,
+            # post-update anomaly guard input: replicated boolean — a
+            # non-finite parameter vector or update norm after the optax
+            # step means ES.train must reject this generation (restore the
+            # previous state) instead of training on poisoned params
+            "update_finite": jnp.logical_and(
+                jnp.isfinite(gnorm),
+                jnp.isfinite(new_state.params_flat).all(),
+            ),
         }
         return new_state, metrics
 

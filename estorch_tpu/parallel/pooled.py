@@ -576,10 +576,21 @@ class PooledEngine:
         return new_state, gnorm
 
     def generation_step(self, state: ESState):
+        from ..resilience.chaos import mutate_fitness
+
         obs = self.telemetry
         with obs.phase("eval"):
             ev = self.evaluate(state)
             fit = np.asarray(ev.fitness)
+        fit = mutate_fitness(state.generation, fit)
+        n_valid = int(np.isfinite(fit).sum())
+        base = {"fitness": fit, "bc": ev.bc, "steps": ev.steps,
+                "n_valid": n_valid}
+        if n_valid < 2:
+            # population collapse: report via n_valid with state untouched —
+            # ES.train owns the reject/re-run policy (docs/resilience.md)
+            return state, {**base, "grad_norm": float("nan"),
+                           "update_finite": True}
         # NaN-safe: a crashed/diverged rollout must not win the top rank
         # (np.argsort sorts NaN last) — drop it and renormalize survivors
         with obs.phase("update"):
@@ -588,10 +599,12 @@ class PooledEngine:
             # fence the psum/optax program so the span is device time
             jax.block_until_ready(new_state.params_flat)
         metrics = {
-            "fitness": ev.fitness,
-            "bc": ev.bc,
-            "steps": ev.steps,
+            **base,
             "grad_norm": gnorm,
-            "n_valid": int(np.isfinite(fit).sum()),
+            # post-update anomaly guard input (ES.train rejects on False)
+            "update_finite": bool(
+                np.isfinite(np.asarray(gnorm))
+                and np.isfinite(np.asarray(new_state.params_flat)).all()
+            ),
         }
         return new_state, metrics

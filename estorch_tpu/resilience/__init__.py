@@ -1,0 +1,26 @@
+"""Resilience subsystem: supervised auto-resume, deterministic chaos
+injection, and per-generation fault containment (docs/resilience.md).
+
+The primitives (utils/fault.py NaN-drop renormalization,
+utils/checkpoint.py exact-state resume, obs/recorder.py heartbeat) exist
+elsewhere; this package is the layer that *uses* them under real
+failure:
+
+* :func:`run_resilient` — catch/rollback/re-run a faulted generation
+  in-process.
+* :class:`Supervisor` — child-process training with heartbeat watchdog
+  and restart-from-latest-checkpoint.
+* :class:`ChaosPlan` / ``ESTORCH_CHAOS`` — deterministic fault schedule
+  so every recovery path above is exercised reproducibly.
+"""
+
+from .chaos import CHAOS_ENV, ChaosError, ChaosPlan
+from .supervisor import Supervisor, run_resilient
+
+__all__ = [
+    "CHAOS_ENV",
+    "ChaosError",
+    "ChaosPlan",
+    "Supervisor",
+    "run_resilient",
+]

@@ -1,0 +1,340 @@
+"""Deterministic chaos injection — the reproducible fault harness.
+
+Every recovery path in this framework (worker respawn, same-generation
+slice retry, update rejection, supervised restart from checkpoint) exists
+because of a fault that is, in the wild, rare and unreproducible.  This
+module makes each fault a *scheduled event*: a :class:`ChaosPlan` pins
+faults to exact ``(generation, member/worker)`` points, so a test can
+assert "a run that loses a worker at generation 5 ends bit-identical to
+one that never did" instead of hoping a race fires.
+
+Plan format (the ``ESTORCH_CHAOS`` environment variable carries it as
+JSON, so forked/spawned children inherit the same plan):
+
+    {"events": [
+        {"kind": "kill_worker", "gen": 5, "worker": 0},
+        {"kind": "nan_fitness", "gen": 9, "member": "all"},
+        {"kind": "rollout_exc", "gen": 3, "member": [1, 4]},
+        {"kind": "straggler",   "gen": 4, "member": 2, "sleep_s": 2.0},
+        {"kind": "ckpt_crash",  "gen": 8},
+        {"kind": "nan_update",  "gen": 2},
+        {"kind": "die",         "gen": 12},
+        {"kind": "wedge",       "gen": 2, "sleep_s": 300.0}
+     ],
+     "ledger": "/tmp/run/chaos_ledger"}
+
+Event kinds and their injection points:
+
+==============  =====================================================
+kind            fires where
+==============  =====================================================
+rollout_exc     inside the member rollout (host thread + fork workers)
+straggler       same place, as a ``sleep_s`` stall
+nan_fitness     on the gathered fitness vector (host/pooled engines)
+kill_worker     SIGKILL of a ProcessPool worker at the generation start
+nan_update      poisons the update direction (host engine) — exercises
+                the post-update anomaly guard
+ckpt_crash      raises mid-``save_checkpoint``, after the sidecar files
+                but before the Orbax payload finalizes
+die             SIGKILL of the WHOLE process (resilience.run_resilient
+                loop head) — exercises the Supervisor restart path
+wedge           a long un-heartbeated sleep at the same point —
+                exercises the Supervisor's staleness watchdog
+==============  =====================================================
+
+Events fire **once**.  In-process that is an in-memory set; across
+process restarts (the Supervisor respawning a SIGKILLed child must not
+replay the kill forever) the plan's optional ``ledger`` file records
+fired event ids append-only, so a resumed run skips them.  The hook
+functions below are no-ops costing one environment lookup when
+``ESTORCH_CHAOS`` is unset — they are safe on hot paths.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import threading
+import time
+
+CHAOS_ENV = "ESTORCH_CHAOS"
+
+KINDS = (
+    "rollout_exc",
+    "straggler",
+    "nan_fitness",
+    "kill_worker",
+    "nan_update",
+    "ckpt_crash",
+    "die",
+    "wedge",
+)
+
+
+class ChaosError(RuntimeError):
+    """An injected fault (rollout exception, checkpoint-write crash)."""
+
+
+class ChaosPlan:
+    """A deterministic, replayable schedule of faults.
+
+    ``events`` is a list of dicts (see module docstring for the schema);
+    each gets a stable ``id`` (its index) used for once-semantics.
+    """
+
+    def __init__(self, events, ledger: str | None = None):
+        self._events: list[dict] = []
+        self._by_gen: dict[int, list[dict]] = {}
+        for i, ev in enumerate(events):
+            kind = ev.get("kind")
+            if kind not in KINDS:
+                raise ValueError(
+                    f"unknown chaos event kind {kind!r} (event {i}); "
+                    f"known: {', '.join(KINDS)}"
+                )
+            if "gen" not in ev:
+                raise ValueError(f"chaos event {i} ({kind}) has no 'gen'")
+            ev = dict(ev, id=i)
+            self._events.append(ev)
+            self._by_gen.setdefault(int(ev["gen"]), []).append(ev)
+        self.ledger = ledger
+        self._fired: set[int] = set()
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------ construct
+
+    @classmethod
+    def parse(cls, text: str) -> "ChaosPlan":
+        data = json.loads(text)
+        if not isinstance(data, dict):
+            raise ValueError("chaos plan must be a JSON object")
+        return cls(data.get("events", []), ledger=data.get("ledger"))
+
+    @classmethod
+    def from_env(cls) -> "ChaosPlan | None":
+        text = os.environ.get(CHAOS_ENV)
+        return cls.parse(text) if text else None
+
+    @classmethod
+    def generate(
+        cls,
+        seed: int,
+        n_generations: int,
+        ledger: str | None = None,
+        kill_every: int = 0,
+        n_workers: int = 1,
+        p_rollout_exc: float = 0.0,
+        p_nan_burst: float = 0.0,
+        population_size: int = 1,
+    ) -> "ChaosPlan":
+        """Seeded random plan — deterministic in ``seed``: the same seed
+        always schedules the same faults at the same points."""
+        import numpy as np
+
+        rng = np.random.default_rng(seed)
+        events: list[dict] = []
+        for g in range(1, n_generations + 1):
+            if kill_every and g % kill_every == 0:
+                events.append(
+                    {"kind": "kill_worker", "gen": g,
+                     "worker": int(rng.integers(n_workers))}
+                )
+            if p_rollout_exc and rng.random() < p_rollout_exc:
+                events.append(
+                    {"kind": "rollout_exc", "gen": g,
+                     "member": int(rng.integers(population_size))}
+                )
+            if p_nan_burst and rng.random() < p_nan_burst:
+                events.append({"kind": "nan_fitness", "gen": g,
+                               "member": "all"})
+        return cls(events, ledger=ledger)
+
+    # -------------------------------------------------------------- inspect
+
+    @property
+    def events(self) -> list[dict]:
+        return [dict(ev) for ev in self._events]
+
+    def to_json(self) -> str:
+        """The env-var form (``os.environ[CHAOS_ENV] = plan.to_json()``)."""
+        stripped = [{k: v for k, v in ev.items() if k != "id"}
+                    for ev in self._events]
+        data: dict = {"events": stripped}
+        if self.ledger:
+            data["ledger"] = self.ledger
+        return json.dumps(data)
+
+    def events_at(self, generation: int, kind: str | None = None) -> list[dict]:
+        evs = self._by_gen.get(int(generation), [])
+        return [ev for ev in evs if kind is None or ev["kind"] == kind]
+
+    # ---------------------------------------------------------------- fire
+
+    def fire(self, event: dict) -> bool:
+        """Claim ``event``: True exactly once per event id, across every
+        process sharing the plan's ledger file (best-effort: the append
+        happens-before the fault's observable effect, so a retry or a
+        restarted process that reads the ledger sees it)."""
+        eid = int(event["id"])
+        with self._lock:
+            if eid in self._fired:
+                return False
+            if self.ledger:
+                fired = self._read_ledger()
+                self._fired |= fired
+                if eid in fired:
+                    return False
+                # O_APPEND keeps small same-file writes from interleaving
+                with open(self.ledger, "a") as f:
+                    f.write(f"{eid}\n")
+                    f.flush()
+            self._fired.add(eid)
+            return True
+
+    def _read_ledger(self) -> set[int]:
+        try:
+            with open(self.ledger) as f:
+                return {int(line) for line in f if line.strip()}
+        except (OSError, ValueError):
+            return set()
+
+
+# ---------------------------------------------------------------------
+# process-wide plan (env-driven, inherited by forked/spawned children)
+# ---------------------------------------------------------------------
+
+_cache_text: str | None = None
+_cache_plan: ChaosPlan | None = None
+
+
+def active_plan() -> ChaosPlan | None:
+    """The ``ESTORCH_CHAOS`` plan, parsed once per distinct env value.
+    None (the overwhelmingly common case) costs one dict lookup."""
+    global _cache_text, _cache_plan
+    text = os.environ.get(CHAOS_ENV)
+    if not text:
+        return None
+    if text != _cache_text:
+        _cache_text, _cache_plan = text, ChaosPlan.parse(text)
+    return _cache_plan
+
+
+def reset_cache() -> None:
+    """Drop the cached plan (tests that reuse identical plan text)."""
+    global _cache_text, _cache_plan
+    _cache_text = _cache_plan = None
+
+
+def _matches_member(ev: dict, member: int) -> bool:
+    m = ev.get("member", "all")
+    if m == "all":
+        return True
+    if isinstance(m, (list, tuple)):
+        return int(member) in [int(x) for x in m]
+    return int(m) == int(member)
+
+
+# ------------------------------------------------------------------ hooks
+
+def member_fault(generation, member: int) -> None:
+    """Rollout-level faults for one (generation, member): ``straggler``
+    sleeps, ``rollout_exc`` raises :class:`ChaosError` (the caller's
+    normal failed-rollout handling marks the member NaN)."""
+    plan = active_plan()
+    if plan is None:
+        return
+    gen = int(generation)
+    for ev in plan.events_at(gen, "straggler"):
+        if _matches_member(ev, member) and plan.fire(ev):
+            time.sleep(float(ev.get("sleep_s", 1.0)))
+    for ev in plan.events_at(gen, "rollout_exc"):
+        if _matches_member(ev, member) and plan.fire(ev):
+            raise ChaosError(
+                f"injected rollout exception (gen {gen}, member {member})"
+            )
+
+
+def mutate_fitness(generation, fitness):
+    """``nan_fitness`` bursts: returns ``fitness`` with the event's
+    members NaN'd (a copy — the input is never modified), or the input
+    unchanged when no event fires."""
+    plan = active_plan()
+    if plan is None:
+        return fitness
+    import numpy as np
+
+    out = fitness
+    for ev in plan.events_at(int(generation), "nan_fitness"):
+        if plan.fire(ev):
+            out = np.array(out, np.float32, copy=True)
+            m = ev.get("member", "all")
+            if m == "all":
+                out[:] = np.nan
+            else:
+                idx = np.asarray(m if isinstance(m, (list, tuple)) else [m],
+                                 np.intp)
+                out[idx] = np.nan
+    return out
+
+
+def kill_workers(generation, pids) -> list[int]:
+    """``kill_worker``: SIGKILL the scheduled worker(s); returns the pids
+    actually killed (the caller counts them)."""
+    plan = active_plan()
+    if plan is None:
+        return []
+    killed: list[int] = []
+    for ev in plan.events_at(int(generation), "kill_worker"):
+        w = int(ev.get("worker", 0))
+        if 0 <= w < len(pids) and plan.fire(ev):
+            os.kill(pids[w], signal.SIGKILL)
+            killed.append(pids[w])
+    return killed
+
+
+def poison_update(generation) -> bool:
+    """``nan_update``: True when this generation's update direction should
+    be poisoned (exercises the post-update anomaly guard)."""
+    plan = active_plan()
+    if plan is None:
+        return False
+    return any(
+        plan.fire(ev) for ev in plan.events_at(int(generation), "nan_update")
+    )
+
+
+def crash_checkpoint(generation) -> None:
+    """``ckpt_crash``: raise mid-checkpoint-write (the caller has written
+    the sidecar files but not finalized the state payload)."""
+    plan = active_plan()
+    if plan is None:
+        return
+    for ev in plan.events_at(int(generation), "ckpt_crash"):
+        if plan.fire(ev):
+            raise ChaosError(
+                f"injected checkpoint-write crash (gen {int(generation)})"
+            )
+
+
+def process_kill(generation) -> None:
+    """``die``: SIGKILL this whole process.  The ledger entry is written
+    by ``fire`` BEFORE the kill, so a supervisor-restarted replay of the
+    same generation does not die again."""
+    plan = active_plan()
+    if plan is None:
+        return
+    for ev in plan.events_at(int(generation), "die"):
+        if plan.fire(ev):
+            os.kill(os.getpid(), signal.SIGKILL)
+
+
+def process_wedge(generation) -> None:
+    """``wedge``: sleep without heartbeating — the supervisor's staleness
+    watchdog must detect and kill this process."""
+    plan = active_plan()
+    if plan is None:
+        return
+    for ev in plan.events_at(int(generation), "wedge"):
+        if plan.fire(ev):
+            time.sleep(float(ev.get("sleep_s", 3600.0)))

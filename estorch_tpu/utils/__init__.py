@@ -1,6 +1,7 @@
 from .backend import (enable_compilation_cache, force_cpu_backend,
                       set_host_device_count_flag)
-from .checkpoint import PeriodicCheckpointer, restore_checkpoint, save_checkpoint
+from .checkpoint import (PeriodicCheckpointer, latest_checkpoint,
+                         restore_checkpoint, save_checkpoint)
 from .fault import mask_and_renormalize, rank_weights_with_failures, valid_mask
 from .metrics import JsonlWriter, MultiWriter, TensorBoardWriter
 from .profiler import annotate, timed_generations, trace
@@ -10,6 +11,7 @@ __all__ = [
     "force_cpu_backend",
     "set_host_device_count_flag",
     "PeriodicCheckpointer",
+    "latest_checkpoint",
     "restore_checkpoint",
     "save_checkpoint",
     "mask_and_renormalize",

@@ -145,21 +145,10 @@ def save_checkpoint(es, path: str, asynchronous: bool = False,
 
     path = os.path.abspath(path)
     os.makedirs(path, exist_ok=True)
-    if asynchronous:
-        # _async_ckptr: a long-lived checkpointer supplied by the caller
-        # (PeriodicCheckpointer) — Orbax's intended reuse pattern; a bare
-        # call gets its own, closed by the handle's wait()
-        ckptr = _async_ckptr or ocp.AsyncCheckpointer(
-            ocp.StandardCheckpointHandler()
-        )
-        ckptr.save(
-            os.path.join(path, "state"),
-            args=ocp.args.StandardSave(_state_tree(es)),
-            force=True,
-        )
-    else:
-        ckptr = ocp.StandardCheckpointer()
-        ckptr.save(os.path.join(path, "state"), _state_tree(es), force=True)
+    # sidecar files FIRST, Orbax payload LAST: the finalized state/ dir is
+    # the commit point (Orbax writes to a tmp dir and renames), so a crash
+    # at ANY earlier moment leaves a directory that latest_checkpoint()
+    # skips — never a restorable-looking checkpoint missing its sidecars
     with open(os.path.join(path, "meta.json"), "w") as f:
         json.dump(_meta_dict(es), f, indent=2)
     # per-generation records survive resume (meta's history_len cross-checks)
@@ -172,8 +161,26 @@ def save_checkpoint(es, path: str, asynchronous: bool = False,
             [s.opt_state for s in _all_states(es)],
             os.path.join(path, "host_opt.pt"),
         )
+    # deterministic chaos: a scheduled mid-checkpoint-write crash lands
+    # exactly here — sidecars written, payload not finalized
+    from ..resilience.chaos import crash_checkpoint
+
+    crash_checkpoint(es.generation)
     if asynchronous:
+        # _async_ckptr: a long-lived checkpointer supplied by the caller
+        # (PeriodicCheckpointer) — Orbax's intended reuse pattern; a bare
+        # call gets its own, closed by the handle's wait()
+        ckptr = _async_ckptr or ocp.AsyncCheckpointer(
+            ocp.StandardCheckpointHandler()
+        )
+        ckptr.save(
+            os.path.join(path, "state"),
+            args=ocp.args.StandardSave(_state_tree(es)),
+            force=True,
+        )
         return AsyncSaveHandle(ckptr, owned=_async_ckptr is None)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(os.path.join(path, "state"), _state_tree(es), force=True)
     ckptr.wait_until_finished()
     return None
 
@@ -329,6 +336,24 @@ def _unpack_state(es, packed: dict, host_opt=None):
     )
 
 
+def latest_checkpoint(root: str) -> str | None:
+    """Newest checkpoint under ``root`` whose Orbax payload is FINALIZED.
+
+    An async save mid-drain, or a crash mid-write, leaves meta.json
+    without a ``state/`` dir (Orbax writes to a tmp dir and renames on
+    finalize) — such a directory must not shadow the older restorable
+    one.  Module-level so supervisors (resilience/supervisor.py) can find
+    the resume point without constructing an ES first."""
+    try:
+        cks = sorted(d for d in os.listdir(root) if d.startswith("gen_"))
+    except OSError:
+        return None
+    for d in reversed(cks):
+        if os.path.isdir(os.path.join(root, d, "state")):
+            return os.path.join(root, d)
+    return None
+
+
 class PeriodicCheckpointer:
     """Save every K generations; keeps the newest ``max_to_keep`` checkpoints.
 
@@ -394,15 +419,8 @@ class PeriodicCheckpointer:
             self._ckptr = None
 
     def latest(self) -> str | None:
-        """Newest checkpoint whose Orbax payload is FINALIZED — an async
-        save mid-drain (or a crash mid-write) leaves meta.json without a
-        state/ dir (Orbax writes to a tmp dir and renames on finalize);
-        such a directory must not shadow the older restorable one."""
-        cks = sorted(d for d in os.listdir(self.root) if d.startswith("gen_"))
-        for d in reversed(cks):
-            if os.path.isdir(os.path.join(self.root, d, "state")):
-                return os.path.join(self.root, d)
-        return None
+        """Newest restorable checkpoint (see :func:`latest_checkpoint`)."""
+        return latest_checkpoint(self.root)
 
     def _gc(self) -> None:
         import shutil

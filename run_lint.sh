@@ -17,6 +17,14 @@ echo "== obs selfcheck =="
 # before a JSONL consumer parses mismatched records
 python -m estorch_tpu.obs summarize --selfcheck
 
+echo "== chaos selfcheck =="
+# recovery-path gate (estorch_tpu/resilience, docs/resilience.md): a tiny
+# host-backend run under a worker-kill chaos plan must keep FULL
+# population participation (respawn + same-generation retry) — measured
+# against a clean twin; fails when recovery regressed.  Host path only,
+# no device touch.
+python bench.py --chaos --selfcheck
+
 echo "== compileall =="
 python -m compileall -q estorch_tpu/ tests/ examples/
 

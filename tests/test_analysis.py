@@ -662,6 +662,129 @@ class TestR07:
 
 
 # ---------------------------------------------------------------------
+# R08 swallowed-fault
+# ---------------------------------------------------------------------
+
+class TestR08:
+    def test_pass_only_handler_in_recovery_path_flagged(self):
+        found = findings("""
+            def send_all(conns, msg):
+                for c in conns:
+                    try:
+                        c.send(msg)
+                    except OSError:
+                        pass
+        """, "R08")
+        assert len(found) == 1
+        assert found[0].symbol == "send_all"
+        assert "swallowed" in found[0].message
+
+    def test_counter_bump_is_evidence(self):
+        assert not findings("""
+            def send_all(self, conns, msg):
+                for c in conns:
+                    try:
+                        c.send(msg)
+                    except OSError:
+                        self.telemetry.counters.inc("worker_send_failures")
+        """, "R08")
+
+    def test_flag_assignment_is_evidence(self):
+        assert not findings("""
+            def reap(proc):
+                unreapable = False
+                try:
+                    proc.wait(timeout=5)
+                except TimeoutError:
+                    unreapable = True
+                return unreapable
+        """, "R08")
+
+    def test_reraise_is_clean(self):
+        assert not findings("""
+            def step(env):
+                try:
+                    return env.step()
+                except RuntimeError:
+                    raise
+        """, "R08")
+
+    def test_teardown_paths_exempt(self):
+        assert not findings("""
+            class Pool:
+                def close(self):
+                    try:
+                        self.conn.send(None)
+                    except OSError:
+                        pass
+
+                def __del__(self):
+                    try:
+                        self.close()
+                    except Exception:
+                        pass
+
+                def __exit__(self, *exc):
+                    try:
+                        self.close()
+                    except Exception:
+                        pass
+        """, "R08")
+
+    def test_fall_through_probe_exempt(self):
+        # the R06-prescribed probe idiom: try the introspection fast path,
+        # fall through to the behavioral probe — the pass IS the dispatch
+        assert not findings("""
+            import inspect
+
+            def takes_params(fn):
+                try:
+                    return bool(inspect.signature(fn).parameters)
+                except (TypeError, ValueError):
+                    pass
+                try:
+                    fn()
+                    return False
+                except TypeError:
+                    return True
+        """, "R08")
+
+    def test_pass_only_at_module_level_flagged(self):
+        found = findings("""
+            try:
+                import optional_dep
+            except ImportError:
+                pass
+        """, "R08")
+        assert len(found) == 1
+        assert found[0].symbol == "<module>"
+
+    def test_multi_handler_try_flags_only_the_silent_one(self):
+        found = findings("""
+            def fetch(conn):
+                try:
+                    return conn.recv()
+                except EOFError:
+                    raise
+                except OSError:
+                    pass
+        """, "R08")
+        # the try body ends in `return` — fall-through shape, both exempt
+        assert not found
+        found = findings("""
+            def fetch(conn):
+                try:
+                    data = conn.recv()
+                except EOFError:
+                    raise
+                except OSError:
+                    pass
+        """, "R08")
+        assert len(found) == 1
+        assert found[0].snippet.strip() == "except OSError:"
+
+
+# ---------------------------------------------------------------------
 # engine / CLI / config / baseline mechanics
 # ---------------------------------------------------------------------
 
@@ -685,7 +808,8 @@ def launch(cmd):
 class TestEngine:
     def test_every_rule_registered(self):
         ids = [r.id for r in all_rules()]
-        assert ids == ["R01", "R02", "R03", "R04", "R05", "R06", "R07"]
+        assert ids == ["R01", "R02", "R03", "R04", "R05", "R06", "R07",
+                       "R08"]
 
     def test_syntax_error_becomes_finding(self):
         found = analyze_source("bad.py", "def broken(:\n")
@@ -818,7 +942,7 @@ class TestConfig:
         cfg = load_config(os.path.join(root, "pyproject.toml"))
         assert cfg.baseline == "esguard_baseline.json"
         assert cfg.rule_ids([r.id for r in all_rules()]) == [
-            "R01", "R02", "R03", "R04", "R05", "R06", "R07"]
+            "R01", "R02", "R03", "R04", "R05", "R06", "R07", "R08"]
 
 
 class TestCLI:

@@ -111,6 +111,61 @@ class TestObsCheck:
         assert out["heartbeat"]["age_s"] > STALE_AFTER_S
 
 
+class TestResilienceCheck:
+    def test_config_checks_without_probe(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("ESTORCH_CKPT_ROOT", str(tmp_path))
+        out = doctor.check_resilience()
+        assert out["ckpt_root"]["path"] == str(tmp_path)
+        assert out["ckpt_root"]["writable"] is True
+        assert "roundtrip" not in out  # probe is opt-in (subprocess cost)
+        assert out["fork"]["available"] is True  # this CI image is posix
+        assert out["heartbeat_watchdog"]["telemetry_enabled"] in (True, False)
+
+    def test_unwritable_ckpt_root_never_crashes(self, tmp_path):
+        out = doctor.check_resilience(
+            ckpt_root=str(tmp_path / "missing" / "deep"))
+        assert out["ckpt_root"]["writable"] is False
+        assert "error" in out["ckpt_root"]
+
+    def test_watchdog_warns_on_heartbeat_with_telemetry_off(
+            self, tmp_path, monkeypatch):
+        """The config trap the sanity check exists for: a heartbeat path
+        with ESTORCH_OBS=0 means no beats ever — a staleness watchdog
+        would kill perfectly healthy runs."""
+        monkeypatch.setenv("ESTORCH_OBS_HEARTBEAT",
+                           str(tmp_path / "hb.json"))
+        monkeypatch.setenv("ESTORCH_OBS", "0")
+        out = doctor.check_resilience(ckpt_root=str(tmp_path))
+        wd = out["heartbeat_watchdog"]
+        assert wd["heartbeat_env_set"] is True
+        assert wd["telemetry_enabled"] is False
+        assert "warning" in wd
+        assert wd["heartbeat_dir_writable"] is True
+
+    def test_roundtrip_probe_classifier(self, tmp_path, monkeypatch):
+        """Probe protocol pinned on controlled children (the real probe
+        builds a tiny ES — exercised once in test_resilience.py's
+        supervisor flow, not per doctor test)."""
+        monkeypatch.setattr(doctor, "_RESILIENCE_PROBE",
+                            "print('RESILIENCE_PROBE_OK')")
+        out = doctor.check_resilience(ckpt_root=str(tmp_path), probe=True)
+        assert out["roundtrip"] == {"status": "ok"}
+
+        monkeypatch.setattr(doctor, "_RESILIENCE_PROBE",
+                            "raise RuntimeError('orbax exploded')")
+        out = doctor.check_resilience(ckpt_root=str(tmp_path), probe=True)
+        assert out["roundtrip"]["status"] == "error"
+        assert "orbax exploded" in out["roundtrip"]["stderr_tail"]
+
+    @pytest.mark.slow
+    def test_roundtrip_probe_wedge_detected(self, tmp_path, monkeypatch):
+        monkeypatch.setattr(doctor, "_RESILIENCE_PROBE",
+                            "import time; time.sleep(60)")
+        out = doctor.check_resilience(ckpt_root=str(tmp_path), probe=True,
+                                      probe_timeout_s=8)
+        assert out["roundtrip"]["status"] == "wedged"
+
+
 class TestReport:
     def test_report_shape_and_hints(self, monkeypatch):
         monkeypatch.setattr(doctor, "probe_device",
@@ -122,6 +177,9 @@ class TestReport:
         assert isinstance(rep["native"]["cpp_pool"], bool)
         assert rep["optional"]["gymnasium"]["available"] is True
         assert rep["obs"]["trace_dir"]["writable"] in (True, False)
+        # resilience config checks ride every report (probe is opt-in)
+        assert rep["resilience"]["fork"]["available"] is True
+        assert "ckpt_root" in rep["resilience"]
 
     def test_report_run_dir_flows_to_obs_check(self, tmp_path,
                                                monkeypatch):

@@ -52,6 +52,14 @@ def test_extended_surface_imports():
         summarize,
         write_manifest,
     )
+    from estorch_tpu.resilience import (  # noqa: F401
+        CHAOS_ENV,
+        ChaosError,
+        ChaosPlan,
+        Supervisor,
+        run_resilient,
+    )
+    from estorch_tpu.utils import latest_checkpoint  # noqa: F401
 
 
 def test_es_constructor_signature_matches_reference():
