@@ -50,6 +50,18 @@ measurement cannot take down the bench — round-1 lesson):
                                         the run_lint.sh gate: nonzero exit
                                         when recovery did not actually
                                         recover
+    bench.py --serve [--selfcheck]      serving A/B (estorch_tpu/serve,
+                                        docs/serving.md): export a trained
+                                        pendulum bundle, serve it, drive
+                                        closed-loop load — dynamic batching
+                                        vs the same server at max_batch=1.
+                                        Gates bit-exact responses, clean
+                                        SIGTERM drain, recompiles ≤
+                                        n_buckets; the full form also gates
+                                        the ≥3x batching win on a big
+                                        (memory-bound) policy.  --selfcheck
+                                        shrinks the policy to the
+                                        run_lint.sh functional gate
     bench.py                            headline + extras, the driver entry
 
 Every stage child writes a heartbeat file (ESTORCH_OBS_HEARTBEAT →
@@ -623,6 +635,183 @@ def stage_chaos(selfcheck=False):
     return 0 if recovered else 1
 
 
+def measure_serve_one(cfg):
+    """Child body for --stage-serve-one: export a trained pendulum bundle,
+    then run the dynamic-batching vs batch-size-1 serving A/B against it
+    (both legs are the SAME server binary, only --max-batch differs).
+    Also verifies the bit-exactness contract (served responses vs this
+    process's es.predict on a batch — same --cpu-devices 1 config on both
+    sides) and the SIGTERM drain.  Returns one JSON row."""
+    from estorch_tpu.utils import force_cpu_backend
+
+    force_cpu_backend(1)
+    import signal
+
+    import jax
+    import optax
+
+    from estorch_tpu import ES, JaxAgent
+    from estorch_tpu.envs.pendulum import Pendulum
+    from estorch_tpu.models import MLPPolicy
+    from estorch_tpu.serve.loadgen import run_load
+
+    hidden = int(cfg.get("hidden", 256))
+    gens = int(cfg.get("gens", 1))
+    duration = float(cfg.get("duration_s", 2.0))
+    max_batch = int(cfg.get("max_batch", 32))
+    # table must cover the (hidden x hidden)-dominated param dim; the next
+    # power of two above 2*hidden^2 always does
+    table_size = max(1 << 14, 1 << (2 * hidden * hidden).bit_length())
+    es = ES(
+        MLPPolicy, JaxAgent(Pendulum(), horizon=8), optax.adam,
+        population_size=4, sigma=0.05, seed=0,
+        policy_kwargs={"action_dim": 1, "hidden": (hidden, hidden),
+                       "discrete": False, "action_scale": 2.0},
+        optimizer_kwargs={"learning_rate": 0.01},
+        table_size=table_size,
+        device=jax.devices()[0],
+    )
+    es.train(gens, verbose=False)
+    # anchor-sized check set: served responses chain to the ANCHOR
+    # (largest) bucket via the batcher's verification, and the anchor
+    # shape is where es.predict's direct program and the serving vmap
+    # agree — a reference at any other batch shape could legitimately
+    # differ by 1 ulp (tests/test_serve.py sizes its check set the same
+    # way)
+    rng = np.random.default_rng(0)
+    check_obs = rng.standard_normal((max_batch, 3)).astype(np.float32)
+    ref = np.asarray(es.predict(check_obs))
+
+    def leg(mb, conns):
+        port_file = os.path.join(workdir, f"port_{mb}.json")
+        argv = [sys.executable, "-m", "estorch_tpu.serve", "--bundle",
+                bundle, "--port", "0", "--port-file", port_file,
+                "--cpu-devices", "1", "--max-batch", str(mb),
+                "--beat-interval", "0.5"]
+        env = {**os.environ, "JAX_PLATFORMS": "cpu",
+               "ESTORCH_OBS_HEARTBEAT": os.path.join(workdir,
+                                                     f"hb_{mb}.json")}
+        proc = subprocess.Popen(argv, stdout=subprocess.PIPE, text=True,
+                                env=env)
+        try:
+            ready = json.loads(proc.stdout.readline())
+            addr = ready["url"]
+            # correctness pass first: every response must be bit-equal to
+            # the exporting run's es.predict rows (GEMM family — buckets
+            # are >= 2 whenever max_batch >= 2; the max_batch=1 leg is
+            # the GEMV family, equal to es.predict on single obs)
+            chk = run_load(addr, conns=4, total=len(check_obs),
+                           duration_s=30.0,
+                           obs_list=[o.tolist() for o in check_obs],
+                           collect_responses=True)
+            if mb == 1:
+                exact_ref = np.stack([
+                    np.asarray(es.predict(o)) for o in check_obs])
+            else:
+                exact_ref = ref
+            # a lost/non-200 check response is a FINDING (bit_exact
+            # False + its row listed), not a stage crash
+            acts = [r.get("action") if isinstance(r, dict) else None
+                    for r in chk["responses"]]
+            if any(a is None for a in acts):
+                bit_exact = False
+                mismatch_rows = [i for i, a in enumerate(acts)
+                                 if a is None]
+            else:
+                got = np.asarray(acts, np.float32)
+                bit_exact = got.tobytes() == exact_ref.tobytes()
+                mismatch_rows = [] if bit_exact else [
+                    i for i in range(len(check_obs))
+                    if got[i].tobytes() != exact_ref[i].tobytes()]
+            load = run_load(addr, conns=conns, duration_s=duration,
+                            obs=[0.1, 0.2, 0.3])
+            from estorch_tpu.serve.client import ServeClient
+
+            with ServeClient(addr) as c:
+                stats = c.stats()
+            proc.send_signal(signal.SIGTERM)
+            out, _ = proc.communicate(timeout=60)
+            final = json.loads(out.strip().splitlines()[-1])
+            return {
+                "rps": load["throughput_rps"],
+                "p50_ms": load["latency_ms"]["p50"],
+                "p99_ms": load["latency_ms"]["p99"],
+                "errors": load["errors"] + chk["errors"],
+                "shed": int(stats["shed_total"]),
+                "recompiles": int(stats["recompiles"]),
+                "n_buckets": len(stats["buckets"])
+                + len(stats.get("buckets_excluded", [])),
+                "buckets_excluded": stats.get("buckets_excluded", []),
+                "mean_batch": stats["mean_batch"],
+                "bit_exact": bit_exact,
+                **({"bit_mismatch_rows": mismatch_rows}
+                   if mismatch_rows else {}),
+                "drain_clean": bool(final.get("clean"))
+                and proc.returncode == 0,
+            }
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+
+    # the exported policy is large (hidden^2 params); the finally covers
+    # EVERYTHING from export on, or a failed run leaks 100+ MB in /tmp
+    import shutil
+
+    workdir = tempfile.mkdtemp(prefix="serve_bench_")
+    try:
+        bundle = es.export_bundle(os.path.join(workdir, "bundle"))
+        dyn = leg(max_batch, conns=int(cfg.get("conns", 32)))
+        b1 = leg(1, conns=8)
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+    ratio = (dyn["rps"] / b1["rps"]) if b1["rps"] else None
+    return {"hidden": hidden, "dyn": dyn, "b1": b1,
+            "ratio": round(ratio, 2) if ratio else None, "cfg": cfg}
+
+
+def stage_serve(selfcheck=False):
+    """Serving A/B via the stage protocol; the selfcheck form is the
+    run_lint.sh gate (functional: bit-exactness, clean drain, bucket
+    accounting — the ≥3x throughput win is gated by the full form and by
+    the tier-1 serving demo, which size the policy to be memory-bound).
+    Returns the process exit code."""
+    cfg = ({"hidden": 256, "gens": 1, "duration_s": 1.5, "conns": 16}
+           if selfcheck else
+           {"hidden": 4096, "gens": 1, "duration_s": 4.0, "conns": 32})
+    argv = [sys.executable, __file__, "--stage-serve-one", json.dumps(cfg)]
+    child_env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    try:
+        r = subprocess.run(argv, timeout=900, capture_output=True,
+                           text=True, env=child_env)
+    except subprocess.TimeoutExpired:
+        print(json.dumps({"label": "serve", "error": "timeout after 900s"}),
+              flush=True)
+        return 1
+    try:
+        last = [ln for ln in r.stdout.strip().splitlines()
+                if ln.startswith("{")][-1]
+        row = json.loads(last)
+    except (IndexError, ValueError):
+        print(json.dumps({"label": "serve", "error":
+                          f"stage exited {r.returncode}",
+                          "stderr_tail": r.stderr[-800:]}), flush=True)
+        return 1
+    dyn, b1 = row["dyn"], row["b1"]
+    functional = (
+        dyn["bit_exact"] and b1["bit_exact"]
+        and dyn["drain_clean"] and b1["drain_clean"]
+        and dyn["errors"] == 0 and b1["errors"] == 0
+        and dyn["shed"] == 0 and b1["shed"] == 0
+        and dyn["recompiles"] <= dyn["n_buckets"]
+        and b1["recompiles"] <= b1["n_buckets"]
+    )
+    ok = functional if selfcheck else (
+        functional and row["ratio"] is not None and row["ratio"] >= 3.0)
+    print(json.dumps({"label": "serve/ab", **row, "pass": ok}), flush=True)
+    return 0 if ok else 1
+
+
 class EvidenceLockBusy(Exception):
     """The evidence flock is held by another measurement/study process."""
 
@@ -758,6 +947,15 @@ if __name__ == "__main__":
     elif "--stage-chaos-one" in sys.argv:
         cfg = json.loads(sys.argv[sys.argv.index("--stage-chaos-one") + 1])
         print(json.dumps(measure_chaos_one(cfg)))
+    elif "--stage-serve-one" in sys.argv:
+        cfg = json.loads(sys.argv[sys.argv.index("--stage-serve-one") + 1])
+        print(json.dumps(measure_serve_one(cfg)))
+    elif "--serve" in sys.argv:
+        # the selfcheck form runs inside run_lint.sh (tiny policy, CPU,
+        # loopback only): skip the evidence lock a full measurement takes
+        if "--selfcheck" not in sys.argv:
+            _lock_or_warn()
+        sys.exit(stage_serve(selfcheck="--selfcheck" in sys.argv))
     elif "--chaos" in sys.argv:
         # the selfcheck form runs inside run_lint.sh (single tiny host
         # config, no device): skip the evidence lock a full measurement

@@ -419,6 +419,7 @@ class ES:
         self.compile_time_s: float | None = None
         self._eval_policy_fn = None  # lazily-built jitted eval rollout
         self._eval_gait_fn = None  # same, with the env-metrics channel
+        self._predict_fn = None  # lazily-built jitted serving-parity predict
 
     # --------------------------------------------------------- pooled backend
 
@@ -957,6 +958,14 @@ class ES:
 
         Recurrent policies return ``(out, new_carry)``; pass the returned
         carry back in on the next step (``carry=None`` starts an episode).
+
+        Runs through the SAME jitted program the serving stack builds
+        (serve/predictor.py) — normalization composed inside, params and
+        running obs stats as arguments — so an exported bundle's
+        ``predict`` and a server's batched responses are bit-comparable
+        to this method (docs/serving.md "Bit-exactness contract").
+        Batched ``obs`` (leading batch axis) is supported and lands in
+        the same execution family as the server's bucketed batches.
         """
         if self.backend == "host":
             import torch
@@ -965,12 +974,16 @@ class ES:
             with torch.no_grad():
                 return policy(torch.as_tensor(np.asarray(obs), dtype=torch.float32))
         p = self.best_policy if use_best else self.policy
-        if getattr(self, "_obs_norm", False):
-            from ..parallel.engine import normalize_obs
+        obs = jnp.asarray(obs)
+        stats = self.state.obs_stats if self._obs_norm else None
+        if self._predict_fn is None:
+            from ..serve.predictor import make_single_predict
 
-            obs = normalize_obs(jnp.asarray(obs), self.state.obs_stats,
-                                self._obs_clip)
-        if getattr(self, "_recurrent", False):
+            self._predict_fn = make_single_predict(
+                self._policy_apply, recurrent=self._recurrent,
+                obs_norm=self._obs_norm, obs_clip=self._obs_clip,
+            )
+        if self._recurrent:
             if carry is None:
                 # same compat contract as make_rollout: a custom module
                 # with the historical zero-arg carry_init() must work here
@@ -981,5 +994,20 @@ class ES:
                 if not hasattr(self, "_ci_takes_params"):
                     self._ci_takes_params = carry_init_takes_params(ci)
                 carry = ci(p) if self._ci_takes_params else ci()
-            return self._policy_apply(p, obs, carry)
-        return self._policy_apply(p, obs)
+            return self._predict_fn(p, stats, obs, carry)
+        return self._predict_fn(p, stats, obs)
+
+    # ---------------------------------------------------------------- serving
+
+    def export_bundle(self, path: str, use_best: bool = False,
+                      version: str | int | None = None,
+                      extra: dict | None = None, **kwargs) -> str:
+        """Export this policy as a versioned serving bundle (serve/bundle.py):
+        params + frozen stats + obs-normalization moments + a manifest
+        (module spec, git sha, jax version, provenance), committed
+        atomically.  Serve it with ``python -m estorch_tpu.serve --bundle
+        <path>`` (docs/serving.md)."""
+        from ..serve.bundle import export_bundle
+
+        return export_bundle(self, path, use_best=use_best, version=version,
+                             extra=extra, **kwargs)

@@ -350,8 +350,72 @@ def check_resilience(ckpt_root: str | None = None,
     return out
 
 
+def check_serve(bundle: str | None = None) -> dict:
+    """Serving readiness (estorch_tpu/serve, docs/serving.md):
+
+    - can this host bind a loopback listening socket (the server's one
+      OS-level requirement beyond python)?
+    - does the dynamic batcher round-trip requests (coalescing, bucket
+      padding, recompile accounting) — exercised with a plain-numpy
+      batch fn, so this check never touches jax or a device runtime;
+    - given ``bundle``: structural validation of the artifact (manifest
+      schema, payload checksum, param count) via
+      ``serve.bundle.validate_bundle`` — again without importing jax, so
+      a corrupt bundle is diagnosable from a wedged-runtime machine.
+    """
+    import socket
+
+    out: dict = {}
+    try:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        out["loopback"] = {"bindable": True, "probe_port": port}
+    except OSError as e:  # diagnostic tool: never crash the report
+        out["loopback"] = {"bindable": False, "error": repr(e)}
+
+    try:
+        import numpy as np
+
+        from .obs.spans import Telemetry
+        from .serve.batcher import DynamicBatcher
+
+        tel = Telemetry(enabled=True)
+        b = DynamicBatcher(lambda arr: arr * 2.0, (3,), max_batch=4,
+                           max_wait_ms=1.0, telemetry=tel)
+        got = b.predict([1.0, 2.0, 3.0], timeout=10.0)
+        b.close()
+        ok = np.allclose(got, [2.0, 4.0, 6.0])
+        out["batcher"] = {
+            "ok": bool(ok),
+            "recompiles": int(tel.counters.get("recompiles")),
+            "buckets": list(b.buckets),
+        }
+    except Exception as e:
+        out["batcher"] = {"ok": False, "error": repr(e)}
+
+    if bundle is not None:
+        from .serve.bundle import BundleError, validate_bundle
+
+        try:
+            man = validate_bundle(bundle)
+            out["bundle"] = {
+                "path": bundle, "valid": True,
+                "version": man["version"],
+                "param_dim": man["param_dim"],
+                "module": man["module"]["import"],
+                "obs_norm": bool(man.get("obs_norm")),
+                "recurrent": bool(man.get("recurrent")),
+            }
+        except (BundleError, OSError) as e:
+            out["bundle"] = {"path": bundle, "valid": False,
+                             "error": str(e)}
+    return out
+
+
 def report(timeout_s: float = 45.0, run_dir: str | None = None,
-           resilience_probe: bool = False) -> dict:
+           resilience_probe: bool = False,
+           serve_bundle: str | None = None) -> dict:
     dev = probe_device(timeout_s)
     rep = {
         "device": dev,
@@ -360,6 +424,7 @@ def report(timeout_s: float = 45.0, run_dir: str | None = None,
         "host": check_host(),
         "obs": check_obs(run_dir),
         "resilience": check_resilience(probe=resilience_probe),
+        "serve": check_serve(bundle=serve_bundle),
     }
     cpu_recipe = (
         "run on the virtual CPU mesh instead — jax.config.update("
@@ -393,9 +458,13 @@ def main(argv=None):
     p.add_argument("--resilience-probe", action="store_true",
                    help="also run the checkpoint save/restore round-trip "
                         "probe (a tiny ES in a timed-out subprocess)")
+    p.add_argument("--bundle", default=None, metavar="DIR",
+                   help="policy bundle to validate (manifest schema + "
+                        "payload checksum, no jax import)")
     args = p.parse_args(argv)
     rep = report(args.timeout, run_dir=args.run_dir,
-                 resilience_probe=args.resilience_probe)
+                 resilience_probe=args.resilience_probe,
+                 serve_bundle=args.bundle)
     print(json.dumps(rep, indent=2))
     return 0 if rep["device"]["status"] == "healthy" else 1
 

@@ -65,8 +65,15 @@ def main(argv: list[str] | None = None) -> int:
         return 0
 
     if not args.jsonl:
-        print("summarize needs a run JSONL (or --selfcheck)",
-              file=sys.stderr)
+        if args.heartbeat:
+            # serving processes have no generation JSONL — liveness +
+            # serving counters come from the heartbeat alone
+            s = summarize([], heartbeat_path=args.heartbeat)
+            print(json.dumps(s, default=float) if args.as_json
+                  else format_summary(s))
+            return 0
+        print("summarize needs a run JSONL (or --heartbeat PATH, or "
+              "--selfcheck)", file=sys.stderr)
         return 3
     try:
         records = load_records(args.jsonl)

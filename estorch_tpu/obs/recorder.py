@@ -28,6 +28,7 @@ from __future__ import annotations
 import collections
 import json
 import os
+import threading
 import time
 
 HEARTBEAT_ENV = "ESTORCH_OBS_HEARTBEAT"
@@ -68,10 +69,17 @@ class FlightRecorder:
 
 
 class Heartbeat:
-    """Atomic last-known-state file for external liveness monitoring."""
+    """Atomic last-known-state file for external liveness monitoring.
+
+    Thread-safe: the serving stack beats from two threads (the batcher's
+    phase entries and the idle-period beater), and both write through the
+    same ``.tmp`` staging file — unserialized, a reader could replace-in
+    a half-written payload and a watchdog would misread a healthy process
+    as corrupt/stale."""
 
     def __init__(self, path: str):
         self.path = os.path.abspath(path)
+        self._lock = threading.Lock()
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
 
     def beat(self, phase: str, generation: int,
@@ -85,9 +93,10 @@ class Heartbeat:
         if counters:
             payload["counters"] = counters
         tmp = self.path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(payload, f, default=float)
-        os.replace(tmp, self.path)
+        with self._lock:
+            with open(tmp, "w") as f:
+                json.dump(payload, f, default=float)
+            os.replace(tmp, self.path)
 
 
 def read_heartbeat(path: str) -> dict | None:

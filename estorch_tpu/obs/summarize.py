@@ -120,6 +120,49 @@ RESILIENCE_COUNTERS = (
     "chaos_worker_kills",
 )
 
+# serving counters (estorch_tpu/serve, docs/serving.md): present in a
+# policy server's heartbeat — `requests_total` is the marker that the
+# process being summarized serves traffic rather than training
+SERVE_COUNTERS = (
+    "requests_total",
+    "batches_total",
+    "batched_requests_total",
+    "shed_total",
+    "recompiles",
+    "batch_errors_total",
+    "reloads_total",
+)
+
+
+def _serving_block(counter_src: dict | None) -> tuple[dict | None, str | None]:
+    """(serving summary, diagnosis clause) from a counter snapshot, or
+    (None, None) when the counters aren't a policy server's."""
+    if not counter_src or not counter_src.get("requests_total"):
+        return None, None
+    c = {k: counter_src.get(k, 0) for k in SERVE_COUNTERS}
+    batches = c["batches_total"]
+    mean_batch = (round(c["batched_requests_total"] / batches, 2)
+                  if batches else None)
+    serving = {
+        "requests": int(c["requests_total"]),
+        "batches": int(batches),
+        "mean_batch": mean_batch,
+        "shed": int(c["shed_total"]),
+        "recompiles": int(c["recompiles"]),
+    }
+    if c["batch_errors_total"]:
+        serving["batch_errors"] = int(c["batch_errors_total"])
+    if c["reloads_total"]:
+        serving["reloads"] = int(c["reloads_total"])
+    clause = (f"serving: {serving['requests']} requests in "
+              f"{serving['batches']} batches"
+              + (f" (mean batch {mean_batch})" if mean_batch else ""))
+    if serving["shed"]:
+        clause += f", {serving['shed']} SHED — the server is saturated"
+    if serving.get("batch_errors"):
+        clause += f", {serving['batch_errors']} batch errors"
+    return serving, clause
+
 
 def _load_manifest_resilience(manifest_path: str | None) -> dict | None:
     """The run manifest's ``resilience`` section (supervisor-written
@@ -137,9 +180,32 @@ def _load_manifest_resilience(manifest_path: str | None) -> dict | None:
 
 def summarize(records: list[dict], heartbeat_path: str | None = None,
               manifest_path: str | None = None) -> dict:
-    """Aggregate a run's records into the summary dict the CLI prints."""
+    """Aggregate a run's records into the summary dict the CLI prints.
+
+    With no records but a heartbeat (a policy server has no generation
+    records), the summary is liveness + the serving counters — the
+    ``summarize --heartbeat <path>`` form for serving processes."""
     if not records:
-        return {"generations": 0, "diagnosis": "no records"}
+        out: dict = {"generations": 0}
+        diagnosis = []
+        hb = read_heartbeat(heartbeat_path) if heartbeat_path else None
+        if hb is not None:
+            out["heartbeat"] = hb
+            state = (f"last phase={hb.get('phase')} beat "
+                     f"{hb['age_s']:.0f}s ago")
+            if hb.get("phase") == "drained":
+                diagnosis.append(f"server drained cleanly; {state}")
+            elif hb["age_s"] > STALE_AFTER_S:
+                diagnosis.append(f"STALE heartbeat: {state} — the process "
+                                 "is wedged or dead")
+            else:
+                diagnosis.append(f"heartbeat fresh: {state}")
+            serving, clause = _serving_block(hb.get("counters"))
+            if serving is not None:
+                out["serving"] = serving
+                diagnosis.append(clause)
+        out["diagnosis"] = "; ".join(diagnosis) or "no records"
+        return out
     # supervisor-replayed generations (the gap between the last checkpoint
     # and a crash) appear twice in an append-only run JSONL — keep the
     # LAST occurrence per generation (the replay that actually counted)
@@ -251,6 +317,9 @@ def summarize(records: list[dict], heartbeat_path: str | None = None,
         hits = [f"{int(counters[k])} {k}" for k in counters]
         if hits:
             diagnosis.append("resilience: " + ", ".join(hits))
+    serving, serve_clause = _serving_block(counter_src)
+    if serve_clause:
+        diagnosis.append(serve_clause)
     restarts = None
     if manifest_res is not None:
         n_restarts = int(manifest_res.get("restart_count", 0))
@@ -292,14 +361,31 @@ def summarize(records: list[dict], heartbeat_path: str | None = None,
         out["heartbeat"] = hb
     if counters:
         out["counters"] = counters
+    if serving is not None:
+        out["serving"] = serving
     if restarts is not None:
         out["restarts"] = restarts
     return out
 
 
+def _format_serving(s: dict) -> list[str]:
+    sv = s.get("serving")
+    if not sv:
+        return []
+    line = (f"serving          {sv['requests']:,} requests  "
+            f"{sv['batches']:,} batches")
+    if sv.get("mean_batch"):
+        line += f"  mean batch {sv['mean_batch']}"
+    line += f"  shed={sv['shed']}  recompiles={sv['recompiles']}"
+    return [line]
+
+
 def format_summary(s: dict) -> str:
     """Human rendering of :func:`summarize`'s dict."""
     if not s.get("generations"):
+        if s.get("serving") or s.get("heartbeat"):
+            return "\n".join(_format_serving(s)
+                             + [f"diagnosis        {s['diagnosis']}"])
         return "no records"
     lines = [
         f"generations      {s['generations']}",
@@ -328,6 +414,7 @@ def format_summary(s: dict) -> str:
     if s.get("counters"):
         lines.append("resilience       " + "  ".join(
             f"{k}={int(v)}" for k, v in s["counters"].items()))
+    lines.extend(_format_serving(s))
     if s.get("restarts") and s["restarts"]["count"]:
         lines.append(f"restarts         {s['restarts']['count']} "
                      f"(completed={s['restarts']['completed']})")
@@ -410,4 +497,32 @@ def selfcheck() -> list[str]:
         sh = summarize(recs, heartbeat_path=hb_path)
         if sh.get("counters", {}).get("workers_respawned") != 1:
             problems.append("heartbeat counters not surfaced sans manifest")
+
+        # serving process: no generation records, counters in the
+        # heartbeat (estorch_tpu/serve writes exactly this shape) — the
+        # summarize --heartbeat form must surface the serving section
+        serve_hb = os.path.join(d, "serve_heartbeat.json")
+        with open(serve_hb, "w") as f:
+            json.dump({"ts": _time.time(), "pid": 2, "phase": "serving",
+                       "generation": 0,
+                       "counters": {"requests_total": 640,
+                                    "batches_total": 40,
+                                    "batched_requests_total": 640,
+                                    "shed_total": 3,
+                                    "recompiles": 5}}, f)
+        ss = summarize([], heartbeat_path=serve_hb)
+        sv = ss.get("serving")
+        if not sv or sv.get("requests") != 640 or sv.get("mean_batch") != 16:
+            problems.append("serving counters not aggregated from a "
+                            "server heartbeat")
+        if "serving" not in ss.get("diagnosis", ""):
+            problems.append("diagnosis missed the serving section")
+        if "SHED" not in ss["diagnosis"]:
+            problems.append("diagnosis missed serving shed (saturation)")
+        if "serving" not in format_summary(ss):
+            problems.append("format_summary dropped the serving block")
+        # a TRAINING run's summary must not grow a serving section just
+        # because resilience counters exist
+        if summarize(recs, heartbeat_path=hb_path).get("serving"):
+            problems.append("non-serving run grew a serving section")
     return problems

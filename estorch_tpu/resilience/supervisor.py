@@ -153,6 +153,15 @@ def _resolve_factory(es_factory):
     return es_factory
 
 
+def _generic_child_main(child_spec, child_args: tuple, root: str) -> None:
+    """Child body for a generic supervised process (``child_target``):
+    point the heartbeat into the supervision root, resolve the target in
+    the CHILD (spec strings avoid pickling), run it.  The target owns its
+    own platform policy — this runs in a spawned, fresh interpreter."""
+    os.environ[HEARTBEAT_ENV] = os.path.join(root, "heartbeat.json")
+    _resolve_factory(child_spec)(root, *child_args)
+
+
 def _child_main(es_factory, root: str, target_generation: int, every: int,
                 n_proc: int, verbose: bool) -> None:
     """Runs in the spawned child: build → resume from latest checkpoint →
@@ -215,9 +224,9 @@ class Supervisor:
 
     def __init__(
         self,
-        es_factory,
-        ckpt_root: str,
-        target_generation: int,
+        es_factory=None,
+        ckpt_root: str = "",
+        target_generation: int = 0,
         *,
         every: int = 5,
         n_proc: int = 1,
@@ -228,8 +237,19 @@ class Supervisor:
         startup_grace_s: float = 120.0,
         poll_s: float = 0.5,
         verbose: bool = False,
+        child_target=None,
+        child_args: tuple = (),
     ):
+        if (es_factory is None) == (child_target is None):
+            raise ValueError(
+                "pass exactly one of es_factory (training child) or "
+                "child_target (generic supervised child)"
+            )
+        if not ckpt_root:
+            raise ValueError("ckpt_root is required")
         self.es_factory = es_factory
+        self.child_target = child_target
+        self.child_args = tuple(child_args)
         self.ckpt_root = os.path.abspath(ckpt_root)
         self.target_generation = int(target_generation)
         self.every = int(every)
@@ -243,6 +263,9 @@ class Supervisor:
         self.verbose = bool(verbose)
         self.restarts: list[dict] = []
         self._counters_total: dict[str, float] = {}
+        self._child = None
+        self._stop_requested = False
+        self._stop_signaled = False
         os.makedirs(self.ckpt_root, exist_ok=True)
 
     # ------------------------------------------------------------- paths
@@ -265,23 +288,51 @@ class Supervisor:
     def run(self) -> dict:
         """Drive the run to completion; returns
         ``{"ok", "restarts", "checkpoint", "reason"}``."""
+        import signal as _signal
+
         ctx = mp.get_context("spawn")
         attempt = 0
         ok = False
         reason = None
         while True:
+            if self._stop_requested:
+                # stop arrived during backoff: don't spawn a child only
+                # to terminate it immediately
+                ok = True
+                break
             started = time.time()
-            child = ctx.Process(
-                target=_child_main,
-                args=(self.es_factory, self.ckpt_root,
-                      self.target_generation, self.every, self.n_proc,
-                      self.verbose),
-            )
+            if self.child_target is not None:
+                child = ctx.Process(
+                    target=_generic_child_main,
+                    args=(self.child_target, self.child_args,
+                          self.ckpt_root),
+                )
+            else:
+                child = ctx.Process(
+                    target=_child_main,
+                    args=(self.es_factory, self.ckpt_root,
+                          self.target_generation, self.every, self.n_proc,
+                          self.verbose),
+                )
             child.start()
+            self._child = child
             failure = self._watch(child, started)
             self._accumulate_counters(started)
             if failure is None:
                 ok = True
+                break
+            if self._stop_requested:
+                # an operator stop is completion, not a crash to restart.
+                # Clean when the child honored the forwarded SIGTERM
+                # (exit 0 after drain) OR died BY that SIGTERM's default
+                # disposition — a stop during startup lands before the
+                # child installs its handler (several seconds of
+                # jax import / bundle load), and that is still a normal
+                # operator stop, not a crash to report
+                ok = child.exitcode == 0 or (
+                    self._stop_signaled
+                    and child.exitcode == -int(_signal.SIGTERM))
+                reason = None if ok else failure
                 break
             self.restarts.append({
                 "ts": time.time(),
@@ -306,6 +357,23 @@ class Supervisor:
             "reason": reason,
         }
 
+    def request_stop(self, signum: int | None = None) -> None:
+        """Operator stop (signal-handler safe): forward SIGTERM to the
+        running child so it can drain, and stop restarting.  The serving
+        stack routes its own SIGTERM here (serve/server.py).
+
+        The forward is sent EXACTLY ONCE (handlers and ``_watch`` both
+        run on the main thread, so the flag needs no lock): a second
+        SIGTERM can land after the child's drain, during interpreter
+        finalization when its handler is already torn down — killing a
+        cleanly-drained child with the default disposition (-15)."""
+        del signum
+        self._stop_requested = True
+        child = self._child
+        if child is not None and child.is_alive() and not self._stop_signaled:
+            self._stop_signaled = True
+            child.terminate()  # SIGTERM — graceful drain, not kill
+
     def _watch(self, child, started: float) -> str | None:
         """Block until the child exits or is killed for staleness.
         Returns None on clean (exit 0) completion, else a reason string."""
@@ -316,6 +384,11 @@ class Supervisor:
                     return None
                 return (f"child died with exit code {child.exitcode}"
                         + (" (signal)" if child.exitcode < 0 else ""))
+            if self._stop_requested and not self._stop_signaled:
+                # stop raced past request_stop's terminate (child was
+                # between start() and _child assignment): forward it here
+                self._stop_signaled = True
+                child.terminate()
             hb = read_heartbeat(self.heartbeat_path)
             if hb is not None and float(hb.get("ts", 0.0)) >= started:
                 # this child has beaten at least once: staleness watchdog

@@ -1,0 +1,52 @@
+"""estorch_tpu.serve — versioned policy bundles + dynamic-batching
+inference server (docs/serving.md).
+
+The serving vertical: export a trained policy into a self-describing
+bundle (serve/bundle.py), serve it behind a dynamic micro-batcher
+(serve/batcher.py, serve/server.py), drive it (serve/client.py,
+serve/loadgen.py).
+
+Heavy submodules (bundle/predictor/server pull jax+flax) load lazily via
+PEP 562 so light consumers — doctor's serve checks, the loadgen smoke —
+can import this package without paying for, or wedging on, a device
+runtime.
+"""
+
+from __future__ import annotations
+
+from .batcher import (BatchError, BatcherClosed, BatcherSaturated,
+                      DynamicBatcher, bucket_sizes)
+from .client import ServeClient, ServeError
+
+_LAZY = {
+    "Bundle": "bundle",
+    "BundleError": "bundle",
+    "export_bundle": "bundle",
+    "load_bundle": "bundle",
+    "validate_bundle": "bundle",
+    "make_single_predict": "predictor",
+    "make_batched_predict": "predictor",
+    "PolicyServer": "server",
+    "find_free_port": "server",
+    "run_load": "loadgen",
+}
+
+__all__ = [
+    "BatchError",
+    "BatcherClosed",
+    "BatcherSaturated",
+    "DynamicBatcher",
+    "bucket_sizes",
+    "ServeClient",
+    "ServeError",
+    *sorted(_LAZY),
+]
+
+
+def __getattr__(name: str):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(f".{mod}", __name__), name)

@@ -1,0 +1,95 @@
+"""serve CLI: ``python -m estorch_tpu.serve --bundle <dir>``.
+
+Platform policy happens HERE, before any jax-importing module loads:
+``--cpu-devices N`` pins the CPU backend with N virtual devices — serve
+on the same host compute configuration as the exporting run and the
+bit-exactness contract holds across the process boundary
+(docs/serving.md).
+
+``--supervised`` wraps the server in the PR-3 watchdog
+(resilience/supervisor.py): heartbeat-staleness + exit-status restarts
+with exponential backoff; SIGTERM to the supervisor forwards to the
+child, which drains and exits cleanly.
+
+Exit codes: 0 clean drain; 1 drain left work behind / supervision gave
+up; 2 bad bundle or arguments.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m estorch_tpu.serve",
+        description="serve a policy bundle over HTTP (docs/serving.md)")
+    p.add_argument("--bundle", required=True, metavar="DIR",
+                   help="bundle directory written by export_bundle")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8321,
+                   help="0 picks an ephemeral port (see --port-file)")
+    p.add_argument("--max-batch", type=int, default=32,
+                   help="bucket ladder top (power of two); 1 = the "
+                        "batch-size-1 baseline")
+    p.add_argument("--max-wait-ms", type=float, default=4.0,
+                   help="batching window from the first queued request")
+    p.add_argument("--max-queue", type=int, default=256,
+                   help="admission-control queue bound (full => 503)")
+    p.add_argument("--cpu-devices", type=int, default=0, metavar="N",
+                   help="force the CPU backend with N virtual devices "
+                        "BEFORE jax init — match the exporting run for "
+                        "cross-process bit-parity (0 = leave platform "
+                        "alone)")
+    p.add_argument("--warm", action="store_true",
+                   help="pre-compile every bucket before READY (flat "
+                        "first-request latency; counts toward the "
+                        "recompiles counter exactly like lazy compiles)")
+    p.add_argument("--port-file", default=None, metavar="PATH",
+                   help="atomically write {host,port,pid} JSON once bound")
+    p.add_argument("--beat-interval", type=float, default=2.0,
+                   help="idle heartbeat period (ESTORCH_OBS_HEARTBEAT)")
+    p.add_argument("--supervised", action="store_true",
+                   help="run under the resilience watchdog (heartbeat "
+                        "staleness + crash restarts)")
+    p.add_argument("--supervise-root", default="serve_run", metavar="DIR",
+                   help="supervision state dir (heartbeat, manifest)")
+    p.add_argument("--max-restarts", type=int, default=5)
+    p.add_argument("--stale-after-s", type=float, default=30.0)
+    p.add_argument("--startup-grace-s", type=float, default=120.0)
+    return p
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    args = build_parser().parse_args(argv)
+    # config validation BEFORE anything heavy (and before --supervised
+    # forks): a bad --max-batch must be exit 2 with one line, not a
+    # traceback — or worse, a supervised child crash-looping through
+    # max_restarts on a typo
+    from .batcher import bucket_sizes
+
+    try:
+        bucket_sizes(args.max_batch)
+    except ValueError as e:
+        print(f"serve: {e}", file=sys.stderr)
+        return 2
+    if args.cpu_devices > 0:
+        from ..utils import force_cpu_backend
+
+        force_cpu_backend(args.cpu_devices)
+    from .bundle import BundleError
+    from .server import run_server, run_supervised
+
+    try:
+        if args.supervised:
+            return run_supervised(args, argv)
+        return run_server(args)
+    except BundleError as e:
+        print(f"serve: {e}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

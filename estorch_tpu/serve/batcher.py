@@ -1,0 +1,433 @@
+"""Dynamic micro-batcher: coalesce concurrent predict requests into
+power-of-two buckets for one jitted batched forward per bucket shape.
+
+The training insight applied to serving (PAPERS.md 2206.08888): the same
+vectorized batched inference that evaluates a population evaluates
+concurrent user requests — one weight-streaming GEMM amortizes the
+memory traffic that dominates per-request GEMV on a CPU/TPU host.
+
+Mechanics:
+
+* a bounded queue feeds ONE worker thread; the worker takes the oldest
+  request, then coalesces more until ``max_batch`` or ``max_wait_ms``
+  from the first request, whichever comes first;
+* the batch is padded to the next power-of-two bucket so the jitted
+  predict compiles once per bucket — ``recompiles`` stays ≤ the number
+  of ladder shapes no matter how request sizes mix;
+* buckets start at 2 (when ``max_batch`` ≥ 2): batch-1 lowers to a GEMV
+  whose final bits differ from the GEMM family, and a response's bits
+  must not depend on how many neighbors a request was coalesced with
+  (docs/serving.md "Bit-exactness contract").  Cross-shape row
+  stability is MEASURED per loaded policy, not assumed — buckets whose
+  rows deviate from the anchor (largest) bucket are excluded from the
+  ladder at construction (:func:`verify_stable_buckets`);
+* admission control: a full queue SHEDS (``BatcherSaturated`` →
+  HTTP 503 + ``shed_total``) instead of growing without bound — graceful
+  backpressure, not OOM;
+* ``close(drain=True)`` stops intake, finishes every queued request, and
+  joins the worker — the SIGTERM drain path.
+
+Deliberately jax-free: ``batch_fn`` is any ``(B, *obs_shape) ndarray →
+(B, ...) ndarray`` callable (``Bundle.batched_predict_fn()`` in
+production, plain numpy in doctor's smoke test).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Callable, Sequence
+
+import numpy as np
+
+from ..obs.spans import NULL_TELEMETRY
+
+
+class BatcherClosed(RuntimeError):
+    """submit() after close() — the server is draining."""
+
+
+class BatcherSaturated(RuntimeError):
+    """Queue full: request shed for backpressure (serve as HTTP 503)."""
+
+
+class BatchError(RuntimeError):
+    """The batched predict callable itself failed — a SERVER-side fault
+    (device runtime error, poisoned params), distinct from the
+    ValueError a caller's malformed observation raises at submit time.
+    The server maps this to HTTP 500, never 400."""
+
+
+class _Pending:
+    """One in-flight request: the caller blocks on ``event``."""
+
+    __slots__ = ("obs", "event", "result", "error")
+
+    def __init__(self, obs: np.ndarray):
+        self.obs = obs
+        self.event = threading.Event()
+        self.result = None
+        self.error: BaseException | None = None
+
+
+def bucket_sizes(max_batch: int) -> tuple[int, ...]:
+    """The power-of-two bucket ladder for ``max_batch``.
+
+    ``max_batch=1`` → ``(1,)`` (the batch-size-1 baseline); otherwise
+    buckets start at 2 (GEMM family, see module docstring) and double up
+    to ``max_batch`` (which must then itself be a power of two ≥ 2).
+    """
+    if max_batch < 1:
+        raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+    if max_batch == 1:
+        return (1,)
+    if max_batch & (max_batch - 1):
+        raise ValueError(
+            f"max_batch must be a power of two (bucket ladder), got "
+            f"{max_batch}"
+        )
+    out = []
+    b = 2
+    while b <= max_batch:
+        out.append(b)
+        b *= 2
+    return tuple(out)
+
+
+def verify_stable_buckets(
+    batch_fn: Callable[[np.ndarray], np.ndarray],
+    obs_shape: Sequence[int],
+    buckets: Sequence[int],
+    *,
+    trials: int = 3,
+    seed: int = 0,
+) -> tuple[tuple[int, ...], tuple[int, ...]]:
+    """Partition the bucket ladder into (stable, excluded) by MEASUREMENT.
+
+    The serving bit-determinism contract — a request's bits must not
+    depend on which bucket/neighbors it was coalesced with — rests on
+    XLA producing row-identical results across batch shapes.  That holds
+    for the GEMM family at most sizes but is NOT guaranteed: measured on
+    CPU, the B=2 lowering can differ from B≥4 by 1 ulp for some trained
+    parameter values.  So the contract is VERIFIED per loaded bundle
+    instead of assumed: every bucket's rows are checked (random obs,
+    random slot arrangements, real pad rows) against the largest bucket
+    — the anchor — and buckets that fail are excluded from the ladder
+    (their requests pad up to the next stable size).  The anchor itself
+    is checked for slot-independence; if even that fails, serving cannot
+    be made deterministic under coalescing and this raises.
+    """
+    buckets = sorted(set(int(b) for b in buckets))
+    anchor = buckets[-1]
+    rng = np.random.default_rng(seed)
+    shape = tuple(int(d) for d in obs_shape)
+    obs = rng.standard_normal((anchor,) + shape).astype(np.float32)
+    ref = np.asarray(batch_fn(obs), np.float32)
+    # anchor slot-independence: the same rows, shuffled, must yield the
+    # same per-row bits
+    for _ in range(trials):
+        perm = rng.permutation(anchor)
+        out = np.asarray(batch_fn(obs[perm]), np.float32)
+        if out.tobytes() != ref[perm].tobytes():
+            raise ValueError(
+                f"batched predict is slot-dependent at anchor batch "
+                f"{anchor}: the same observation yields different bits in "
+                "different slots — deterministic coalesced serving is "
+                "impossible with this program"
+            )
+    stable, excluded = [], []
+    for b in buckets[:-1]:
+        ok = True
+        for _ in range(trials):
+            idx = rng.choice(anchor, size=b, replace=False)
+            out = np.asarray(batch_fn(obs[idx]), np.float32)
+            if out.tobytes() != ref[idx].tobytes():
+                ok = False
+                break
+            # half-full composition: real rows + zero padding
+            n = max(1, b // 2)
+            idx2 = rng.choice(anchor, size=n, replace=False)
+            pad = np.zeros((b,) + shape, np.float32)
+            pad[:n] = obs[idx2]
+            out2 = np.asarray(batch_fn(pad), np.float32)[:n]
+            if out2.tobytes() != ref[idx2].tobytes():
+                ok = False
+                break
+        (stable if ok else excluded).append(b)
+    stable.append(anchor)
+    return tuple(stable), tuple(excluded)
+
+
+class DynamicBatcher:
+    """Bounded-queue request coalescer over a batched predict callable."""
+
+    def __init__(
+        self,
+        batch_fn: Callable[[np.ndarray], np.ndarray],
+        obs_shape: Sequence[int],
+        *,
+        max_batch: int = 32,
+        max_wait_ms: float = 4.0,
+        max_queue: int = 256,
+        telemetry=None,
+        verify: bool = True,
+    ):
+        self.batch_fn = batch_fn
+        self.obs_shape = tuple(int(d) for d in obs_shape)
+        self.max_batch = int(max_batch)
+        self.max_wait_s = float(max_wait_ms) / 1e3
+        self.obs = telemetry if telemetry is not None else NULL_TELEMETRY
+        ladder = bucket_sizes(self.max_batch)
+        self.buckets_excluded: tuple[int, ...] = ()
+        # verification applies to every coalescing ladder (anchor ≥ 2):
+        # even a single-bucket ladder of 2 must prove slot-independence —
+        # only the batch-1 baseline has nothing to coalesce
+        if verify and ladder[-1] >= 2:
+            # measured bit-consistency gate (see verify_stable_buckets);
+            # the verification forwards also pre-compile every kept bucket,
+            # so they count toward `recompiles` exactly once here
+            stable, excluded = verify_stable_buckets(
+                batch_fn, self.obs_shape, ladder)
+            self.buckets = stable
+            self.buckets_excluded = excluded
+            for b in excluded:
+                self.obs.counters.inc("buckets_excluded")
+                self.obs.event("bucket_excluded", bucket=b)
+        else:
+            self.buckets = ladder
+        self._q: queue.Queue[_Pending | None] = queue.Queue(
+            maxsize=int(max_queue))
+        self._closing = False
+        # serializes the closing-flag check against close(): without it a
+        # submit() preempted between check and enqueue could land in the
+        # queue after close()'s final sweep and block its caller for the
+        # whole request timeout (reachable via hot reload)
+        self._close_lock = threading.Lock()
+        self._buckets_seen: set[int] = set()
+        if verify and ladder[-1] >= 2:
+            # verification dispatched every ladder shape once — those ARE
+            # the compiles; honest accounting means recompiles == ladder
+            # length already, and dispatch never adds more
+            for b in ladder:
+                self._buckets_seen.add(b)
+                self.obs.counters.inc("recompiles")
+        self._worker = threading.Thread(
+            target=self._run, name="batcher", daemon=True)
+        self._worker.start()
+
+    # ---------------------------------------------------------- intake
+
+    def submit(self, obs) -> _Pending:
+        """Enqueue one observation; returns the pending slot to wait on.
+        Sheds (:class:`BatcherSaturated`) when the queue is full."""
+        if self._closing:
+            raise BatcherClosed("batcher is draining — no new requests")
+        arr = np.asarray(obs, np.float32)
+        if arr.shape != self.obs_shape:
+            raise ValueError(
+                f"observation shape {arr.shape} != bundle obs_shape "
+                f"{self.obs_shape}"
+            )
+        item = _Pending(arr)
+        self.obs.counters.inc("requests_total")
+        with self._close_lock:
+            if self._closing:
+                raise BatcherClosed("batcher is draining — no new requests")
+            try:
+                self._q.put_nowait(item)
+            except queue.Full:
+                self.obs.counters.inc("shed_total")
+                self.obs.event("request_shed", queue_depth=self._q.qsize())
+                raise BatcherSaturated(
+                    f"request queue full ({self._q.maxsize}) — shedding "
+                    "for backpressure"
+                ) from None
+        return item
+
+    def predict(self, obs, timeout: float | None = 30.0) -> np.ndarray:
+        """submit + wait; raises the batch's error or TimeoutError."""
+        item = self.submit(obs)
+        if not item.event.wait(timeout):
+            raise TimeoutError(f"no batch result within {timeout}s")
+        if item.error is not None:
+            raise item.error
+        return item.result
+
+    # ---------------------------------------------------------- worker
+
+    def _bucket(self, n: int) -> int:
+        # walk the STABLE ladder, not powers of two: an excluded interior
+        # shape (e.g. B=4 failed verification) must be padded PAST, never
+        # dispatched to — n ≤ max_batch = buckets[-1], so this always hits
+        for b in self.buckets:
+            if b >= n:
+                return b
+        return self.buckets[-1]
+
+    def _run(self) -> None:
+        while True:
+            try:
+                item = self._q.get(timeout=0.05)
+            except queue.Empty:
+                if self._closing:
+                    return
+                continue
+            if item is None:
+                self._drain_remaining()
+                return
+            batch = [item]
+            deadline = time.perf_counter() + self.max_wait_s
+            stop = False
+            while len(batch) < self.max_batch:
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
+                try:
+                    nxt = self._q.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    stop = True
+                    break
+                batch.append(nxt)
+            self._dispatch(batch)
+            if stop:
+                self._drain_remaining()
+                return
+
+    def _drain_remaining(self) -> None:
+        """Service requests that slipped in BEHIND the close sentinel: a
+        submit() racing close() can pass the ``_closing`` check and land
+        after the None in the FIFO — returning at the sentinel would
+        leave that caller blocked for its whole request timeout (the hot
+        reload path closes a batcher that is still taking traffic)."""
+        batch: list[_Pending] = []
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if item is None:
+                continue
+            batch.append(item)
+            if len(batch) >= self.max_batch:
+                self._dispatch(batch)
+                batch = []
+        if batch:
+            self._dispatch(batch)
+
+    def _dispatch(self, batch: list[_Pending]) -> None:
+        obs = self.obs
+        n = len(batch)
+        bucket = self._bucket(n)
+        if bucket not in self._buckets_seen:
+            # one XLA compile per bucket shape — this counter staying
+            # ≤ len(self.buckets) under mixed load is the test contract
+            self._buckets_seen.add(bucket)
+            obs.counters.inc("recompiles")
+            obs.event("bucket_compile", bucket=bucket)
+        arr = np.zeros((bucket,) + self.obs_shape, np.float32)
+        for i, item in enumerate(batch):
+            arr[i] = item.obs
+        obs.counters.gauge("queue_depth", self._q.qsize())
+        obs.counters.gauge("batch_size_last", n)
+        obs.counters.gauge("bucket_last", bucket)
+        # thread-safe primitives only (note/counters): during a hot
+        # reload the OLD batcher drains while the NEW one serves, and two
+        # workers sharing the Telemetry would corrupt its span stack —
+        # obs.phase is single-writer machinery.  The heartbeat still
+        # shows "predict" as the last phase under load, and the timing
+        # lands in counters (which is all the serving summary reads).
+        obs.note("predict")
+        t_predict = time.perf_counter()
+        try:
+            out = self.batch_fn(arr)
+            err = None
+        except Exception as e:  # noqa: BLE001 — propagated to every waiter
+            # typed so the server can answer 500 (server fault), never
+            # mistake it for a caller's 400-grade ValueError
+            err = BatchError(f"batched predict failed: {e!r}")
+            err.__cause__ = e
+            obs.counters.inc("batch_errors_total")
+            obs.event("batch_error", error=repr(e)[:200])
+        dt = time.perf_counter() - t_predict
+        obs.counters.inc("predict_time_s_total", dt)
+        obs.counters.gauge("batch_predict_ms_last", round(dt * 1e3, 3))
+        obs.counters.inc("batches_total")
+        obs.counters.inc("batched_requests_total", n)
+        if err is None:
+            # own the results before crossing threads: np.asarray on a jax
+            # output is a ZERO-COPY view of the XLA buffer, and waiter
+            # threads read it milliseconds later — after the worker has
+            # dispatched more batches into the same allocator.  Observed
+            # (1-ulp flaky rows under load) before this copy; the copy is
+            # (bucket, action_dim) floats, noise next to the forward pass.
+            out = np.array(out, np.float32, copy=True)
+        for i, item in enumerate(batch):
+            if err is None:
+                item.result = out[i]
+            else:
+                item.error = err
+            item.event.set()
+
+    # ----------------------------------------------------------- drain
+
+    def close(self, drain: bool = True, timeout: float = 30.0) -> None:
+        """Stop intake; with ``drain`` finish every queued request, then
+        join the worker.  Without ``drain`` pending requests get
+        :class:`BatcherClosed` set as their error."""
+        with self._close_lock:
+            if self._closing:
+                already = True
+            else:
+                already = False
+                self._closing = True
+        if already:
+            self._worker.join(timeout)
+            return
+        if not drain:
+            # fail queued waiters fast instead of leaving them blocked
+            while True:
+                try:
+                    item = self._q.get_nowait()
+                except queue.Empty:
+                    break
+                if item is not None:
+                    item.error = BatcherClosed("batcher closed without drain")
+                    item.event.set()
+        try:
+            self._q.put_nowait(None)  # wake + stop the worker
+        except queue.Full:
+            pass  # worker is draining a full queue; the _closing flag stops it
+        self._worker.join(timeout)
+        # a submit() that raced close() may have enqueued after the worker
+        # exited — fail those waiters loudly instead of leaving them to
+        # time out against a dead queue
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if item is not None:
+                item.error = BatcherClosed("batcher closed mid-submit")
+                item.event.set()
+
+    # ----------------------------------------------------------- stats
+
+    def stats(self) -> dict:
+        c = self.obs.counters
+        batches = c.get("batches_total")
+        served = c.get("batched_requests_total")
+        return {
+            "queue_depth": self._q.qsize(),
+            "max_batch": self.max_batch,
+            "buckets": list(self.buckets),
+            "buckets_excluded": list(self.buckets_excluded),
+            "buckets_compiled": sorted(self._buckets_seen),
+            "requests_total": int(c.get("requests_total")),
+            "batches_total": int(batches),
+            "shed_total": int(c.get("shed_total")),
+            "recompiles": int(c.get("recompiles")),
+            "mean_batch": round(served / batches, 3) if batches else None,
+        }

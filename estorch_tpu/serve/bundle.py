@@ -1,0 +1,504 @@
+"""Versioned policy bundles — the deployable artifact of a training run.
+
+A bundle is a self-describing directory that carries everything needed to
+serve a trained policy in a FRESH process, with a bit-exactness contract:
+``Bundle.predict(obs)`` equals the exporting run's ``ES.predict(obs)``
+(same host compute configuration; docs/serving.md).  Contents:
+
+- ``arrays.npz``   — params_flat (the center or best-member vector),
+                     every frozen collection's leaves (VBN reference
+                     stats, …), and the running obs-normalization triple
+                     when the run trained with ``obs_norm``;
+- ``MANIFEST.json``— schema + bundle version, the module import spec
+                     (``"pkg.mod:Class"`` + JSON kwargs) that rebuilds
+                     the flax policy, obs shape, provenance (algorithm,
+                     backend, generation, best reward), the runtime
+                     facts a regression hunt needs (git sha, jax/numpy
+                     versions — reusing obs/manifest.py), and the
+                     sha256 of ``arrays.npz``.
+
+Write protocol (the checkpoint lesson, utils/checkpoint.py): payload
+first, ``MANIFEST.json`` LAST via atomic rename — the manifest IS the
+commit point.  A crash at any earlier moment leaves a directory
+``load_bundle`` rejects as uncommitted, never a loadable-looking bundle
+with a half-written payload.  Re-exporting over an existing bundle
+deletes the manifest first (decommit) for the same reason.
+
+Host-backend (torch) policies are not bundleable — torch has its own
+serialization story and the serving stack is JAX-native; ``export_bundle``
+says so instead of writing an artifact the server cannot run.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import importlib
+import json
+import os
+import time
+from typing import Any
+
+import numpy as np
+
+BUNDLE_SCHEMA = 1
+MANIFEST_NAME = "MANIFEST.json"
+ARRAYS_NAME = "arrays.npz"
+
+
+class BundleError(ValueError):
+    """Malformed, corrupt, or incompatible bundle."""
+
+
+# --------------------------------------------------------------------- util
+
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _resolve_import(spec: str):
+    """``"pkg.mod:attr"`` → the attribute (class/function)."""
+    mod, _, attr = spec.partition(":")
+    if not attr:
+        raise BundleError(f"import spec {spec!r} must be 'module:attr'")
+    try:
+        obj = importlib.import_module(mod)
+    except ImportError as e:
+        raise BundleError(
+            f"bundle module {spec!r} is not importable in this process: {e}"
+        ) from e
+    for part in attr.split("."):
+        obj = getattr(obj, part)
+    return obj
+
+
+def _import_path(obj) -> str:
+    mod = getattr(obj, "__module__", None)
+    qual = getattr(obj, "__qualname__", None)
+    if not mod or not qual or "<locals>" in qual:
+        raise BundleError(
+            f"{obj!r} has no stable import path — bundles must reference "
+            "module-level classes/functions so a fresh serving process can "
+            "import them"
+        )
+    if mod == "__main__":
+        raise BundleError(
+            f"{obj!r} is defined in __main__ — move it to an importable "
+            "module (the serving process cannot import your script's "
+            "__main__) or pass module_import/module_kwargs explicitly"
+        )
+    return f"{mod}:{qual}"
+
+
+_JSON_SCALARS = (bool, int, float, str, type(None))
+
+
+def _encode_field(name: str, v):
+    """A module dataclass field value → JSON, or raise with guidance."""
+    if isinstance(v, _JSON_SCALARS):
+        return v
+    if isinstance(v, (tuple, list)):
+        out = []
+        for x in v:
+            if not isinstance(x, _JSON_SCALARS):
+                raise BundleError(
+                    f"module field {name!r} contains non-JSON element {x!r}; "
+                    "pass module_kwargs explicitly to export_bundle"
+                )
+            out.append(x)
+        return out
+    if callable(v):
+        path = _import_path(v)
+        if _resolve_import(path) is not v:
+            raise BundleError(
+                f"module field {name!r}={v!r} does not round-trip through "
+                f"its import path {path!r}; pass module_kwargs explicitly"
+            )
+        return {"__callable__": path}
+    raise BundleError(
+        f"module field {name!r}={v!r} is not JSON-serializable; pass "
+        "module_kwargs explicitly to export_bundle"
+    )
+
+
+def _decode_field(v):
+    if isinstance(v, dict) and "__callable__" in v:
+        return _resolve_import(v["__callable__"])
+    return v
+
+
+def _eq_default(v, default) -> bool:
+    try:
+        return bool(v == default)
+    except Exception:  # exotic __eq__: treat as non-default, encode it
+        pass
+    return False
+
+
+def _module_spec(module) -> tuple[str, dict]:
+    """(import path, JSON kwargs) that reconstruct a flax module.
+
+    flax ``nn.Module``s are dataclasses — fields at their class default
+    are omitted (the class reconstructs them, including non-serializable
+    defaults like activation callables); the rest must encode to JSON.
+    """
+    cls = type(module)
+    path = _import_path(cls)
+    if _resolve_import(path) is not cls:
+        raise BundleError(
+            f"policy class {cls.__name__} does not round-trip through its "
+            f"import path {path!r}; pass module_import/module_kwargs "
+            "explicitly"
+        )
+    kwargs = {}
+    for f in dataclasses.fields(module):
+        if f.name in ("parent", "name"):
+            continue  # flax wiring, not construction config
+        v = getattr(module, f.name)
+        if v is f.default:
+            continue
+        if f.default is not dataclasses.MISSING and _eq_default(v, f.default):
+            continue
+        kwargs[f.name] = _encode_field(f.name, v)
+    return path, kwargs
+
+
+def _flatten_collection(tree) -> tuple[list[np.ndarray], Any]:
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    return [np.asarray(x) for x in leaves], treedef
+
+
+# ------------------------------------------------------------------- export
+
+def export_bundle(
+    es,
+    path: str,
+    *,
+    use_best: bool = False,
+    version: str | int | None = None,
+    module_import: str | None = None,
+    module_kwargs: dict | None = None,
+    extra: dict | None = None,
+) -> str:
+    """Export a trained ``ES`` (device/pooled backend) into a bundle dir.
+
+    ``use_best`` exports the best-ever member snapshot instead of the
+    current center.  ``version`` tags the artifact (default: the source
+    generation).  ``module_import``/``module_kwargs`` override the
+    automatic module spec for policies whose config fields don't encode
+    to JSON.  Returns the absolute bundle path.
+    """
+    if getattr(es, "backend", None) == "host":
+        raise NotImplementedError(
+            "host-backend (torch) policies are not bundleable — the serving "
+            "stack is JAX-native; use torch.save on es.policy.state_dict() "
+            "for torch deployment"
+        )
+    if es.module is None:
+        raise BundleError("this ES has no flax module to bundle")
+
+    if use_best and es._best_flat is None:
+        raise BundleError(
+            "use_best=True but no best-member snapshot exists yet — "
+            "train at least one generation first"
+        )
+    flat = np.asarray(
+        es._best_flat if use_best else es.state.params_flat, np.float32
+    )
+
+    if module_import is None:
+        module_import, auto_kwargs = _module_spec(es.module)
+        if module_kwargs is None:
+            module_kwargs = auto_kwargs
+    elif module_kwargs is None:
+        module_kwargs = {}
+
+    arrays: dict[str, np.ndarray] = {"params_flat": flat}
+    frozen_meta: dict[str, int] = {}
+    for coll, tree in sorted(es._frozen.items()):
+        leaves, _ = _flatten_collection(tree)
+        frozen_meta[coll] = len(leaves)
+        for i, leaf in enumerate(leaves):
+            arrays[f"frozen.{coll}.{i}"] = leaf
+
+    obs_norm = bool(getattr(es, "_obs_norm", False))
+    if obs_norm:
+        cnt, mean, m2 = es.state.obs_stats
+        arrays["obs_stats.count"] = np.asarray(cnt)
+        arrays["obs_stats.mean"] = np.asarray(mean)
+        arrays["obs_stats.m2"] = np.asarray(m2)
+
+    path = os.path.abspath(path)
+    os.makedirs(path, exist_ok=True)
+    manifest_path = os.path.join(path, MANIFEST_NAME)
+    if os.path.exists(manifest_path):
+        # decommit BEFORE touching the payload: a reader racing this
+        # re-export sees "uncommitted", never a manifest whose checksum
+        # describes the previous payload
+        os.remove(manifest_path)
+    arrays_path = os.path.join(path, ARRAYS_NAME)
+    with open(arrays_path, "wb") as f:
+        np.savez(f, **arrays)
+
+    from ..obs.manifest import collect_manifest
+
+    mesh = getattr(es, "mesh", None)
+    runtime = collect_manifest(
+        devices=list(mesh.devices.flat) if mesh is not None else None
+    )
+    manifest = {
+        "schema": BUNDLE_SCHEMA,
+        "created_unix": time.time(),
+        "version": str(version if version is not None else es.generation),
+        "module": {"import": module_import, "kwargs": module_kwargs},
+        "obs_shape": [int(d) for d in np.shape(es._obs0)],
+        "param_dim": int(flat.shape[0]),
+        "recurrent": bool(getattr(es, "_recurrent", False)),
+        "obs_norm": obs_norm,
+        "obs_clip": float(getattr(es, "_obs_clip", 5.0)),
+        "frozen": frozen_meta,
+        "source": {
+            "algorithm": type(es).__name__,
+            "backend": es.backend,
+            "generation": int(es.generation),
+            "population_size": int(es.population_size),
+            "sigma": float(es.sigma),
+            "seed": int(es.seed),
+            "best_reward": float(es.best_reward),
+            "use_best": bool(use_best),
+        },
+        "runtime": runtime,
+        "sha256": {ARRAYS_NAME: _sha256_file(arrays_path)},
+    }
+    if extra:
+        manifest["extra"] = extra
+    tmp = manifest_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=2, default=float)
+    os.replace(tmp, manifest_path)  # the commit point
+    return path
+
+
+# ----------------------------------------------------------------- validate
+
+def validate_bundle(path: str) -> dict:
+    """Structural validation WITHOUT importing jax or the policy module —
+    what :func:`estorch_tpu.doctor.check_serve` runs.  Returns the
+    manifest; raises :class:`BundleError` with the finding otherwise.
+    """
+    path = os.path.abspath(path)
+    manifest_path = os.path.join(path, MANIFEST_NAME)
+    if not os.path.isdir(path):
+        raise BundleError(f"bundle path {path!r} is not a directory")
+    if not os.path.exists(manifest_path):
+        raise BundleError(
+            f"bundle at {path!r} has no {MANIFEST_NAME} — the export never "
+            "committed (crashed mid-write?) or this is not a bundle"
+        )
+    try:
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+    except ValueError as e:
+        raise BundleError(f"unreadable {MANIFEST_NAME}: {e}") from e
+    schema = manifest.get("schema")
+    if schema != BUNDLE_SCHEMA:
+        raise BundleError(
+            f"bundle schema {schema!r} != supported {BUNDLE_SCHEMA} — "
+            "re-export from the run that produced it"
+        )
+    for key in ("module", "obs_shape", "param_dim", "sha256", "version"):
+        if key not in manifest:
+            raise BundleError(f"{MANIFEST_NAME} is missing {key!r}")
+    arrays_path = os.path.join(path, ARRAYS_NAME)
+    if not os.path.exists(arrays_path):
+        raise BundleError(f"bundle is missing its payload {ARRAYS_NAME}")
+    sha = manifest.get("sha256")
+    want = sha.get(ARRAYS_NAME) if isinstance(sha, dict) else None
+    if not want:
+        raise BundleError(
+            f"{MANIFEST_NAME} records no checksum for {ARRAYS_NAME} — "
+            "not a bundle this version can trust"
+        )
+    got = _sha256_file(arrays_path)
+    if got != want:
+        raise BundleError(
+            f"{ARRAYS_NAME} checksum mismatch (manifest {want[:12]}…, file "
+            f"{got[:12]}…) — the payload is corrupt or was modified after "
+            "export"
+        )
+    with np.load(arrays_path) as z:
+        if "params_flat" not in z.files:
+            raise BundleError(f"{ARRAYS_NAME} has no params_flat array")
+        n = int(z["params_flat"].shape[0])
+    if n != int(manifest["param_dim"]):
+        raise BundleError(
+            f"params_flat has {n} parameters but the manifest promises "
+            f"{manifest['param_dim']}"
+        )
+    return manifest
+
+
+# --------------------------------------------------------------------- load
+
+class Bundle:
+    """A loaded policy bundle: rebuilt module + parameters + jitted
+    predict, honoring the exporting run's predict contract."""
+
+    def __init__(self, path: str, manifest: dict, module, params,
+                 frozen: dict, obs_stats):
+        self.path = path
+        self.manifest = manifest
+        self.module = module
+        self.params = params
+        self.frozen = frozen
+        self.obs_stats = obs_stats  # (count, mean, m2) or None
+        self.version = manifest["version"]
+        self.recurrent = bool(manifest.get("recurrent", False))
+        self.obs_shape = tuple(manifest["obs_shape"])
+        self.obs_clip = float(manifest.get("obs_clip", 5.0))
+        self._obs_norm = bool(manifest.get("obs_norm", False))
+
+        frozen_d = frozen
+
+        if self.recurrent:
+
+            def policy_apply(p, obs, h):
+                return module.apply({"params": p, **frozen_d}, obs, h)
+
+        else:
+
+            def policy_apply(p, obs):
+                return module.apply({"params": p, **frozen_d}, obs)
+
+        self._policy_apply = policy_apply
+        from .predictor import make_single_predict
+
+        self._predict_fn = make_single_predict(
+            policy_apply, recurrent=self.recurrent,
+            obs_norm=self._obs_norm, obs_clip=self.obs_clip,
+        )
+
+    # ---------------------------------------------------------- predict
+
+    def predict(self, obs, carry=None):
+        """Forward pass, bit-equal to the exporting run's ``ES.predict``
+        (same host compute configuration).  Recurrent bundles return
+        ``(out, new_carry)``; ``carry=None`` starts an episode."""
+        import jax.numpy as jnp
+
+        obs = jnp.asarray(obs)
+        if self.recurrent:
+            if carry is None:
+                from ..envs.rollout import carry_init_takes_params
+
+                ci = self.module.carry_init
+                carry = ci(self.params) if carry_init_takes_params(ci) else ci()
+            return self._predict_fn(self.params, self.obs_stats, obs, carry)
+        return self._predict_fn(self.params, self.obs_stats, obs)
+
+    def batched_predict_fn(self):
+        """``f(obs_batch (B, *obs_shape) np.ndarray) -> np.ndarray`` — the
+        dynamic batcher's compute, one XLA compile per batch shape.
+        Stateless policies only (the server's contract)."""
+        if self.recurrent:
+            raise BundleError(
+                "recurrent bundles cannot serve through the dynamic "
+                "batcher — the hidden carry belongs to a session, and the "
+                "batcher coalesces unrelated requests; use predict(obs, "
+                "carry) in-process"
+            )
+        import jax.numpy as jnp
+
+        from .predictor import make_batched_predict
+
+        fn = make_batched_predict(
+            self._policy_apply, obs_norm=self._obs_norm,
+            obs_clip=self.obs_clip,
+        )
+        params, stats = self.params, self.obs_stats
+
+        def batch_predict(obs_batch: np.ndarray) -> np.ndarray:
+            return np.asarray(fn(params, stats, jnp.asarray(obs_batch)))
+
+        return batch_predict
+
+
+def load_bundle(path: str) -> Bundle:
+    """Validate + load a bundle; raises :class:`BundleError` on any
+    structural, checksum, or module-compatibility problem."""
+    manifest = validate_bundle(path)
+    path = os.path.abspath(path)
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.params import make_param_spec
+
+    module_cls = _resolve_import(manifest["module"]["import"])
+    kwargs = {k: _decode_field(v)
+              for k, v in manifest["module"]["kwargs"].items()}
+    try:
+        module = module_cls(**kwargs)
+    except TypeError as e:
+        raise BundleError(
+            f"policy class {manifest['module']['import']!r} rejected the "
+            f"bundled kwargs {sorted(kwargs)}: {e} — the class signature "
+            "changed since export"
+        ) from e
+
+    obs0 = jnp.zeros(tuple(manifest["obs_shape"]), jnp.float32)
+    recurrent = bool(manifest.get("recurrent", False))
+    # structure-only init, mirroring ES._module_init: shapes depend on the
+    # obs shape and module config, never on the key or obs values
+    if recurrent:
+        variables = module.init(jax.random.PRNGKey(0), obs0,
+                                module.carry_init())
+    else:
+        variables = module.init(jax.random.PRNGKey(0), obs0)
+
+    _, spec = make_param_spec(variables["params"])
+    if spec.dim != int(manifest["param_dim"]):
+        raise BundleError(
+            f"rebuilt module has {spec.dim} parameters but the bundle "
+            f"carries {manifest['param_dim']} — the module definition "
+            "changed since export"
+        )
+
+    with np.load(os.path.join(path, ARRAYS_NAME)) as z:
+        arrays = {k: z[k] for k in z.files}
+
+    params = spec.unravel(jnp.asarray(arrays["params_flat"]))
+
+    frozen: dict[str, Any] = {}
+    for coll, n_leaves in (manifest.get("frozen") or {}).items():
+        tmpl = variables.get(coll)
+        if tmpl is None:
+            raise BundleError(
+                f"bundle carries frozen collection {coll!r} but the rebuilt "
+                "module does not define it — module definition drift"
+            )
+        leaves, treedef = jax.tree_util.tree_flatten(tmpl)
+        if len(leaves) != int(n_leaves):
+            raise BundleError(
+                f"frozen collection {coll!r}: module wants {len(leaves)} "
+                f"leaves, bundle has {n_leaves}"
+            )
+        loaded = [jnp.asarray(arrays[f"frozen.{coll}.{i}"])
+                  for i in range(int(n_leaves))]
+        frozen[coll] = jax.tree_util.tree_unflatten(treedef, loaded)
+
+    obs_stats = None
+    if manifest.get("obs_norm"):
+        obs_stats = (
+            jnp.asarray(arrays["obs_stats.count"]),
+            jnp.asarray(arrays["obs_stats.mean"]),
+            jnp.asarray(arrays["obs_stats.m2"]),
+        )
+
+    return Bundle(path, manifest, module, params, frozen, obs_stats)

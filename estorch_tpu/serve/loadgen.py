@@ -1,0 +1,361 @@
+"""Load generator for the policy server: open/closed-loop traffic with
+throughput and latency percentiles.
+
+Engine design: ONE thread drives N persistent connections through a
+``selectors`` loop, each connection holding at most one request in
+flight.  On a GIL'd host this measures the server honestly — a
+thread-per-connection client spends more time context-switching than
+talking, and (measured) *lowers* observed server throughput as
+concurrency rises.  Closed loop: every connection fires its next request
+the moment its response lands — offered load tracks capacity, the right
+mode for "how fast CAN it go" A/Bs.  Open loop: requests fire on a fixed
+schedule (``target_rps``) regardless of completions — queueing delay
+shows up in the latencies, the right mode for "what happens at X rps".
+
+Deliberately stdlib-only and importable without the package (run as
+``python estorch_tpu/serve/loadgen.py``) so the run_lint smoke and a
+wedged-jax host can still drive/load-test a server.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import selectors
+import socket
+import sys
+import time
+
+
+def _percentile(sorted_xs: list[float], q: float) -> float:
+    """Nearest-rank percentile on a pre-sorted sample (q in [0, 1])."""
+    if not sorted_xs:
+        return float("nan")
+    i = min(len(sorted_xs) - 1, max(0, int(q * len(sorted_xs))))
+    return sorted_xs[i]
+
+
+class _Conn:
+    __slots__ = ("sock", "buf", "sent_at", "req_index", "busy")
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self.buf = b""
+        self.sent_at = 0.0
+        self.req_index = -1
+        self.busy = False
+
+
+def _parse_responses(conn: _Conn):
+    """Yield (status, body bytes) for each complete HTTP response in the
+    buffer; leaves partial data buffered."""
+    while True:
+        head_end = conn.buf.find(b"\r\n\r\n")
+        if head_end < 0:
+            return
+        head = conn.buf[:head_end]
+        status = int(head.split(b" ", 2)[1])
+        clen = 0
+        for line in head.split(b"\r\n")[1:]:
+            if line[:15].lower() == b"content-length:":
+                clen = int(line[15:])
+        total = head_end + 4 + clen
+        if len(conn.buf) < total:
+            return
+        body = conn.buf[head_end + 4:total]
+        conn.buf = conn.buf[total:]
+        yield status, body
+
+
+def run_load(
+    address: str,
+    *,
+    mode: str = "closed",
+    conns: int = 8,
+    duration_s: float = 3.0,
+    total: int | None = None,
+    target_rps: float | None = None,
+    obs: list | None = None,
+    obs_list: list | None = None,
+    collect_responses: bool = False,
+    timeout_s: float = 60.0,
+) -> dict:
+    """Drive ``/predict`` traffic; returns the measurement dict.
+
+    ``obs_list`` assigns observation i to request i (requests are issued
+    in index order; with ``collect_responses`` the returned
+    ``responses[i]`` is request i's parsed body — the bit-exactness
+    check's plumbing).  ``total`` stops after exactly that many requests
+    (default: run for ``duration_s``).  ``mode="open"`` needs
+    ``target_rps``.
+    """
+    if mode not in ("closed", "open"):
+        raise ValueError(f"mode must be closed|open, got {mode!r}")
+    if mode == "open" and not target_rps:
+        raise ValueError("open-loop load needs target_rps")
+    if obs_list is None:
+        obs_list = [obs if obs is not None else [0.0]]
+    bodies = [json.dumps({"obs": o}).encode() for o in obs_list]
+    reqs = [
+        b"POST /predict HTTP/1.1\r\nHost: x\r\nContent-Type: application/json"
+        b"\r\nContent-Length: " + str(len(b)).encode() + b"\r\n\r\n" + b
+        for b in bodies
+    ]
+
+    if "://" in address:
+        address = address.split("://", 1)[1]
+    host, _, port = address.rstrip("/").partition(":")
+    addr = (host, int(port))
+
+    sel = selectors.DefaultSelector()
+    pool: list[_Conn] = []
+    for _ in range(int(conns)):
+        s = socket.create_connection(addr, timeout=timeout_s)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        s.setblocking(False)
+        c = _Conn(s)
+        sel.register(s, selectors.EVENT_READ, c)
+        pool.append(c)
+
+    import collections
+
+    latencies: list[float] = []
+    responses: list | None = [None] * len(obs_list) if collect_responses else None
+    issued = completed = errors = shed = scheduled = 0
+    t0 = time.perf_counter()
+    deadline = t0 + float(duration_s)
+    interval = 1.0 / target_rps if target_rps else 0.0
+    next_send = t0
+    # open loop: the SCHEDULE is authoritative — ticks accumulate here
+    # even while every connection is busy, and a request's latency is
+    # measured from its scheduled time, so queueing delay above capacity
+    # shows up in the percentiles instead of being coordinated away
+    backlog: collections.deque[float] = collections.deque()
+
+    def want_more(now: float) -> bool:
+        if total is not None:
+            return scheduled < total if mode == "open" else issued < total
+        return now < deadline
+
+    def tick_schedule(now: float) -> None:
+        nonlocal next_send, scheduled
+        if mode != "open":
+            return
+        while next_send <= now and want_more(now):
+            backlog.append(next_send)
+            scheduled += 1
+            next_send += interval
+
+    def retire(c: _Conn) -> None:
+        nonlocal completed, errors
+        if c.busy:
+            errors += 1
+            completed += 1
+            c.busy = False
+        sel.unregister(c.sock)
+        c.sock.close()
+        pool.remove(c)
+
+    def send_on(c: _Conn, sent_at: float) -> bool:
+        """Issue the next request on ``c`` (``sent_at``: the wall time
+        latency is measured from — the actual send for closed loop, the
+        SCHEDULED time for open loop).  A send failure (server closed
+        the connection mid-measurement) retires the connection and
+        counts the request as an error instead of blowing up the whole
+        measurement."""
+        nonlocal issued, errors, completed
+        c.req_index = issued
+        c.sent_at = sent_at
+        c.busy = True
+        issued += 1
+        try:
+            c.sock.sendall(reqs[c.req_index % len(reqs)])
+        except OSError:
+            retire(c)
+            return False
+        return True
+
+    def feed_idle(now: float) -> None:
+        tick_schedule(now)
+        for c in [c for c in pool if not c.busy]:
+            if mode == "open":
+                if not backlog:
+                    break
+                send_on(c, backlog.popleft())
+            else:
+                if not want_more(time.perf_counter()):
+                    break
+                send_on(c, time.perf_counter())
+
+    feed_idle(t0)
+
+    hard_stop = t0 + float(duration_s) + timeout_s
+    while (completed < issued or backlog
+           or want_more(time.perf_counter())):
+        now = time.perf_counter()
+        if now > hard_stop:
+            errors += issued - completed
+            break
+        feed_idle(now)
+        wait = 0.05
+        if mode == "open" and want_more(now) and not backlog:
+            wait = min(wait, max(0.0, next_send - now))
+        for key, _ in sel.select(timeout=wait):
+            c: _Conn = key.data
+            try:
+                chunk = c.sock.recv(1 << 16)
+            except BlockingIOError:
+                continue
+            except OSError:
+                chunk = b""
+            if not chunk:
+                # server closed the connection (drain) — count any
+                # outstanding request on it as an error and retire it
+                retire(c)
+                if not pool:
+                    break
+                continue
+            c.buf += chunk
+            for status, body in _parse_responses(c):
+                completed += 1
+                latencies.append(time.perf_counter() - c.sent_at)
+                if status == 503:
+                    shed += 1
+                elif status != 200:
+                    errors += 1
+                if responses is not None and 0 <= c.req_index < len(responses):
+                    try:
+                        responses[c.req_index] = json.loads(body)
+                    except ValueError:
+                        responses[c.req_index] = None
+                c.busy = False
+                now = time.perf_counter()
+                if mode == "open":
+                    tick_schedule(now)
+                    if backlog:
+                        send_on(c, backlog.popleft())
+                elif want_more(now):
+                    send_on(c, now)
+        if not pool:
+            errors += issued - completed
+            break
+
+    wall = time.perf_counter() - t0
+    for c in pool:
+        sel.unregister(c.sock)
+        c.sock.close()
+    sel.close()
+    lat_sorted = sorted(latencies)
+    out = {
+        "mode": mode,
+        "conns": int(conns),
+        "requests": completed,
+        "errors": errors,
+        "shed": shed,
+        "duration_s": round(wall, 4),
+        "throughput_rps": round(completed / wall, 2) if wall > 0 else 0.0,
+        "latency_ms": {
+            "p50": round(_percentile(lat_sorted, 0.50) * 1e3, 3),
+            "p95": round(_percentile(lat_sorted, 0.95) * 1e3, 3),
+            "p99": round(_percentile(lat_sorted, 0.99) * 1e3, 3),
+            "mean": round(sum(lat_sorted) / len(lat_sorted) * 1e3, 3)
+            if lat_sorted else float("nan"),
+            "max": round(lat_sorted[-1] * 1e3, 3) if lat_sorted else
+            float("nan"),
+        },
+    }
+    if target_rps:
+        out["target_rps"] = float(target_rps)
+    if responses is not None:
+        out["responses"] = responses
+    return out
+
+
+# ------------------------------------------------------------------ smoke
+
+def _selfcheck() -> int:
+    """Self-contained plumbing gate for run_lint.sh: spin a trivial
+    stdlib echo server in-process, drive both loop modes against it,
+    and validate the measurement schema.  No jax, no numpy, ~1s."""
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Echo(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            data = json.loads(self.rfile.read(n))
+            body = json.dumps({"action": data["obs"]}).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Echo)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    addr = f"127.0.0.1:{srv.server_address[1]}"
+    problems = []
+    try:
+        obs_list = [[float(i), 1.0] for i in range(16)]
+        closed = run_load(addr, conns=4, total=16, duration_s=5.0,
+                          obs_list=obs_list, collect_responses=True)
+        if closed["requests"] != 16 or closed["errors"]:
+            problems.append(f"closed loop lost requests: {closed}")
+        got = [r and r["action"] for r in closed["responses"]]
+        if got != obs_list:
+            problems.append("responses not matched to request indices")
+        lat = closed["latency_ms"]
+        if not (lat["p50"] <= lat["p95"] <= lat["p99"] <= lat["max"]):
+            problems.append(f"percentiles not monotone: {lat}")
+        open_ = run_load(addr, mode="open", target_rps=200, conns=4,
+                         duration_s=0.5)
+        if open_["requests"] == 0 or open_["errors"]:
+            problems.append(f"open loop failed: {open_}")
+        if not (0.3 * 200 * 0.5 < open_["requests"] <= 1.7 * 200 * 0.5):
+            problems.append(
+                f"open loop missed its schedule: {open_['requests']} "
+                "requests for target 200 rps x 0.5s")
+    finally:
+        srv.shutdown()
+        srv.server_close()
+    for p in problems:
+        print(f"loadgen selfcheck: {p}", file=sys.stderr)
+    if not problems:
+        print("loadgen selfcheck: OK (closed+open loop, percentiles, "
+              "response indexing)")
+    return 1 if problems else 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="drive /predict load against a policy server")
+    p.add_argument("--address", help="host:port of a running server")
+    p.add_argument("--mode", choices=("closed", "open"), default="closed")
+    p.add_argument("--conns", type=int, default=8)
+    p.add_argument("--duration", type=float, default=3.0)
+    p.add_argument("--target-rps", type=float, default=None)
+    p.add_argument("--obs", default=None,
+                   help="JSON observation, e.g. '[0.1, 0.2, 0.3]'")
+    p.add_argument("--selfcheck", action="store_true",
+                   help="validate the loadgen itself against an "
+                        "in-process echo server (CI gate)")
+    args = p.parse_args(argv)
+    if args.selfcheck:
+        return _selfcheck()
+    if not args.address:
+        p.error("--address is required (or --selfcheck)")
+    res = run_load(
+        args.address, mode=args.mode, conns=args.conns,
+        duration_s=args.duration, target_rps=args.target_rps,
+        obs=json.loads(args.obs) if args.obs else None,
+    )
+    print(json.dumps(res))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,109 @@
+"""The serving forward pass — ONE definition shared by ``ES.predict``,
+:mod:`estorch_tpu.serve.bundle`, and the inference server.
+
+Bit-exactness is the whole point of this module.  A served response must
+equal what the exporting run's ``ES.predict`` computes, and that only
+holds if every consumer builds the SAME jitted program from the SAME
+closure shape (normalize → apply, params and running stats as arguments).
+Two independently-written predict paths would drift — eager vs jitted
+and GEMV vs GEMM execution families genuinely differ in final bits on
+CPU (docs/serving.md "Bit-exactness contract") — so the builders live
+here and everyone imports them.
+
+Execution families (measured, tests/test_serve.py pins them):
+
+* single-observation calls lower to GEMV; ``jit`` and eager agree bit-
+  for-bit at batch 1;
+* batched calls (B ≥ 2) lower to GEMM; rows are bit-identical across
+  batch sizes *within the jitted family*, which is why the dynamic
+  batcher pads to power-of-two buckets of at least 2 — a request's bits
+  must not depend on how many neighbors it was coalesced with;
+* bit-parity across *processes* additionally requires the same host
+  compute configuration (e.g. ``--cpu-devices`` on the server matching
+  the exporting run's virtual-device count).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+
+
+def make_single_predict(
+    policy_apply: Callable[..., Any],
+    *,
+    recurrent: bool = False,
+    obs_norm: bool = False,
+    obs_clip: float = 5.0,
+) -> Callable[..., Any]:
+    """Jitted ``f(params, obs_stats, obs[, carry])`` for one observation.
+
+    ``obs_stats`` is the (count, mean, m2) Welford triple when
+    ``obs_norm`` (normalization happens INSIDE the jitted program so the
+    composition matches the rollout path), and must be passed as ``None``
+    otherwise.  Recurrent policies take and return the hidden carry:
+    ``f(...) -> (out, new_carry)``.
+
+    Also correct for batched ``obs`` (leading batch axis): flax modules
+    broadcast over leading dims, and normalization is elementwise — the
+    jitted batch call lands in the same GEMM family as
+    :func:`make_batched_predict`'s rows.
+    """
+    if obs_norm:
+        from ..parallel.engine import normalize_obs
+
+        if recurrent:
+
+            def f(params, stats, obs, carry):
+                return policy_apply(
+                    params, normalize_obs(obs, stats, obs_clip), carry
+                )
+
+        else:
+
+            def f(params, stats, obs):
+                return policy_apply(params, normalize_obs(obs, stats, obs_clip))
+
+    else:
+        if recurrent:
+
+            def f(params, stats, obs, carry):
+                del stats
+                return policy_apply(params, obs, carry)
+
+        else:
+
+            def f(params, stats, obs):
+                del stats
+                return policy_apply(params, obs)
+
+    return jax.jit(f)
+
+
+def make_batched_predict(
+    policy_apply: Callable[..., Any],
+    *,
+    obs_norm: bool = False,
+    obs_clip: float = 5.0,
+) -> Callable[..., Any]:
+    """Jitted ``f(params, obs_stats, obs_batch (B, *obs_shape)) -> (B, ...)``
+    — the dynamic batcher's program, one XLA compile per batch shape.
+
+    Stateless policies only: a recurrent policy's carry belongs to a
+    session, and the batcher coalesces *unrelated* requests — the server
+    refuses recurrent bundles rather than silently mixing carries.
+    """
+    if obs_norm:
+        from ..parallel.engine import normalize_obs
+
+        def one(params, stats, obs):
+            return policy_apply(params, normalize_obs(obs, stats, obs_clip))
+
+    else:
+
+        def one(params, stats, obs):
+            del stats
+            return policy_apply(params, obs)
+
+    return jax.jit(jax.vmap(one, in_axes=(None, None, 0)))

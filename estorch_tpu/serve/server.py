@@ -1,0 +1,458 @@
+"""Threaded inference server over a policy bundle (stdlib HTTP only).
+
+``python -m estorch_tpu.serve --bundle <dir>`` serves:
+
+* ``POST /predict``  — ``{"obs": [...]}`` → ``{"action": [...]}``; the
+  request rides the dynamic micro-batcher (serve/batcher.py); a full
+  queue answers 503 + ``Retry-After`` instead of growing without bound;
+* ``GET /healthz``   — liveness + the PR-2 heartbeat facts (last phase,
+  beat age) + queue/counter snapshot; 503 while draining;
+* ``GET /stats``     — full serving counters, bucket ladder, bundle
+  provenance;
+* ``POST /reload``   — ``{"path": "<bundle dir>"}`` hot-swaps the bundle
+  atomically: the new bundle loads and warms OFF the serving path, the
+  swap is one reference assignment, and the old batcher drains its
+  in-flight requests against the old params — no request ever sees a
+  half-loaded policy.
+
+Operational contract (docs/serving.md): heartbeat beats ride the
+``ESTORCH_OBS_HEARTBEAT`` protocol (obs/recorder.py) so the PR-3
+watchdog machinery can babysit a serving process exactly like a training
+run — ``serve --supervised`` runs the server as a spawned child of
+:class:`estorch_tpu.resilience.Supervisor` with heartbeat-staleness
+restarts.  SIGTERM drains: stop accepting, answer everything in flight,
+write the final counter line, exit 0.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from ..obs.spans import Telemetry, resolve_telemetry
+from .batcher import BatcherClosed, BatcherSaturated, DynamicBatcher
+from .bundle import BundleError, load_bundle
+
+DRAIN_GRACE_S = 15.0
+
+
+class _Engine:
+    """One immutable (bundle, batcher) pair — THE hot-reload swap unit."""
+
+    def __init__(self, bundle, batcher: DynamicBatcher):
+        self.bundle = bundle
+        self.batcher = batcher
+
+
+class PolicyServer:
+    """Bundle + dynamic batcher behind a ThreadingHTTPServer."""
+
+    def __init__(
+        self,
+        bundle_path: str,
+        *,
+        host: str = "127.0.0.1",
+        port: int = 8321,
+        max_batch: int = 32,
+        max_wait_ms: float = 4.0,
+        max_queue: int = 256,
+        request_timeout_s: float = 30.0,
+        telemetry=None,
+        warm: bool = False,
+    ):
+        self.obs = resolve_telemetry(telemetry)
+        self.max_batch = int(max_batch)
+        # validate the CONFIG here so a bad --max-batch fails fast as a
+        # config error — inside _build_engine it would be misattributed
+        # to the bundle (the try there is for slot-dependence only)
+        from .batcher import bucket_sizes
+
+        bucket_sizes(self.max_batch)
+        self.max_wait_ms = float(max_wait_ms)
+        self.max_queue = int(max_queue)
+        self.request_timeout_s = float(request_timeout_s)
+        self.warm = bool(warm)
+        self.started_unix = time.time()
+        self.draining = False
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+        self._inflight_zero = threading.Event()
+        self._inflight_zero.set()
+        self._drained = threading.Event()
+        self.obs.note("load_bundle")
+        # serializes reload-vs-reload and reload-vs-shutdown: concurrent
+        # swaps would double-close one old engine and leak the other
+        self._engine_lock = threading.Lock()
+        self._engine = self._build_engine(bundle_path)
+        self._httpd = _Httpd((host, int(port)), _make_handler(self))
+        self.host, self.port = self._httpd.server_address[:2]
+
+    # ----------------------------------------------------------- engine
+
+    def _build_engine(self, bundle_path: str) -> _Engine:
+        bundle = load_bundle(bundle_path)
+        batch_fn = bundle.batched_predict_fn()  # refuses recurrent bundles
+        # the batcher's construction-time bucket verification doubles as
+        # the compile warm-up for every ladder shape (serve/batcher.py);
+        # --warm additionally pre-compiles the single-bucket case the
+        # verification skips (max_batch=1, the A/B baseline)
+        try:
+            batcher = DynamicBatcher(
+                batch_fn, bundle.obs_shape, max_batch=self.max_batch,
+                max_wait_ms=self.max_wait_ms, max_queue=self.max_queue,
+                telemetry=self.obs,
+            )
+        except ValueError as e:
+            # slot-dependent anchor: a bundle-grade rejection, so /reload
+            # answers 409 and the CLI exits 2 with the diagnosis
+            raise BundleError(
+                f"bundle at {bundle_path!r} cannot serve deterministically "
+                f"under coalescing: {e}"
+            ) from e
+        if self.warm and len(batcher.buckets) == 1:
+            b = batcher.buckets[0]
+            batch_fn(np.zeros((b,) + bundle.obs_shape, np.float32))
+        return _Engine(bundle, batcher)
+
+    def reload(self, bundle_path: str) -> dict:
+        """Hot bundle reload: load+warm off-path, swap atomically, drain
+        the old batcher.  On any load error the old bundle keeps serving.
+        Serialized: concurrent reloads would double-close one old engine
+        and leak the other's worker thread + loaded params."""
+        with self._engine_lock:
+            if self.draining:
+                raise BundleError("server is draining — reload refused")
+            old = self._engine
+            new = self._build_engine(bundle_path)  # BundleError on junk
+            self._engine = new  # atomic reference swap
+        self.obs.counters.inc("reloads_total")
+        self.obs.event("bundle_reloaded", path=bundle_path,
+                       version=new.bundle.version)
+        old.batcher.close(drain=True)
+        return {"ok": True, "version": new.bundle.version,
+                "previous": old.bundle.version}
+
+    # ---------------------------------------------------------- serving
+
+    def predict(self, obs) -> np.ndarray:
+        # one engine read per attempt; a request racing a hot reload can
+        # catch the OLD batcher mid-close (BatcherClosed) on a perfectly
+        # healthy server — retry against the freshly-swapped engine
+        # instead of answering a spurious "draining" 503
+        while True:
+            eng = self._engine
+            try:
+                return eng.batcher.predict(obs,
+                                           timeout=self.request_timeout_s)
+            except BatcherClosed:
+                if self.draining or eng is self._engine:
+                    raise
+
+    def track_request(self):
+        with self._inflight_lock:
+            self._inflight += 1
+            self._inflight_zero.clear()
+
+    def untrack_request(self):
+        with self._inflight_lock:
+            self._inflight -= 1
+            if self._inflight <= 0:
+                self._inflight_zero.set()
+
+    def health(self) -> dict:
+        eng = self._engine
+        c = self.obs.counters
+        out = {
+            "ok": not self.draining,
+            "draining": self.draining,
+            "version": eng.bundle.version,
+            "bundle": eng.bundle.path,
+            "uptime_s": round(time.time() - self.started_unix, 3),
+            "pid": os.getpid(),
+            "queue_depth": eng.batcher._q.qsize(),
+            "requests_total": int(c.get("requests_total")),
+            "shed_total": int(c.get("shed_total")),
+        }
+        hb = self.obs.heartbeat
+        if hb is not None:
+            from ..obs.recorder import read_heartbeat
+
+            beat = read_heartbeat(hb.path)
+            if beat is not None:
+                out["heartbeat"] = {"path": hb.path,
+                                    "age_s": round(beat["age_s"], 3),
+                                    "phase": beat.get("phase")}
+        return out
+
+    def stats(self) -> dict:
+        eng = self._engine
+        return {
+            "version": eng.bundle.version,
+            "bundle": eng.bundle.path,
+            "source": eng.bundle.manifest.get("source"),
+            "obs_shape": list(eng.bundle.obs_shape),
+            "max_wait_ms": self.max_wait_ms,
+            "counters": self.obs.counters.snapshot(),
+            **eng.batcher.stats(),
+        }
+
+    # -------------------------------------------------------- lifecycle
+
+    def serve_forever(self) -> None:
+        self.obs.note("serving")
+        self._httpd.serve_forever(poll_interval=0.1)
+
+    def start_background(self) -> threading.Thread:
+        t = threading.Thread(target=self.serve_forever, name="serve-http",
+                             daemon=True)
+        t.start()
+        return t
+
+    def shutdown(self, drain: bool = True) -> dict:
+        """Graceful stop: no new connections, answer everything already
+        in flight, drain the batcher queue, then close.  Returns the
+        final counter snapshot (the CLI prints it as the last line)."""
+        with self._engine_lock:
+            # after this flag no reload can swap in a fresh engine that
+            # shutdown would never close
+            self.draining = True
+        self.obs.note("draining")
+        self._httpd.shutdown()  # stop accepting; serve_forever returns
+        # requests already parsed (tracked) finish against the batcher
+        self._inflight_zero.wait(DRAIN_GRACE_S)
+        self._engine.batcher.close(drain=drain)
+        self._httpd.server_close()
+        self.obs.note("drained")
+        final = {
+            "drained": True,
+            "clean": self._inflight_zero.is_set(),
+            "counters": self.obs.counters.snapshot(),
+        }
+        self._drained.set()
+        return final
+
+
+class _Httpd(ThreadingHTTPServer):
+    # handler threads die with the process; drain correctness comes from
+    # the in-flight tracking in PolicyServer.shutdown, not thread joins
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+def _make_handler(server: PolicyServer):
+    class ServeHandler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"  # keep-alive: persistent clients
+
+        def log_message(self, *args):  # quiet: obs counters tell the story
+            pass
+
+        def _reply(self, code: int, payload: dict,
+                   extra_headers: dict | None = None) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in (extra_headers or {}).items():
+                self.send_header(k, v)
+            if server.draining:
+                # finish this response, then let the connection close so
+                # keep-alive clients re-resolve elsewhere
+                self.send_header("Connection", "close")
+                self.close_connection = True
+            self.end_headers()
+            self.wfile.write(body)
+
+        # ------------------------------------------------------- routes
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                h = server.health()
+                self._reply(200 if h["ok"] else 503, h)
+            elif self.path == "/stats":
+                self._reply(200, server.stats())
+            else:
+                self._reply(404, {"error": f"no route {self.path!r}"})
+
+        def do_POST(self):
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                data = json.loads(self.rfile.read(n)) if n else {}
+            except (ValueError, TypeError) as e:
+                self._reply(400, {"error": f"bad request body: {e}"})
+                return
+            if not isinstance(data, dict):
+                self._reply(400, {"error": "request body must be a JSON "
+                                           "object"})
+                return
+            if self.path == "/predict":
+                self._predict(data)
+            elif self.path == "/reload":
+                self._reload(data)
+            else:
+                self._reply(404, {"error": f"no route {self.path!r}"})
+
+        def _predict(self, data: dict) -> None:
+            if "obs" not in data:
+                self._reply(400, {"error": "predict needs {'obs': [...]}"})
+                return
+            # a request counts as in flight until its RESPONSE is written:
+            # untracking before the reply would let a SIGTERM drain declare
+            # victory (inflight==0) while this thread still holds an
+            # unwritten answer — and the process exit would drop it
+            server.track_request()
+            try:
+                try:
+                    out = server.predict(data["obs"])
+                except BatcherSaturated:
+                    self._reply(503,
+                                {"error": "saturated — retry with backoff"},
+                                {"Retry-After": "1"})
+                    return
+                except BatcherClosed:
+                    self._reply(503, {"error": "draining"})
+                    return
+                except (ValueError, TypeError) as e:
+                    # malformed obs AT SUBMIT (wrong shape → ValueError,
+                    # nulls/non-numerics → TypeError from np.asarray) —
+                    # genuinely the client's fault; batch-side faults
+                    # arrive as BatchError below, never these types
+                    self._reply(400, {"error": str(e)})
+                    return
+                except TimeoutError as e:
+                    self._reply(504, {"error": str(e)})
+                    return
+                except Exception as e:  # noqa: BLE001 — a server fault
+                    # (BatchError from the jitted forward, device runtime
+                    # death) must answer 500, not drop the connection
+                    server.obs.counters.inc("http_500_total")
+                    server.obs.event("predict_error", error=repr(e)[:200])
+                    self._reply(500, {"error": f"server fault: {e}"})
+                    return
+                self._reply(200, {"action": out.tolist()})
+            finally:
+                server.untrack_request()
+
+        def _reload(self, data: dict) -> None:
+            path = data.get("path")
+            if not path:
+                self._reply(400, {"error": "reload needs {'path': ...}"})
+                return
+            try:
+                self._reply(200, server.reload(path))
+            except (BundleError, OSError) as e:
+                # the old bundle keeps serving — a bad reload is a 409,
+                # not an outage
+                self._reply(409, {"error": str(e)})
+
+    return ServeHandler
+
+
+# ---------------------------------------------------------------- CLI body
+
+def run_server(args) -> int:
+    """The ``python -m estorch_tpu.serve`` body (args from __main__.py).
+    Returns the process exit code: 0 after a clean drain."""
+    telemetry = Telemetry.from_env()
+    telemetry.note("init")
+    server = PolicyServer(
+        args.bundle, host=args.host, port=args.port,
+        max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
+        max_queue=args.max_queue, telemetry=telemetry, warm=args.warm,
+    )
+
+    stop = threading.Event()
+
+    def _on_signal(signum, frame):
+        del frame
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+
+    url = f"http://{server.host}:{server.port}"
+    ready = {
+        "ready": True, "url": url, "pid": os.getpid(),
+        "version": server._engine.bundle.version,
+        "max_batch": server.max_batch,
+        "buckets": list(server._engine.batcher.buckets),
+    }
+    print(json.dumps(ready), flush=True)
+    if args.port_file:
+        tmp = args.port_file + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"host": server.host, "port": server.port,
+                       "pid": os.getpid()}, f)
+        os.replace(tmp, args.port_file)
+
+    server.start_background()
+    beat_s = max(0.2, float(args.beat_interval))
+    while not stop.wait(beat_s):
+        # periodic heartbeat so the PR-3 staleness watchdog sees an IDLE
+        # server as alive, not wedged (batcher phases beat under load)
+        telemetry.note("serving")
+    final = server.shutdown(drain=True)
+    print(json.dumps(final, default=float), flush=True)
+    return 0 if final["clean"] else 1
+
+
+# ------------------------------------------------------------- supervision
+
+def supervised_child(root: str, argv: list) -> None:
+    """Child body for ``serve --supervised`` — runs in a spawned (fresh)
+    interpreter with ``ESTORCH_OBS_HEARTBEAT`` already pointed into
+    ``root`` by the Supervisor plumbing (resilience/supervisor.py), so
+    platform policy must be re-applied here before jax initializes."""
+    del root
+    from .__main__ import build_parser
+
+    args = build_parser().parse_args(argv)
+    if args.cpu_devices > 0:
+        from ..utils import force_cpu_backend
+
+        force_cpu_backend(args.cpu_devices)
+    raise SystemExit(run_server(args))
+
+
+def run_supervised(args, argv: list) -> int:
+    """Babysit the server with the PR-3 watchdog: exit-status + heartbeat
+    staleness restarts, exponential backoff.  SIGTERM to the supervisor
+    forwards to the child, which drains and exits 0 — the supervisor then
+    reports clean completion."""
+    from ..resilience.supervisor import Supervisor
+
+    child_argv = [a for a in argv if a != "--supervised"]
+    sup = Supervisor(
+        ckpt_root=args.supervise_root,
+        target_generation=0,
+        child_target="estorch_tpu.serve.server:supervised_child",
+        child_args=(child_argv,),
+        max_restarts=args.max_restarts,
+        stale_after_s=args.stale_after_s,
+        startup_grace_s=args.startup_grace_s,
+    )
+
+    def _forward(signum, frame):
+        del frame
+        sup.request_stop(signum)
+
+    signal.signal(signal.SIGTERM, _forward)
+    signal.signal(signal.SIGINT, _forward)
+    result = sup.run()
+    print(json.dumps({"supervised": True, "ok": result["ok"],
+                      "restarts": len(result["restarts"]),
+                      "reason": result["reason"]}), flush=True)
+    return 0 if result["ok"] else 1
+
+
+def find_free_port(host: str = "127.0.0.1") -> int:
+    """An ephemeral port for tests/tools (bind(0), read, release)."""
+    with socket.socket() as s:
+        s.bind((host, 0))
+        return s.getsockname()[1]

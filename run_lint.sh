@@ -25,6 +25,22 @@ echo "== chaos selfcheck =="
 # no device touch.
 python bench.py --chaos --selfcheck
 
+echo "== loadgen smoke =="
+# the load generator validated against an in-process stdlib echo server
+# (closed+open loop, latency percentiles, response indexing).  Run as a
+# FILE, not a module: loadgen is deliberately stdlib-only, so this works
+# even where the jax import chain is broken/wedged.
+python estorch_tpu/serve/loadgen.py --selfcheck
+
+echo "== serve selfcheck =="
+# serving-vertical gate (estorch_tpu/serve, docs/serving.md): export a
+# trained pendulum bundle, serve it through the dynamic batcher, drive
+# concurrent load — gates bit-exact responses (vs the exporting run's
+# es.predict), bucket/recompile accounting, zero shed, and a clean
+# SIGTERM drain.  CPU only; the >=3x batching-throughput gate lives in
+# the full `bench.py --serve` form and the tier-1 serving demo.
+python bench.py --serve --selfcheck
+
 echo "== compileall =="
 python -m compileall -q estorch_tpu/ tests/ examples/
 

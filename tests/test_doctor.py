@@ -166,6 +166,62 @@ class TestResilienceCheck:
         assert out["roundtrip"]["status"] == "wedged"
 
 
+class TestServeCheck:
+    def test_loopback_and_batcher_smoke(self):
+        out = doctor.check_serve()
+        assert out["loopback"]["bindable"] is True
+        assert out["batcher"]["ok"] is True
+        # the numpy-only smoke compiles nothing, but the accounting must
+        # still bound "recompiles" by the ladder it reports
+        assert out["batcher"]["recompiles"] <= len(out["batcher"]["buckets"])
+        assert "bundle" not in out  # no bundle given
+
+    def test_bundle_validation_without_jax_import(self, tmp_path):
+        """A structurally-broken bundle is diagnosed (not crashed on),
+        and validation never needs the policy module to be importable."""
+        out = doctor.check_serve(bundle=str(tmp_path / "missing"))
+        assert out["bundle"]["valid"] is False
+        assert "error" in out["bundle"]
+
+        import json
+
+        bdir = tmp_path / "b"
+        bdir.mkdir()
+        (bdir / "arrays.npz").write_bytes(b"junk")
+        (bdir / "MANIFEST.json").write_text(json.dumps({
+            "schema": 1, "version": "x",
+            "module": {"import": "not.importable:Ghost", "kwargs": {}},
+            "obs_shape": [3], "param_dim": 7,
+            "sha256": {"arrays.npz": "0" * 64},
+        }))
+        out = doctor.check_serve(bundle=str(bdir))
+        assert out["bundle"]["valid"] is False
+        assert "checksum" in out["bundle"]["error"]
+
+    def test_valid_bundle_reported(self, tmp_path):
+        import hashlib
+        import json
+
+        import numpy as np
+
+        bdir = tmp_path / "b"
+        bdir.mkdir()
+        arrays = bdir / "arrays.npz"
+        with open(arrays, "wb") as f:
+            np.savez(f, params_flat=np.zeros(7, np.float32))
+        sha = hashlib.sha256(arrays.read_bytes()).hexdigest()
+        (bdir / "MANIFEST.json").write_text(json.dumps({
+            "schema": 1, "version": "v9",
+            "module": {"import": "whatever:NotImported", "kwargs": {}},
+            "obs_shape": [3], "param_dim": 7, "obs_norm": False,
+            "sha256": {"arrays.npz": sha},
+        }))
+        out = doctor.check_serve(bundle=str(bdir))
+        assert out["bundle"]["valid"] is True
+        assert out["bundle"]["version"] == "v9"
+        assert out["bundle"]["param_dim"] == 7
+
+
 class TestReport:
     def test_report_shape_and_hints(self, monkeypatch):
         monkeypatch.setattr(doctor, "probe_device",
@@ -180,6 +236,9 @@ class TestReport:
         # resilience config checks ride every report (probe is opt-in)
         assert rep["resilience"]["fork"]["available"] is True
         assert "ckpt_root" in rep["resilience"]
+        # serving readiness rides every report too (bundle is opt-in)
+        assert rep["serve"]["loopback"]["bindable"] is True
+        assert rep["serve"]["batcher"]["ok"] is True
 
     def test_report_run_dir_flows_to_obs_check(self, tmp_path,
                                                monkeypatch):

@@ -59,6 +59,17 @@ def test_extended_surface_imports():
         Supervisor,
         run_resilient,
     )
+    from estorch_tpu.serve import (  # noqa: F401
+        BatcherSaturated,
+        Bundle,
+        BundleError,
+        DynamicBatcher,
+        PolicyServer,
+        ServeClient,
+        export_bundle,
+        load_bundle,
+        validate_bundle,
+    )
     from estorch_tpu.utils import latest_checkpoint  # noqa: F401
 
 
