@@ -50,6 +50,18 @@ measurement cannot take down the bench — round-1 lesson):
                                         the run_lint.sh gate: nonzero exit
                                         when recovery did not actually
                                         recover
+    bench.py --regress [BASELINE.json]  perf gate (estorch_tpu/obs/export/
+                                        regress.py): measure the headline
+                                        config `--repeats` times (fresh
+                                        stage children), compare the
+                                        median against the committed
+                                        BENCH_*.json baseline with a
+                                        noise band learned from the
+                                        repeats; exit 1 on regression.
+                                        Defaults to the newest BENCH_r*
+                                        file (add --cpu off-chip — only
+                                        gate against a baseline measured
+                                        on the same platform)
     bench.py --serve [--selfcheck]      serving A/B (estorch_tpu/serve,
                                         docs/serving.md): export a trained
                                         pendulum bundle, serve it, drive
@@ -80,31 +92,37 @@ import time
 import numpy as np
 
 
-def _load_obs_recorder():
-    """Load estorch_tpu/obs/recorder.py WITHOUT the package __init__.
+def _load_repo_module(name, *relpath):
+    """Load a repo module by FILE, without the package __init__.
 
-    The recorder module itself is jax-free, but `import estorch_tpu...`
+    The loaded modules are jax-free, but `import estorch_tpu...`
     executes the package init, which imports jax — and importing jax in
     THIS process would touch the possibly-wedged device runtime before
     the stage protocol's subprocess+timeout isolation can protect us
     (the round-1 lesson the whole stage design exists for).  A direct
-    file load keeps one implementation of the heartbeat protocol while
-    keeping the bench driver accelerator-free."""
+    file load keeps one implementation of each protocol while keeping
+    the bench driver accelerator-free."""
     import importlib.util
 
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "estorch_tpu", "obs", "recorder.py")
-    spec = importlib.util.spec_from_file_location("_estorch_obs_recorder",
-                                                  path)
+                        *relpath)
+    spec = importlib.util.spec_from_file_location(name, path)
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return mod
 
 
-obs_recorder = _load_obs_recorder()
+obs_recorder = _load_repo_module("_estorch_obs_recorder",
+                                 "estorch_tpu", "obs", "recorder.py")
 HEARTBEAT_ENV = obs_recorder.HEARTBEAT_ENV
 describe_heartbeat = obs_recorder.describe_heartbeat
 read_heartbeat = obs_recorder.read_heartbeat
+
+
+def _load_obs_regress():
+    """estorch_tpu/obs/export/regress.py, same jax-free contract."""
+    return _load_repo_module("_estorch_obs_regress",
+                             "estorch_tpu", "obs", "export", "regress.py")
 
 V5E_BF16_PEAK = 197e12  # TPU v5e per-chip bf16 peak FLOP/s
 
@@ -812,6 +830,56 @@ def stage_serve(selfcheck=False):
     return 0 if ok else 1
 
 
+def _default_regress_baseline() -> str | None:
+    """Newest committed BENCH_r*.json beside this file, by name."""
+    import glob
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    cands = sorted(glob.glob(os.path.join(here, "BENCH_r*.json")))
+    return cands[-1] if cands else None
+
+
+def stage_regress(baseline: str | None, repeats: int = 3,
+                  force_cpu: bool = False) -> int:
+    """Perf gate against a committed baseline (obs/export/regress.py).
+
+    The headline config is measured ``repeats`` times in fresh stage
+    children (the --obs-ab repeat discipline: one run cannot resolve a
+    small effect on a loaded shared core, so the verdict compares the
+    repeat median and learns its noise band from the repeats); a drop
+    beyond the band vs the baseline's recorded value exits 1.  Rows and
+    the verdict land as JSON lines like every other stage."""
+    regress = _load_obs_regress()
+    baseline = baseline or _default_regress_baseline()
+    if not baseline:
+        print(json.dumps({"label": "regress", "error":
+                          "no BENCH_r*.json baseline found"}), flush=True)
+        return 2
+    try:
+        base_samples, base_metric = regress.load_measurement(baseline)
+    except (OSError, ValueError) as e:
+        print(json.dumps({"label": "regress",
+                          "error": f"baseline: {e}"}), flush=True)
+        return 2
+    rates = []
+    for rep in range(int(repeats)):
+        r = run_stage(dict(SMALL), timeout_s=1200 if force_cpu else 600,
+                      force_cpu=force_cpu)
+        if r and r.get("rate"):
+            rates.append(r["rate"])
+        print(json.dumps({"label": "regress/repeat", "rep": rep,
+                          **(r or {"rate": None, "cfg": SMALL})}),
+              flush=True)
+    if not rates:
+        print(json.dumps({"label": "regress",
+                          "error": "every repeat failed"}), flush=True)
+        return 2
+    verdict = regress.compare(rates, base_samples, metric=base_metric)
+    print(json.dumps({"label": "regress", "baseline": baseline,
+                      **verdict}), flush=True)
+    return 0 if verdict["verdict"] == "pass" else 1
+
+
 class EvidenceLockBusy(Exception):
     """The evidence flock is held by another measurement/study process."""
 
@@ -950,6 +1018,17 @@ if __name__ == "__main__":
     elif "--stage-serve-one" in sys.argv:
         cfg = json.loads(sys.argv[sys.argv.index("--stage-serve-one") + 1])
         print(json.dumps(measure_serve_one(cfg)))
+    elif "--regress" in sys.argv:
+        _lock_or_warn()
+        idx = sys.argv.index("--regress")
+        baseline = None
+        if idx + 1 < len(sys.argv) and not sys.argv[idx + 1].startswith("-"):
+            baseline = sys.argv[idx + 1]
+        repeats = 3
+        if "--repeats" in sys.argv:
+            repeats = int(sys.argv[sys.argv.index("--repeats") + 1])
+        sys.exit(stage_regress(baseline, repeats=repeats,
+                               force_cpu="--cpu" in sys.argv))
     elif "--serve" in sys.argv:
         # the selfcheck form runs inside run_lint.sh (tiny policy, CPU,
         # loopback only): skip the evidence lock a full measurement takes

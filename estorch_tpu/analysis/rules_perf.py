@@ -1,4 +1,5 @@
-"""Measurement-honesty rules: R07 unfenced-device-timing.
+"""Measurement-honesty rules: R07 unfenced-device-timing, R09
+nonmonotonic-span-clock.
 
 JAX dispatch is asynchronous: a jitted call returns a future-like array
 immediately and the device executes in the background.  So
@@ -164,5 +165,84 @@ def check_unfenced_timing(ctx: ModuleContext):
                     "call jax.block_until_ready(...) on the dispatched "
                     "outputs (or materialize them with np.asarray/.item()) "
                     "before taking the delta",
+                    symbol))
+    return out
+
+
+# ---------------------------------------------------------------------
+# R09: wall-clock (time.time) used for an elapsed-time measurement
+# ---------------------------------------------------------------------
+#
+# ``time.time()`` is the WALL clock: NTP steps, leap smearing, and
+# suspend/resume move it — backwards included.  Using it to time a span
+# or age a within-process timestamp silently corrupts exactly the
+# telemetry that perf gates and staleness watchdogs trust; the monotonic
+# clocks (``time.perf_counter()``/``time.monotonic()``) exist for this.
+#
+# Wall time IS required when the timestamp crosses a process boundary
+# (the heartbeat protocol: writer pid != reader pid, so no monotonic
+# clock is shared — obs/recorder.py's ``age_s`` must stay wall-clock).
+# The rule is therefore conservative: it only flags a delta whose BOTH
+# ends are provably this module's own ``time.time()`` reads — a start
+# bound from ``time.time()`` in the same scope (or a ``self.<attr>``
+# assigned from it anywhere in the module) subtracted from a fresh
+# ``time.time()`` call.  A start read from a file/dict (the heartbeat
+# reader) is untyped and stays silent.
+
+_WALL_CLOCK = "time.time"
+
+
+def _is_wall_call(ctx: ModuleContext, node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and ctx.resolve(node.func) == _WALL_CLOCK)
+
+
+@rule("R09", "nonmonotonic-span-clock", "warning",
+      "time.time() delta measures elapsed time with the wall clock — "
+      "NTP steps/suspend skew spans and ages; use time.perf_counter() "
+      "or time.monotonic()")
+def check_nonmonotonic_span_clock(ctx: ModuleContext):
+    r = get_rule("R09")
+    # self.<attr> = time.time() is collected module-wide: the serving/
+    # supervisor idiom stamps the start in __init__ and takes the delta
+    # in another method
+    wall_attrs: set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign) and _is_wall_call(ctx, node.value):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Attribute):
+                    wall_attrs.add(tgt.attr)
+    out = []
+    for symbol, scope in iter_scopes(ctx):
+        wall_names: set[str] = set()
+        deltas: list[ast.BinOp] = []
+        for node in scope_nodes(scope):
+            if isinstance(node, ast.Assign) and _is_wall_call(
+                    ctx, node.value):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        wall_names.add(tgt.id)
+            elif (isinstance(node, ast.BinOp)
+                    and isinstance(node.op, ast.Sub)
+                    and _is_wall_call(ctx, node.left)):
+                deltas.append(node)
+        for node in deltas:
+            right = node.right
+            start = None
+            if isinstance(right, ast.Name) and right.id in wall_names:
+                start = f"`{right.id}`"
+            elif (isinstance(right, ast.Attribute)
+                    and right.attr in wall_attrs):
+                start = f"`self.{right.attr}`-style attribute"
+            if start is not None:
+                out.append(make_finding(
+                    ctx, r, node,
+                    f"elapsed time measured as time.time() minus {start} "
+                    "(also bound from time.time()) — the wall clock can "
+                    "step backwards under NTP/suspend, corrupting the "
+                    "span/age",
+                    "bind both ends to time.perf_counter() (spans) or "
+                    "time.monotonic() (ages/deadlines); keep time.time() "
+                    "only for timestamps that cross a process boundary",
                     symbol))
     return out

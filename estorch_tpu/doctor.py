@@ -157,6 +157,11 @@ def check_obs(run_dir: str | None = None) -> dict:
       profiler traces, heartbeat files all land there)?
     - is TensorBoard importable (TensorBoardSink), or is JsonlSink the
       only option?
+    - export probe: spin up the Prometheus metrics sidecar
+      (obs/export/sidecar.py) over a synthetic temp run-dir, scrape it
+      over loopback, and validate the exposition PARSES — all stdlib, no
+      jax touch, so "can this host be scraped" is answerable even from a
+      wedged-runtime machine;
     - given a run dir: heartbeat freshness — the liveness verdict for a
       run that stopped printing ("wedged or dead" vs "slow but beating").
     """
@@ -187,6 +192,7 @@ def check_obs(run_dir: str | None = None) -> dict:
         "available": tb,
         "needed_for": "obs.TensorBoardSink (obs.JsonlSink needs nothing)",
     }
+    out["export"] = _export_probe()
     if run_dir is not None:
         hb_path = os.path.join(run_dir, "heartbeat.json")
         hb = read_heartbeat(hb_path)
@@ -205,6 +211,58 @@ def check_obs(run_dir: str | None = None) -> dict:
                 "generation": hb.get("generation"),
             }
     return out
+
+
+def _export_probe() -> dict:
+    """Loopback-scrape the metrics sidecar against a synthetic temp
+    run-dir and validate the exposition parses (obs/export/): the
+    end-to-end proof that a supervised run on THIS host would be
+    scrapeable.  Stdlib only — never touches jax or a device runtime."""
+    import json as _json
+    import os
+    import tempfile
+    import time as _time
+    import urllib.request
+
+    try:
+        from .obs.export.prometheus import parse_exposition, samples_by_name
+        from .obs.export.sidecar import MetricsSidecar, publish_counters
+
+        with tempfile.TemporaryDirectory() as d:
+            hb_ts = _time.time()
+            with open(os.path.join(d, "heartbeat.json"), "w") as f:
+                _json.dump({"ts": hb_ts, "pid": os.getpid(),
+                            "phase": "doctor_probe", "generation": 1,
+                            "counters": {"env_steps": 1}}, f)
+            # published totals + a NEWER live beat: the scrape must
+            # compose both (the cross-restart monotonicity contract)
+            publish_counters(d, {"env_steps": 2}, through_ts=hb_ts - 1.0,
+                             extra={"restart_count": 1})
+            sidecar = MetricsSidecar(d, port=0)
+            sidecar.start_background()
+            try:
+                with urllib.request.urlopen(
+                        f"http://{sidecar.host}:{sidecar.port}/metrics",
+                        timeout=10) as resp:
+                    body = resp.read().decode()
+            finally:
+                sidecar.close()
+        samples = parse_exposition(body)  # ValueError on malformed lines
+        vals = samples_by_name(samples)
+        problems = []
+        if vals.get("estorch_env_steps") != 3:
+            problems.append(
+                f"published+live composition broke: env_steps="
+                f"{vals.get('estorch_env_steps')} (want 3)")
+        if vals.get("estorch_up") != 1:
+            problems.append("fresh heartbeat did not read as up")
+        return {
+            "ok": not problems,
+            "samples": len(samples),
+            **({"problems": problems} if problems else {}),
+        }
+    except Exception as e:  # diagnostic tool: never crash the report
+        return {"ok": False, "error": repr(e)}
 
 
 # tiny host-backend ES save/restore round trip, run in a SUBPROCESS with a

@@ -18,20 +18,28 @@ explain itself (docs/observability.md):
 - **manifest** (`manifest.py`): config + jax version + device topology +
   git sha, written once per run;
 - **summarize** (`summarize.py`, ``python -m estorch_tpu.obs``): phase
-  time share, throughput trend, stall diagnosis from a run JSONL.
+  time share, throughput trend, stall diagnosis from a run JSONL;
+- **export** (`export/`): the operator-facing surfaces — Prometheus
+  text exposition (+ the jax-free ``serve-metrics`` sidecar), Perfetto
+  trace-event export (``obs trace``), and the ``obs regress`` perf gate
+  over committed ``BENCH_*.json`` baselines.
 
 ``utils.metrics`` and ``utils.profiler`` remain as re-export shims for
 backward compatibility.
 """
 
+from . import export  # noqa: F401  (prometheus/sidecar/trace/regress)
 from .counters import Counters, NullCounters
+from .export import (MetricsSidecar, export_trace, parse_exposition,
+                     render_exposition, validate_trace)
 from .manifest import collect_manifest, load_manifest, write_manifest
 from .recorder import (HEARTBEAT_ENV, STALE_AFTER_S, FlightRecorder,
                        Heartbeat, describe_heartbeat, read_heartbeat)
 from .sinks import (JsonlSink, JsonlWriter, MultiSink, MultiWriter,
                     TensorBoardSink, TensorBoardWriter)
 from .spans import NULL_TELEMETRY, Telemetry, resolve_telemetry
-from .summarize import (format_summary, load_records, selfcheck, summarize,
+from .summarize import (format_summary, load_records,
+                        load_records_tolerant, selfcheck, summarize,
                         validate_record)
 from .trace import annotate, timed_generations, trace
 
@@ -58,6 +66,13 @@ __all__ = [
     "load_manifest",
     "format_summary",
     "load_records",
+    "load_records_tolerant",
+    "export",
+    "MetricsSidecar",
+    "export_trace",
+    "validate_trace",
+    "parse_exposition",
+    "render_exposition",
     "selfcheck",
     "summarize",
     "validate_record",

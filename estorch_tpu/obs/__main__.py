@@ -1,8 +1,8 @@
-"""obs CLI: ``python -m estorch_tpu.obs summarize <run.jsonl>``.
+"""obs CLI: summarize / trace / regress / serve-metrics.
 
-Subcommands:
+Subcommands (docs/observability.md):
 
-  summarize <run.jsonl> [--heartbeat PATH] [--json]
+  summarize <run.jsonl> [--heartbeat PATH] [--manifest PATH] [--json]
       Per-phase time share, throughput trend, and stall diagnosis for a
       training-run JSONL (the ``train(log_fn=JsonlSink(...))`` output).
       ``--heartbeat`` folds a live run's last-known phase/age into the
@@ -13,7 +13,24 @@ Subcommands:
       Validate the golden record against the record schema (CI gate —
       record-schema drift fails fast here, not in a consumer).
 
-Exit codes: 0 ok; 1 selfcheck problems / unreadable input; 3 bad usage.
+  trace <run.jsonl> [-o trace.json] [--events ring.jsonl]
+      Export the run as Perfetto/Chrome trace-event JSON: phase lanes
+      per generation, supervisor-restart boundaries marked, process
+      lanes keyed by manifest provenance.  ``manifest.json`` /
+      ``heartbeat.json`` beside the JSONL are auto-discovered.
+
+  regress <current> --baseline <BENCH_*.json> [--label L] [--json]
+      Statistical perf gate: robust medians + a noise band learned from
+      repeats.  Exit 0 pass, 1 regression.  ``regress --selfcheck`` is
+      the run_lint.sh gate for the gate.
+
+  serve-metrics --run-dir DIR [--port N] [--port-file PATH]
+      Prometheus /metrics sidecar over a run directory (heartbeat +
+      supervisor-published counter totals).  On a wedged-jax host run it
+      as a file instead: ``python estorch_tpu/obs/export/sidecar.py``.
+
+Exit codes: 0 ok; 1 selfcheck problems / unreadable input / regression;
+2 bad run dir; 3 bad usage.
 """
 
 from __future__ import annotations
@@ -23,7 +40,8 @@ import json
 import os
 import sys
 
-from .summarize import format_summary, load_records, selfcheck, summarize
+from .summarize import (format_summary, load_records_tolerant, selfcheck,
+                        summarize)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -31,6 +49,7 @@ def build_parser() -> argparse.ArgumentParser:
         prog="python -m estorch_tpu.obs",
         description="observability tooling (docs/observability.md)")
     sub = p.add_subparsers(dest="cmd")
+
     s = sub.add_parser("summarize",
                        help="per-phase share + stall diagnosis of a run")
     s.add_argument("jsonl", nargs="?", default=None,
@@ -46,15 +65,73 @@ def build_parser() -> argparse.ArgumentParser:
                    help="machine-readable summary on stdout")
     s.add_argument("--selfcheck", action="store_true",
                    help="validate the golden record schema and exit")
+
+    t = sub.add_parser("trace",
+                       help="export a run JSONL as Perfetto/Chrome "
+                            "trace-event JSON")
+    t.add_argument("jsonl", help="run JSONL (one generation per line)")
+    t.add_argument("-o", "--out", default=None, metavar="PATH",
+                   help="output path (default: trace.json beside the "
+                        "JSONL)")
+    t.add_argument("--manifest", default=None, metavar="PATH",
+                   help="run manifest for restart provenance (default: "
+                        "manifest.json beside the JSONL)")
+    t.add_argument("--heartbeat", default=None, metavar="PATH",
+                   help="heartbeat file (default: heartbeat.json beside "
+                        "the JSONL)")
+    t.add_argument("--events", default=None, metavar="PATH",
+                   help="flight-recorder dump_jsonl file: rendered as a "
+                        "wall-clock marker lane")
+
+    r = sub.add_parser("regress",
+                       help="perf gate: current measurement vs a "
+                            "committed baseline")
+    r.add_argument("current", nargs="?", default=None,
+                   help="run JSONL / bench output to gate")
+    r.add_argument("--baseline", default=None, metavar="PATH",
+                   help="committed baseline (BENCH_*.json schema, bench "
+                        "line, or run JSONL)")
+    r.add_argument("--label", default=None,
+                   help="filter bench A/B rows by label on both sides")
+    r.add_argument("--min-band-pct", type=float, default=None,
+                   help="noise-band floor in percent (default 5)")
+    r.add_argument("--json", action="store_true", dest="as_json",
+                   help="verdict as one JSON line (default: human line "
+                        "+ JSON)")
+    r.add_argument("--selfcheck", action="store_true",
+                   help="prove the gate flags an injected 30%% slowdown "
+                        "and passes an identical run, then exit")
+
+    m = sub.add_parser("serve-metrics",
+                       help="Prometheus /metrics sidecar over a run dir")
+    m.add_argument("--run-dir", required=True, metavar="DIR")
+    m.add_argument("--host", default="127.0.0.1")
+    m.add_argument("--port", type=int, default=9321)
+    m.add_argument("--port-file", default=None, metavar="PATH")
+    m.add_argument("--stale-after-s", type=float, default=None)
     return p
 
 
-def main(argv: list[str] | None = None) -> int:
-    args = build_parser().parse_args(argv)
-    if args.cmd != "summarize":
-        build_parser().print_help()
-        return 3
+def _beside(jsonl: str, explicit: str | None, name: str) -> str | None:
+    if explicit is not None:
+        return explicit
+    cand = os.path.join(os.path.dirname(os.path.abspath(jsonl)), name)
+    return cand if os.path.exists(cand) else None
 
+
+def _load_tolerant(jsonl: str) -> list[dict] | None:
+    try:
+        records, dropped = load_records_tolerant(jsonl)
+    except (OSError, ValueError) as e:
+        print(f"cannot read {jsonl}: {e}", file=sys.stderr)
+        return None
+    if dropped:
+        print(f"note: dropped a truncated final line in {jsonl} "
+              "(crash artifact)", file=sys.stderr)
+    return records
+
+
+def _cmd_summarize(args) -> int:
     if args.selfcheck:
         problems = selfcheck()
         if problems:
@@ -75,26 +152,128 @@ def main(argv: list[str] | None = None) -> int:
         print("summarize needs a run JSONL (or --heartbeat PATH, or "
               "--selfcheck)", file=sys.stderr)
         return 3
-    try:
-        records = load_records(args.jsonl)
-    except (OSError, ValueError) as e:
-        print(f"cannot read {args.jsonl}: {e}", file=sys.stderr)
+    records = _load_tolerant(args.jsonl)
+    if records is None:
         return 1
-    run_dir = os.path.dirname(os.path.abspath(args.jsonl))
-    hb = args.heartbeat
-    if hb is None:
-        cand = os.path.join(run_dir, "heartbeat.json")
-        hb = cand if os.path.exists(cand) else None
-    mf = args.manifest
-    if mf is None:
-        cand = os.path.join(run_dir, "manifest.json")
-        mf = cand if os.path.exists(cand) else None
-    s = summarize(records, heartbeat_path=hb, manifest_path=mf)
+    s = summarize(records,
+                  heartbeat_path=_beside(args.jsonl, args.heartbeat,
+                                         "heartbeat.json"),
+                  manifest_path=_beside(args.jsonl, args.manifest,
+                                        "manifest.json"))
     if args.as_json:
         print(json.dumps(s, default=float))
     else:
         print(format_summary(s))
     return 0
+
+
+def _cmd_trace(args) -> int:
+    from .recorder import read_heartbeat
+    from .export.traceevent import export_trace, validate_trace, write_trace
+
+    records = _load_tolerant(args.jsonl)
+    if records is None:
+        return 1
+    manifest = None
+    mf = _beside(args.jsonl, args.manifest, "manifest.json")
+    if mf:
+        try:
+            with open(mf) as f:
+                manifest = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"note: ignoring unreadable manifest {mf}: {e}",
+                  file=sys.stderr)
+    hb_path = _beside(args.jsonl, args.heartbeat, "heartbeat.json")
+    heartbeat = read_heartbeat(hb_path) if hb_path else None
+    events = None
+    if args.events:
+        try:
+            events, dropped = load_records_tolerant(args.events)
+            if dropped:
+                print(f"note: dropped a truncated final line in "
+                      f"{args.events}", file=sys.stderr)
+        except (OSError, ValueError) as e:
+            print(f"cannot read {args.events}: {e}", file=sys.stderr)
+            return 1
+    trace = export_trace(records, manifest=manifest, events=events,
+                         heartbeat=heartbeat)
+    problems = validate_trace(trace)
+    if problems:  # exporter bug, not user error — still fail loudly
+        for pr in problems:
+            print(f"trace: invalid output: {pr}", file=sys.stderr)
+        return 1
+    out = args.out or os.path.join(
+        os.path.dirname(os.path.abspath(args.jsonl)), "trace.json")
+    write_trace(trace, out)
+    meta = trace["otherData"]
+    print(f"trace: {len(trace['traceEvents'])} events, "
+          f"{meta['generations']} generations, "
+          f"{meta['segments']} segment(s), "
+          f"{meta['restart_markers']} restart marker(s) -> {out}")
+    return 0
+
+
+def _cmd_regress(args) -> int:
+    from .export import regress as _regress
+
+    if args.selfcheck:
+        problems = _regress.selfcheck()
+        if problems:
+            for pr in problems:
+                print(f"regress selfcheck: {pr}", file=sys.stderr)
+            return 1
+        print("obs regress selfcheck: OK (flags a 30% injected slowdown, "
+              "passes an identical run)")
+        return 0
+    if not args.current or not args.baseline:
+        print("regress needs <current> --baseline PATH (or --selfcheck)",
+              file=sys.stderr)
+        return 3
+    kw = {}
+    if args.min_band_pct is not None:
+        kw["min_band_pct"] = args.min_band_pct
+    try:
+        verdict = _regress.compare_files(args.current, args.baseline,
+                                         label=args.label, **kw)
+    except (OSError, ValueError) as e:
+        print(f"regress: {e}", file=sys.stderr)
+        return 1
+    if not args.as_json:
+        word = ("REGRESSION" if verdict["verdict"] == "regress"
+                else ("pass (improved)" if verdict.get("improved")
+                      else "pass"))
+        print(f"regress: {word} — {verdict['metric']} "
+              f"{verdict['current_median']} vs baseline "
+              f"{verdict['baseline_median']} "
+              f"(drop {verdict['drop_pct']}%, band {verdict['band_pct']}%)")
+    print(json.dumps(verdict, default=float))
+    return 0 if verdict["verdict"] == "pass" else 1
+
+
+def _cmd_serve_metrics(args) -> int:
+    from .export import sidecar as _sidecar
+
+    argv = ["--run-dir", args.run_dir, "--host", args.host,
+            "--port", str(args.port)]
+    if args.port_file:
+        argv += ["--port-file", args.port_file]
+    if args.stale_after_s is not None:
+        argv += ["--stale-after-s", str(args.stale_after_s)]
+    return _sidecar.main(argv)
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.cmd == "summarize":
+        return _cmd_summarize(args)
+    if args.cmd == "trace":
+        return _cmd_trace(args)
+    if args.cmd == "regress":
+        return _cmd_regress(args)
+    if args.cmd == "serve-metrics":
+        return _cmd_serve_metrics(args)
+    build_parser().print_help()
+    return 3
 
 
 if __name__ == "__main__":

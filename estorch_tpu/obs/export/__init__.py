@@ -1,0 +1,52 @@
+"""estorch_tpu.obs.export — operator-facing surfaces over the obs hub.
+
+The hub (spans/counters/heartbeat, PR 2) made single runs explain
+themselves; this package makes the signals leave the process
+(docs/observability.md, "Export"):
+
+- **prometheus** — zero-dependency Prometheus text exposition encoder +
+  validating parser over ``Counters.snapshot()`` and heartbeat
+  freshness; served at ``/metrics`` by the serve server and by the
+  sidecar;
+- **sidecar** — a stdlib-only, jax-free metrics process over a run
+  directory (``python -m estorch_tpu.obs serve-metrics --run-dir D``;
+  file-runnable on wedged hosts), composing supervisor-published
+  cross-restart counter totals with the live child's heartbeat;
+- **traceevent** — ``obs trace run.jsonl`` → Perfetto/Chrome
+  trace-event JSON: per-generation phase lanes, restart boundaries,
+  manifest-keyed process provenance;
+- **regress** — ``obs regress`` statistical perf gate: robust medians +
+  a learned noise band against committed ``BENCH_*.json`` baselines.
+
+Every module here is importable without jax; prometheus/sidecar/regress
+are additionally importable without the package (bench.py and the
+sidecar's file-run mode load them by path).
+"""
+
+from .prometheus import (GAUGE_NAMES, is_gauge, metric_name,
+                         parse_exposition, render_exposition,
+                         samples_by_name)
+from .regress import compare, compare_files, load_measurement
+from .sidecar import (COUNTERS_FILENAME, MetricsSidecar, compose_totals,
+                      publish_counters, read_published_counters)
+from .traceevent import export_trace, validate_trace, write_trace
+
+__all__ = [
+    "GAUGE_NAMES",
+    "is_gauge",
+    "metric_name",
+    "parse_exposition",
+    "render_exposition",
+    "samples_by_name",
+    "compare",
+    "compare_files",
+    "load_measurement",
+    "COUNTERS_FILENAME",
+    "MetricsSidecar",
+    "compose_totals",
+    "publish_counters",
+    "read_published_counters",
+    "export_trace",
+    "validate_trace",
+    "write_trace",
+]

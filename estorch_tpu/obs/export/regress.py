@@ -1,0 +1,270 @@
+"""``obs regress`` — a statistical perf gate over committed baselines.
+
+The BENCH_r01–r05 trajectory has been checked by humans reading
+markdown; this module makes it gate itself: compare a current
+measurement (a run JSONL, a ``bench.py`` output line, or a bench A/B
+JSONL) against a committed baseline (the ``BENCH_*.json`` schema) and
+emit a machine-readable verdict that ``bench.py --regress`` and
+``run_lint.sh`` consume.
+
+The statistics follow the ``--obs-ab`` discipline (bench.py): single
+runs on a loaded shared-core host swing far more than any effect worth
+gating on, so verdicts compare **robust medians**, and the pass/fail
+threshold is a **noise band learned from the repeats themselves** — the
+scaled median-absolute-deviation of whichever side carries repeats
+(per-generation rates in a run JSONL, per-repeat rows in a bench
+artifact), floored at ``min_band_pct`` so a suspiciously quiet sample
+cannot manufacture false alarms.  A drop beyond the band is a
+regression; a gain beyond it is reported as an improvement (still exit
+0 — the gate is one-sided by design).
+
+Deliberately stdlib-only and importable WITHOUT the package: bench.py
+(whose driver must never import jax — the round-1 wedge lesson) loads
+this file directly, the same way it loads ``obs/recorder.py``.
+
+Accepted measurement files (auto-detected per line):
+
+* ``BENCH_r*.json``     — ``{"parsed": {"metric", "value", ...}}``
+* bench stdout line     — ``{"metric", "value", ...}``
+* bench A/B JSONL rows  — ``{"label", "rate", ...}`` (``--label``
+  filters; rows with null rate are skipped)
+* run JSONL records     — ``{"generation", "env_steps_per_sec", ...}``
+  (supervisor-replayed generations are deduped, keeping the last)
+"""
+
+from __future__ import annotations
+
+import json
+import math
+
+DEFAULT_MIN_BAND_PCT = 5.0
+REGRESS_SCHEMA = 1
+
+
+def _median(xs: list[float]) -> float:
+    s = sorted(xs)
+    n = len(s)
+    if n == 0:
+        return float("nan")
+    return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
+
+
+def _noise_band_pct(xs: list[float]) -> float:
+    """Relative noise of one sample set as a percentage of its median:
+    1.4826·MAD/median (the robust sigma estimate) — 0 when there are
+    fewer than 3 repeats to learn from."""
+    if len(xs) < 3:
+        return 0.0
+    med = _median(xs)
+    if not med or not math.isfinite(med):
+        return 0.0
+    mad = _median([abs(x - med) for x in xs])
+    return 100.0 * 1.4826 * mad / abs(med)
+
+
+def extract_samples(lines: list[dict], label: str | None = None
+                    ) -> tuple[list[float], str]:
+    """(samples, metric name) from parsed measurement lines (see module
+    docstring for the accepted shapes).  Raises ValueError when nothing
+    usable is found — a gate that silently passes on an empty file is
+    worse than no gate."""
+    samples: list[float] = []
+    metric = "env_steps_per_sec"
+    gen_last: dict[int, float] = {}  # replay dedup: last occurrence wins
+    order: list[int] = []
+    for row in lines:
+        if not isinstance(row, dict):
+            continue
+        if label is not None and row.get("label") not in (None, label):
+            continue
+        parsed = row.get("parsed")
+        if isinstance(parsed, dict) and isinstance(
+                parsed.get("value"), (int, float)):
+            samples.append(float(parsed["value"]))
+            metric = str(parsed.get("metric", metric))
+        elif isinstance(row.get("value"), (int, float)) and "metric" in row:
+            samples.append(float(row["value"]))
+            metric = str(row["metric"])
+        elif isinstance(row.get("rate"), (int, float)):
+            samples.append(float(row["rate"]))
+            metric = "rate"
+        elif isinstance(row.get("env_steps_per_sec"), (int, float)):
+            g = row.get("generation")
+            if isinstance(g, int):
+                if g not in gen_last:
+                    order.append(g)
+                gen_last[g] = float(row["env_steps_per_sec"])
+            else:
+                samples.append(float(row["env_steps_per_sec"]))
+    samples.extend(gen_last[g] for g in order)
+    samples = [s for s in samples if math.isfinite(s)]
+    if not samples:
+        raise ValueError(
+            "no usable samples (expected BENCH_*.json 'parsed.value', a "
+            "bench {'metric','value'} line, {'rate'} rows, or run-JSONL "
+            "'env_steps_per_sec' records)")
+    return samples, metric
+
+
+def load_measurement(path: str, label: str | None = None
+                     ) -> tuple[list[float], str]:
+    """Read one measurement file (JSON object or JSONL) into samples.
+    A truncated FINAL line (crash artifact) is tolerated; garbage
+    earlier in the file is an error."""
+    with open(path) as f:
+        text = f.read()
+    lines = [ln for ln in text.splitlines() if ln.strip()]
+    rows: list[dict] = []
+    if not lines:
+        raise ValueError(f"{path}: empty file")
+    try:
+        # whole-file JSON first: BENCH_*.json is an indented object
+        rows = [json.loads(text)]
+    except ValueError:
+        for i, ln in enumerate(lines):
+            try:
+                rows.append(json.loads(ln))
+            except ValueError as e:
+                if i == len(lines) - 1:
+                    break  # truncated tail: a crash mid-append
+                raise ValueError(f"{path} line {i + 1}: {e}") from e
+    try:
+        return extract_samples(rows, label=label)
+    except ValueError as e:
+        raise ValueError(f"{path}: {e}") from e
+
+
+def compare(current: list[float], baseline: list[float],
+            metric: str = "rate",
+            min_band_pct: float = DEFAULT_MIN_BAND_PCT) -> dict:
+    """Median-vs-median verdict with a learned noise band.
+
+    ``verdict``: ``"pass"`` | ``"regress"``; ``drop_pct`` is positive
+    when the current measurement is slower than the baseline.
+    """
+    cur_med = _median(current)
+    base_med = _median(baseline)
+    band = max(float(min_band_pct),
+               _noise_band_pct(current), _noise_band_pct(baseline))
+    drop = ((base_med - cur_med) / base_med * 100.0) if base_med else 0.0
+    verdict = "regress" if drop > band else "pass"
+    return {
+        "schema": REGRESS_SCHEMA,
+        "verdict": verdict,
+        "metric": metric,
+        "current_median": round(cur_med, 3),
+        "baseline_median": round(base_med, 3),
+        "drop_pct": round(drop, 2),
+        "band_pct": round(band, 2),
+        "n_current": len(current),
+        "n_baseline": len(baseline),
+        "improved": drop < -band,
+    }
+
+
+def compare_files(current_path: str, baseline_path: str,
+                  label: str | None = None,
+                  min_band_pct: float = DEFAULT_MIN_BAND_PCT) -> dict:
+    cur, metric = load_measurement(current_path, label=label)
+    base, base_metric = load_measurement(baseline_path, label=label)
+    out = compare(cur, base, metric=metric, min_band_pct=min_band_pct)
+    if base_metric != metric:
+        out["warning"] = (f"metric mismatch: current={metric!r} "
+                          f"baseline={base_metric!r}")
+    return out
+
+
+# ---------------------------------------------------------------------
+# selfcheck: the run_lint.sh gate for the gate
+# ---------------------------------------------------------------------
+
+def selfcheck() -> list[str]:
+    """Prove the gate can tell signal from noise ([] = healthy):
+
+    * an identical-run comparison (same samples both sides) passes;
+    * a same-distribution rerun (fresh ±2% noise) passes;
+    * a 30% slowdown injected into a copied baseline is flagged;
+    * the file round trip (BENCH-style baseline vs run-JSONL current)
+      produces the same verdicts the in-memory path does.
+    """
+    import os
+    import random
+    import tempfile
+
+    problems: list[str] = []
+
+    def synth(seed: int, scale: float = 1.0, n: int = 12) -> list[float]:
+        rng = random.Random(seed)
+        return [1000.0 * scale * (1.0 + rng.uniform(-0.02, 0.02))
+                for _ in range(n)]
+
+    base = synth(0)
+    same = compare(list(base), list(base))
+    if same["verdict"] != "pass" or abs(same["drop_pct"]) > 1e-9:
+        problems.append(f"identical-run comparison did not pass: {same}")
+    rerun = compare(synth(1), base)
+    if rerun["verdict"] != "pass":
+        problems.append(f"same-distribution rerun flagged as regression: "
+                        f"{rerun}")
+    slow = compare(synth(2, scale=0.70), base)
+    if slow["verdict"] != "regress" or slow["drop_pct"] < 20.0:
+        problems.append(f"30% injected slowdown not flagged: {slow}")
+    fast = compare(synth(3, scale=1.30), base)
+    if fast["verdict"] != "pass" or not fast["improved"]:
+        problems.append(f"30% speedup misreported: {fast}")
+
+    with tempfile.TemporaryDirectory() as d:
+        # committed-baseline schema (a copied BENCH_*.json with the
+        # synthetic slowdown injected into the current side)
+        base_path = os.path.join(d, "BENCH_base.json")
+        with open(base_path, "w") as f:
+            json.dump({"n": 1, "rc": 0, "parsed": {
+                "metric": "env_steps_per_sec_per_chip",
+                "value": 1000.0, "unit": "env-steps/s/chip"}}, f)
+
+        def write_run(path: str, rates: list[float]) -> None:
+            with open(path, "w") as f:
+                for g, r in enumerate(rates):
+                    f.write(json.dumps({
+                        "generation": g, "env_steps_per_sec": r,
+                        "env_steps": 1000, "wall_time_s": 1000 / r,
+                        "reward_mean": 0.0, "reward_max": 0.0,
+                        "best_reward": 0.0}) + "\n")
+
+        clean_path = os.path.join(d, "clean.jsonl")
+        write_run(clean_path, synth(4))
+        v = compare_files(clean_path, base_path)
+        if v["verdict"] != "pass":
+            problems.append(f"clean run vs committed baseline failed: {v}")
+        slow_path = os.path.join(d, "slow.jsonl")
+        write_run(slow_path, synth(5, scale=0.70))
+        v = compare_files(slow_path, base_path)
+        if v["verdict"] != "regress":
+            problems.append(f"slowed run vs committed baseline passed: {v}")
+        # a replayed generation (supervisor restart) must be deduped, not
+        # averaged in twice
+        with open(clean_path, "a") as f:
+            f.write(json.dumps({"generation": 0,
+                                "env_steps_per_sec": 1.0}) + "\n")
+        cur, _ = load_measurement(clean_path)
+        if len(cur) != 12:
+            problems.append(f"replay dedup kept {len(cur)} samples, not 12")
+        if min(cur) != 1.0:
+            problems.append("replay dedup did not keep the LAST occurrence")
+        # truncated tail (crash artifact) tolerated; empty file is an error
+        with open(clean_path, "a") as f:
+            f.write('{"generation": 99, "env_ste')
+        try:
+            load_measurement(clean_path)
+        except ValueError as e:
+            problems.append(f"truncated tail not tolerated: {e}")
+        empty = os.path.join(d, "empty.jsonl")
+        open(empty, "w").close()
+        empty_raised = False
+        try:
+            load_measurement(empty)
+        except ValueError:
+            empty_raised = True
+        if not empty_raised:
+            problems.append("empty measurement file did not raise")
+    return problems

@@ -62,10 +62,30 @@ class FlightRecorder:
         return len(self._ring)
 
     def dump_jsonl(self, path: str) -> None:
-        """Append the ring to a JSONL file (crash-dump / post-mortem)."""
-        with open(path, "a") as f:
+        """Append the ring to a JSONL file (crash-dump / post-mortem).
+
+        Atomic (same tmp + ``os.replace`` contract as :class:`Heartbeat`):
+        the append is staged by copying the existing file into ``.tmp``,
+        writing the ring after it, then renaming over ``path`` — a crash
+        mid-dump leaves either the previous complete file or the new
+        complete file, never a truncated JSONL for the metrics sidecar or
+        ``obs trace --events`` to choke on."""
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            if os.path.exists(path):  # single-writer file: no TOCTOU race
+                with open(path) as old:
+                    prev = old.read()
+                if prev and not prev.endswith("\n"):
+                    # a pre-atomic-era torn tail is one lost partial
+                    # event: DROP it — newline-terminating it would move
+                    # the malformed line mid-file, where tolerant readers
+                    # rightly treat it as corruption, not a crash artifact
+                    cut = prev.rfind("\n")
+                    prev = prev[:cut + 1] if cut >= 0 else ""
+                f.write(prev)
             for ev in self._ring:
                 f.write(json.dumps(ev, default=float) + "\n")
+        os.replace(tmp, path)
 
 
 class Heartbeat:

@@ -97,6 +97,31 @@ def load_records(path: str) -> list[dict]:
         return [json.loads(line) for line in f if line.strip()]
 
 
+def load_records_tolerant(path: str) -> tuple[list[dict], int]:
+    """Like :func:`load_records`, but a malformed FINAL line is dropped
+    instead of raised: an append-only run JSONL whose writer crashed (or
+    was SIGKILLed — the supervised case) legitimately ends in a partial
+    line, and the post-mortem tools (`obs summarize`, `obs trace`) exist
+    for exactly those runs.  Returns ``(records, n_dropped)`` so the CLI
+    can say the tail was dropped; garbage EARLIER in the file still
+    raises — that is corruption, not a crash artifact."""
+    with open(path) as f:
+        lines = [(i, ln) for i, ln in enumerate(f.read().splitlines(), 1)
+                 if ln.strip()]
+    records: list[dict] = []
+    for pos, (lineno, ln) in enumerate(lines):
+        try:
+            records.append(json.loads(ln))
+        except ValueError as e:
+            if pos == len(lines) - 1 and records:
+                # a crash artifact is a torn tail BEHIND valid records;
+                # a file whose only line is malformed is the wrong file,
+                # not a truncated run
+                return records, 1
+            raise ValueError(f"line {lineno}: {e}") from e
+    return records, 0
+
+
 def _median(xs: list[float]) -> float:
     s = sorted(xs)
     n = len(s)

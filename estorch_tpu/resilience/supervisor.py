@@ -218,8 +218,10 @@ class Supervisor:
     belongs (e.g. ``force_cpu_backend`` before building the ES).
 
     The checkpoint directory ``ckpt_root`` is the unit of resumability:
-    heartbeat, run JSONL, manifest, and ``gen_*`` checkpoints all live
-    there, so a run's post-mortem is one directory.
+    heartbeat, run JSONL, manifest, published counter totals
+    (``counters.json``, scraped by the obs metrics sidecar), and
+    ``gen_*`` checkpoints all live there, so a run's post-mortem is one
+    directory.
     """
 
     def __init__(
@@ -263,6 +265,8 @@ class Supervisor:
         self.verbose = bool(verbose)
         self.restarts: list[dict] = []
         self._counters_total: dict[str, float] = {}
+        self._counters_through_ts = 0.0
+        self._publish_error: str | None = None
         self._child = None
         self._stop_requested = False
         self._stop_signaled = False
@@ -317,6 +321,17 @@ class Supervisor:
             child.start()
             self._child = child
             failure = self._watch(child, started)
+            if failure is not None and not self._stop_requested:
+                # record the restart BEFORE folding+publishing counters,
+                # so the sidecar's restart_count gauge counts this death
+                # for the whole next child's lifetime, not one publish late
+                self.restarts.append({
+                    "ts": time.time(),
+                    "attempt": attempt,
+                    "reason": failure,
+                    "exitcode": child.exitcode,
+                    "heartbeat": read_heartbeat(self.heartbeat_path),
+                })
             self._accumulate_counters(started)
             if failure is None:
                 ok = True
@@ -334,13 +349,6 @@ class Supervisor:
                     and child.exitcode == -int(_signal.SIGTERM))
                 reason = None if ok else failure
                 break
-            self.restarts.append({
-                "ts": time.time(),
-                "attempt": attempt,
-                "reason": failure,
-                "exitcode": child.exitcode,
-                "heartbeat": read_heartbeat(self.heartbeat_path),
-            })
             attempt += 1
             if attempt > self.max_restarts:
                 reason = failure
@@ -414,19 +422,53 @@ class Supervisor:
         SIGKILLed child's ``generations_rejected`` survives its death.
         A beat older than this child's start is a PREVIOUS child's file
         (the child died before beating) — counting it again would
-        double-count that child's totals."""
+        double-count that child's totals.
+
+        The totals are also PUBLISHED atomically (``counters.json`` in
+        the run dir, obs/export/sidecar.py) so the metrics sidecar can
+        keep answering scrapes with monotone totals across the restart:
+        the published ``through_ts`` tells the sidecar which heartbeat
+        is already folded in, so a dead child's final beat is never
+        counted twice."""
         hb = read_heartbeat(self.heartbeat_path)
-        if hb is None or float(hb.get("ts", 0.0)) < started:
-            return
-        for name, val in (hb.get("counters") or {}).items():
-            if isinstance(val, (int, float)):
-                self._counters_total[name] = (
-                    self._counters_total.get(name, 0) + val
-                )
+        if hb is not None and float(hb.get("ts", 0.0)) >= started:
+            for name, val in (hb.get("counters") or {}).items():
+                if isinstance(val, (int, float)):
+                    self._counters_total[name] = (
+                        self._counters_total.get(name, 0) + val
+                    )
+            self._counters_through_ts = float(hb.get("ts", 0.0))
+        # publish even when this child never beat (wedged import killed
+        # by the startup grace): the restart_count the sidecar scrapes
+        # must count that death too, not wait for a later child's beat
+        self._publish_counters(through_ts=self._counters_through_ts)
+
+    def _publish_counters(self, through_ts: float,
+                          completed: bool | None = None) -> None:
+        from ..obs.export.sidecar import publish_counters
+
+        extra: dict = {"restart_count": len(self.restarts)}
+        if completed is not None:
+            extra["completed"] = completed
+        try:
+            publish_counters(self.ckpt_root, self._counters_total,
+                             through_ts, extra=extra)
+            self._publish_error = None
+        except OSError as e:
+            # best-effort observability: a full disk must not become a
+            # supervision failure — but the evidence rides the manifest
+            self._publish_error = repr(e)
 
     def _write_provenance(self, ok: bool) -> None:
         """Merge restart provenance into the run manifest (atomic write —
         readers racing a restart never see a partial file)."""
+        # final published snapshot FIRST: scrapes after the run ends get
+        # the full cross-restart totals + completion verdict, and a
+        # publish failure here still lands in the manifest written below
+        # ("the evidence rides the manifest" — it can't if the manifest
+        # is already closed)
+        self._publish_counters(through_ts=self._counters_through_ts,
+                               completed=ok)
         data: dict = {}
         try:
             with open(self.manifest_path) as f:
@@ -440,6 +482,9 @@ class Supervisor:
             "restarts": self.restarts,
             "counters": dict(self._counters_total),
         }
+        if self._publish_error:
+            data["resilience"]["counters_publish_error"] = \
+                self._publish_error
         tmp = self.manifest_path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(data, f, indent=2, default=float)

@@ -9,6 +9,8 @@
   beat age) + queue/counter snapshot; 503 while draining;
 * ``GET /stats``     — full serving counters, bucket ladder, bundle
   provenance;
+* ``GET /metrics``   — Prometheus text exposition of the same counters
+  (obs/export/prometheus.py) + heartbeat freshness, for scrapers;
 * ``POST /reload``   — ``{"path": "<bundle dir>"}`` hot-swaps the bundle
   atomically: the new bundle loads and warms OFF the serving path, the
   swap is one reference assignment, and the old batcher drains its
@@ -79,7 +81,9 @@ class PolicyServer:
         self.max_queue = int(max_queue)
         self.request_timeout_s = float(request_timeout_s)
         self.warm = bool(warm)
-        self.started_unix = time.time()
+        # monotonic: uptime is an elapsed measure (esguard R09 — an NTP
+        # step must not make a healthy server report negative uptime)
+        self._started_mono = time.monotonic()
         self.draining = False
         self._inflight = 0
         self._inflight_lock = threading.Lock()
@@ -174,7 +178,7 @@ class PolicyServer:
             "draining": self.draining,
             "version": eng.bundle.version,
             "bundle": eng.bundle.path,
-            "uptime_s": round(time.time() - self.started_unix, 3),
+            "uptime_s": round(time.monotonic() - self._started_mono, 3),
             "pid": os.getpid(),
             "queue_depth": eng.batcher._q.qsize(),
             "requests_total": int(c.get("requests_total")),
@@ -190,6 +194,29 @@ class PolicyServer:
                                     "age_s": round(beat["age_s"], 3),
                                     "phase": beat.get("phase")}
         return out
+
+    def metrics(self) -> str:
+        """Prometheus text exposition of the serving counters (the
+        `/metrics` body; obs/export/prometheus.py).  `estorch_up` is 1
+        while not draining — this process answering IS the liveness; the
+        heartbeat facts ride along when a heartbeat path is configured
+        so scrapes and the PR-3 watchdog agree on staleness."""
+        from ..obs.export.prometheus import render_exposition
+        from ..obs.recorder import read_heartbeat
+
+        eng = self._engine
+        hb = (read_heartbeat(self.obs.heartbeat.path)
+              if self.obs.heartbeat is not None else None)
+        return render_exposition(
+            self.obs.counters.snapshot(), hb,
+            extra_gauges={
+                "queue_depth": eng.batcher._q.qsize(),
+                "uptime_seconds": round(
+                    time.monotonic() - self._started_mono, 3),
+                "draining": 1.0 if self.draining else 0.0,
+            },
+            up=not self.draining,
+        )
 
     def stats(self) -> dict:
         eng = self._engine
@@ -277,6 +304,18 @@ def _make_handler(server: PolicyServer):
                 self._reply(200 if h["ok"] else 503, h)
             elif self.path == "/stats":
                 self._reply(200, server.stats())
+            elif self.path == "/metrics":
+                body = server.metrics().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4; "
+                                 "charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                if server.draining:
+                    self.send_header("Connection", "close")
+                    self.close_connection = True
+                self.end_headers()
+                self.wfile.write(body)
             else:
                 self._reply(404, {"error": f"no route {self.path!r}"})
 

@@ -89,9 +89,10 @@ def main(argv=None):
             print(f"skip (done): {label}", file=sys.stderr)
             continue
         cfg = {**base, **over}
-        t0 = time.time()
+        t0 = time.monotonic()  # elapsed measure: wall clock steps (R09)
         out = bench.run_stage_detailed(cfg, timeout_s=args.timeout_s)
-        line = {"label": label, **out, "wall_s": round(time.time() - t0, 1)}
+        line = {"label": label, **out,
+                "wall_s": round(time.monotonic() - t0, 1)}
         with open(args.out, "a") as f:
             f.write(json.dumps(line) + "\n")
         print(json.dumps({k: line[k] for k in ("label", "rate", "wall_s")

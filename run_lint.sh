@@ -17,6 +17,14 @@ echo "== obs selfcheck =="
 # before a JSONL consumer parses mismatched records
 python -m estorch_tpu.obs summarize --selfcheck
 
+echo "== obs regress selfcheck =="
+# perf-gate gate (estorch_tpu/obs/export/regress.py): the statistical
+# regression detector must flag a synthetic 30% slowdown injected into a
+# copied baseline AND pass an identical-run comparison — a gate that can
+# do neither would either cry wolf on every loaded-host run or wave real
+# regressions through.  Pure stdlib, milliseconds.
+python -m estorch_tpu.obs regress --selfcheck
+
 echo "== chaos selfcheck =="
 # recovery-path gate (estorch_tpu/resilience, docs/resilience.md): a tiny
 # host-backend run under a worker-kill chaos plan must keep FULL

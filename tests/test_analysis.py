@@ -785,6 +785,84 @@ class TestR08:
 
 
 # ---------------------------------------------------------------------
+# R09 nonmonotonic-span-clock
+# ---------------------------------------------------------------------
+
+class TestR09:
+    def test_local_wall_clock_span_flagged(self):
+        found = findings("""
+            import time
+
+            def span():
+                t0 = time.time()
+                work()
+                return time.time() - t0
+        """, "R09")
+        assert len(found) == 1
+        assert "wall clock" in found[0].message
+
+    def test_self_attr_wall_clock_span_flagged(self):
+        """The serving idiom: start stamped in __init__, delta taken in
+        another method — the uptime bug this rule's self-apply fixed in
+        serve/server.py."""
+        found = findings("""
+            import time
+
+            class Server:
+                def __init__(self):
+                    self.started = time.time()
+
+                def uptime(self):
+                    return time.time() - self.started
+        """, "R09")
+        assert len(found) == 1
+        assert found[0].symbol == "Server.uptime"
+
+    def test_perf_counter_span_clean(self):
+        assert not findings("""
+            import time
+
+            def span():
+                t0 = time.perf_counter()
+                work()
+                return time.perf_counter() - t0
+        """, "R09")
+
+    def test_monotonic_deadline_clean(self):
+        assert not findings("""
+            import time
+
+            def wait(deadline_s):
+                t0 = time.monotonic()
+                while time.monotonic() - t0 < deadline_s:
+                    poll()
+        """, "R09")
+
+    def test_cross_process_age_from_file_clean(self):
+        """The heartbeat reader: the start timestamp crosses a process
+        boundary (written by another pid), so wall clock is REQUIRED —
+        an untyped start read from a dict must stay silent."""
+        assert not findings("""
+            import json
+            import time
+
+            def heartbeat_age(path):
+                with open(path) as f:
+                    hb = json.load(f)
+                return time.time() - hb["ts"]
+        """, "R09")
+
+    def test_wall_timestamp_without_delta_clean(self):
+        assert not findings("""
+            import time
+
+            def stamp(record):
+                record["ts"] = time.time()
+                return record
+        """, "R09")
+
+
+# ---------------------------------------------------------------------
 # engine / CLI / config / baseline mechanics
 # ---------------------------------------------------------------------
 
@@ -809,7 +887,7 @@ class TestEngine:
     def test_every_rule_registered(self):
         ids = [r.id for r in all_rules()]
         assert ids == ["R01", "R02", "R03", "R04", "R05", "R06", "R07",
-                       "R08"]
+                       "R08", "R09"]
 
     def test_syntax_error_becomes_finding(self):
         found = analyze_source("bad.py", "def broken(:\n")
@@ -942,7 +1020,7 @@ class TestConfig:
         cfg = load_config(os.path.join(root, "pyproject.toml"))
         assert cfg.baseline == "esguard_baseline.json"
         assert cfg.rule_ids([r.id for r in all_rules()]) == [
-            "R01", "R02", "R03", "R04", "R05", "R06", "R07", "R08"]
+            "R01", "R02", "R03", "R04", "R05", "R06", "R07", "R08", "R09"]
 
 
 class TestCLI:

@@ -110,6 +110,29 @@ class TestObsCheck:
         assert out["heartbeat"]["stale"] is True
         assert out["heartbeat"]["age_s"] > STALE_AFTER_S
 
+    def test_export_probe_scrapes_and_parses(self):
+        """The export probe: loopback-scrape the metrics sidecar over a
+        synthetic temp run-dir and validate the exposition parses, with
+        the published+live counter composition checked end to end."""
+        out = doctor.check_obs()
+        probe = out["export"]
+        assert probe["ok"] is True, probe
+        assert probe["samples"] > 0
+
+    def test_export_probe_failure_is_reported_not_raised(self,
+                                                         monkeypatch):
+        """A diagnostic tool never crashes the report — a broken sidecar
+        surfaces as ok=False with the error."""
+        from estorch_tpu.obs.export import sidecar as sidecar_mod
+
+        def boom(*a, **k):
+            raise RuntimeError("bind refused")
+
+        monkeypatch.setattr(sidecar_mod.MetricsSidecar, "__init__", boom)
+        probe = doctor.check_obs()["export"]
+        assert probe["ok"] is False
+        assert "bind refused" in probe["error"]
+
 
 class TestResilienceCheck:
     def test_config_checks_without_probe(self, tmp_path, monkeypatch):

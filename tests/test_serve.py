@@ -543,6 +543,31 @@ class TestServerEndpoints:
             assert s["recompiles"] <= len(s["buckets"]) + len(
                 s["buckets_excluded"])
 
+    def test_metrics_exposition_scrapeable(self, live_server):
+        """GET /metrics speaks Prometheus text exposition: the serving
+        counters as estorch_-prefixed samples, validated by the parser
+        that did not write them (obs/export/prometheus.py)."""
+        import urllib.request
+
+        from estorch_tpu.obs.export.prometheus import (parse_exposition,
+                                                       samples_by_name)
+
+        with ServeClient(f"{live_server.host}:{live_server.port}") as c:
+            obs = np.zeros(3, np.float32)
+            c.predict(obs)  # at least one served request on the counters
+        url = f"http://{live_server.host}:{live_server.port}/metrics"
+        with urllib.request.urlopen(url, timeout=30) as r:
+            assert r.status == 200
+            assert "text/plain" in r.headers["Content-Type"]
+            body = r.read().decode()
+        vals = samples_by_name(parse_exposition(body))
+        assert vals["estorch_requests_total"] >= 1
+        assert vals["estorch_up"] == 1  # serving and not draining
+        assert vals["estorch_uptime_seconds"] >= 0
+        assert "estorch_queue_depth" in vals
+        assert "# TYPE estorch_requests_total counter" in body
+        assert "# TYPE estorch_queue_depth gauge" in body
+
     def test_bad_requests_are_4xx(self, live_server):
         with ServeClient(f"{live_server.host}:{live_server.port}") as c:
             with pytest.raises(ServeError) as ei:
