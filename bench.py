@@ -13,13 +13,21 @@ extras: a Humanoid-sized-policy point (SyntheticEnv obs 376 → 256×256 → 17,
 the __graft_entry__ flagship shape), a pop-10240 point, and a
 physics-on-chip locomotion point (Cheetah2D — never terminates, so its
 step counts carry the same honesty property; its MFU counts policy-forward
-FLOPs only, not the physics).  "mfu" is always policy-forward FLOPs against the v5e bf16 peak
-(197 TFLOP/s) regardless of config dtype — one fixed denominator keeps
-cross-dtype A/B numbers comparable — and is null off-TPU (a CPU rate
-against a TPU peak means nothing).  When the TPU path fails and the
-headline falls back to CPU, the extras instead carry the same scaling
-points measured on the CPU mesh, each tagged ``cpu_relative: true`` —
-comparable to each other and to bench_ab_cpu.jsonl, never to TPU numbers.
+FLOPs only, not the physics).  "mfu" is policy-forward FLOPs against the
+platform roofline: on TPU the fixed v5e bf16 peak (197 TFLOP/s)
+regardless of config dtype — one fixed denominator keeps cross-dtype A/B
+numbers comparable — and off-TPU this host's MEASURED GEMM ceiling
+(obs/profile/roofline.py), tagged ``mfu_basis: cpu_calibrated`` so a
+fraction of a loaded host's real capability is never read against
+accelerator silicon.  Per-phase achieved rates and the compile ledger
+ride each row (``phases`` / ``compile``).  When the TPU path fails the
+headline falls back to CPU — decided by the typed device probe
+(doctor.check_device: alive-or-wedged in seconds with a no-device /
+init-hang / compile-hang / exec-hang reason, recorded in
+extras["device_probe"]) rather than discovered by a 480s stage timeout —
+and the extras carry the same scaling points measured on the CPU mesh,
+each tagged ``cpu_relative: true`` — comparable to each other and to
+bench_ab_cpu.jsonl, never to TPU numbers.
 
 vs_baseline: ratio against a reference-style estorch loop measured live on
 this host — per-member Python loop, torch CPU MLP forward per step,
@@ -124,7 +132,79 @@ def _load_obs_regress():
     return _load_repo_module("_estorch_obs_regress",
                              "estorch_tpu", "obs", "export", "regress.py")
 
-V5E_BF16_PEAK = 197e12  # TPU v5e per-chip bf16 peak FLOP/s
+
+def _load_doctor():
+    """estorch_tpu/doctor.py by file: check_device (the typed staged
+    probe the platform decision reads) is stdlib-only — the whole module
+    imports jax-free, same contract as the recorder/regress loads."""
+    return _load_repo_module("_estorch_doctor", "estorch_tpu", "doctor.py")
+
+
+# ---------------------------------------------------------------------
+# crash-durable scratch: per-driver-pid workdir + stale-artifact sweep
+# ---------------------------------------------------------------------
+
+_BENCH_TMP_ROOT = os.path.join(tempfile.gettempdir(), "estorch_bench")
+
+
+def _bench_workdir() -> str:
+    """Per-process scratch dir for crash-durable buffers (the buffered
+    fallback stderr, stage heartbeats).  Kept when this process dies a
+    fatal-signal death (the diagnostics must survive the crash), removed
+    on clean driver exit, and swept by :func:`_sweep_stale_bench_dirs`
+    on the NEXT driver run once the owning pid is gone — so crashed runs
+    cannot accumulate in the temp dir forever."""
+    d = os.path.join(_BENCH_TMP_ROOT, str(os.getpid()))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True  # exists, someone else's
+    return True
+
+
+def _sweep_stale_bench_dirs() -> None:
+    """Remove bench scratch left by CRASHED prior runs: per-pid workdirs
+    whose owner is gone, plus the legacy flat-file buffers
+    (``bench_stderr_<pid>.log`` / ``bench_hb_<pid>_*.json``) older
+    drivers wrote straight into the temp dir."""
+    import glob
+    import re as _re
+    import shutil
+
+    if os.path.isdir(_BENCH_TMP_ROOT):
+        for name in os.listdir(_BENCH_TMP_ROOT):
+            path = os.path.join(_BENCH_TMP_ROOT, name)
+            try:
+                pid = int(name)
+            except ValueError:
+                continue  # not ours to judge
+            if not _pid_alive(pid):
+                shutil.rmtree(path, ignore_errors=True)
+    tmp = tempfile.gettempdir()
+    for pattern in ("bench_stderr_*.log", "bench_hb_*.json"):
+        for path in glob.glob(os.path.join(tmp, pattern)):
+            m = _re.search(r"_(\d+)", os.path.basename(path))
+            if m and not _pid_alive(int(m.group(1))):
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+
+
+def _cleanup_bench_workdir() -> None:
+    """Clean-exit removal of this process's scratch dir (a crash skips
+    this by construction — that is the point of the buffers)."""
+    import shutil
+
+    shutil.rmtree(os.path.join(_BENCH_TMP_ROOT, str(os.getpid())),
+                  ignore_errors=True)
 
 # The XLA:CPU persistent-cache loader logs an E-level machine-feature dump
 # even for same-machine pseudo-feature mismatches (+prefer-no-scatter etc.,
@@ -154,10 +234,11 @@ def _filtered_stderr():
     it, only an fd-level redirect can.  The buffer is a NAMED on-disk file
     announced up front: a fatal signal mid-fallback (abort/SIGKILL — the
     finally never runs) leaves the full unfiltered diagnostics at that
-    path instead of destroying them with an anonymous tempfile."""
-    path = os.path.join(
-        tempfile.gettempdir(), f"bench_stderr_{os.getpid()}.log"
-    )
+    path instead of destroying them with an anonymous tempfile.  It lives
+    under the per-pid bench workdir (cleaned on a clean exit, swept as
+    stale by the next driver run once this pid dies) so crashed runs
+    don't accumulate loose logs in the temp dir."""
+    path = os.path.join(_bench_workdir(), "fallback_stderr.log")
     print(f"bench: cpu-fallback stderr buffered at {path} (kept on crash)",
           file=sys.stderr)
     sys.stderr.flush()
@@ -291,13 +372,59 @@ def measure_one(cfg, force_cpu=False):
     peak_rss = round(
         resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / rss_div, 3
     )
+
+    # MFU is no longer null off-chip: on TPU it keeps the fixed v5e bf16
+    # denominator (cross-dtype comparability); on CPU the denominator is
+    # this host's MEASURED GEMM peak (obs/profile/roofline.py), tagged
+    # cpu_calibrated so nobody reads it against accelerator silicon
+    from estorch_tpu.obs.profile import platform_roofline, profile_records
+
+    flops_per_step = policy_flops_per_member_step(cfg)
+    if platform == "tpu":
+        roof = platform_roofline("tpu")
+        mfu = rate * flops_per_step / roof["peak_flops_per_s"]
+        mfu_basis = roof["basis"]
+    else:
+        # cpu gets the measured host ceiling; any OTHER platform gets
+        # None-peaks (platform_roofline refuses to hand a gpu the host
+        # CPU's GEMM rate as a denominator) and mfu stays null there
+        roof = platform_roofline(platform)
+        peak = roof.get("peak_flops_per_s")
+        # whole-host utilization: total steps/s (not per-chip — the CPU
+        # "chips" are virtual devices time-slicing this host) against
+        # the host's measured ceiling
+        mfu = (rate * n_chips * flops_per_step / peak) if peak else None
+        mfu_basis = roof.get("basis") if peak else None
+
+    # per-phase attribution of the measured generations (obs/profile/):
+    # seconds share + achieved FLOP/s per phase, the compile ledger, and
+    # the analytic-vs-XLA cross-check ride the bench row
+    phases = None
+    compile_block = None
+    try:
+        # full history, not just the timed window: the compile ledger
+        # flushed into the warm-up generation's record, and the warm-up's
+        # spans are as representative as the timed ones for attribution
+        prof = profile_records(es.history, roof,
+                               cost_model=es.obs.cost_model)
+        phases = {
+            name: {k: (round(v, 8) if isinstance(v, float) else v)
+                   for k, v in row.items()
+                   if k in ("share", "seconds", "flops_per_s", "mfu",
+                            "arith_intensity", "bound")}
+            for name, row in (prof.get("phases") or {}).items()
+        }
+        compile_block = prof.get("compile")
+    except Exception as e:  # noqa: BLE001 — attribution must not kill a row
+        print(f"bench: phase attribution failed: {e!r}", file=sys.stderr)
     return {
         "rate": rate,
         "platform": platform,
         "dtype": dtype,
-        # fixed bf16-peak denominator (see module docstring); null off-TPU
-        "mfu": (rate * policy_flops_per_member_step(cfg) / V5E_BF16_PEAK
-                if platform == "tpu" else None),
+        "mfu": round(mfu, 8) if mfu is not None else None,
+        "mfu_basis": mfu_basis,
+        "phases": phases,
+        "compile": compile_block,
         "peak_hbm_gb": peak_hbm,
         "peak_rss_gb": peak_rss,
         "cfg": cfg,
@@ -343,8 +470,8 @@ def run_stage_detailed(cfg, timeout_s=480, force_cpu=False):
     phase + generation + heartbeat age instead of a guess — "wedged in
     phase=device at gen 0, silent for 470s" vs "slow but beating"."""
     hb_path = os.path.join(
-        tempfile.gettempdir(),
-        f"bench_hb_{os.getpid()}_{abs(hash(json.dumps(cfg, sort_keys=True))) % 10**8}.json",
+        _bench_workdir(),
+        f"hb_{abs(hash(json.dumps(cfg, sort_keys=True))) % 10**8}.json",
     )
     try:
         argv = [sys.executable, __file__, "--stage-one", json.dumps(cfg)]
@@ -439,6 +566,7 @@ AB_MATRIX = [
 
 
 def stage_ab(force_cpu=False):
+    force_cpu = _probe_or_force_cpu(force_cpu)
     seen = {}
     for label, base, over in AB_MATRIX:
         cfg = {**base, **over}
@@ -488,6 +616,7 @@ def stage_obs_ab(force_cpu=False, gens=3, repeats=3):
     verdict compares the per-arm MEDIANS.  Per-run rows land as JSON
     lines for the artifact; the ``obs/overhead`` line carries the
     medians + the verdict."""
+    force_cpu = _probe_or_force_cpu(force_cpu)
     rates = {"spans_on": [], "spans_off": []}
     for rep in range(repeats):
         for label, tel in (("spans_on", True), ("spans_off", False)):
@@ -856,23 +985,41 @@ def stage_regress(baseline: str | None, repeats: int = 3,
                           "no BENCH_r*.json baseline found"}), flush=True)
         return 2
     try:
-        base_samples, base_metric = regress.load_measurement(baseline)
+        base_rows = regress.load_rows(baseline)
+        base_samples, base_metric = regress.extract_samples(base_rows)
     except (OSError, ValueError) as e:
         print(json.dumps({"label": "regress",
                           "error": f"baseline: {e}"}), flush=True)
         return 2
+    base_platform = regress.measurement_platform(base_rows)
+    # probe BEFORE measuring: on a wedged host the repeats would each eat
+    # a full stage timeout; the probe's cpu fallback surfaces the
+    # platform mismatch against a TPU baseline in seconds instead
+    force_cpu = _probe_or_force_cpu(force_cpu)
     rates = []
+    cur_platform = None
     for rep in range(int(repeats)):
         r = run_stage(dict(SMALL), timeout_s=1200 if force_cpu else 600,
                       force_cpu=force_cpu)
         if r and r.get("rate"):
             rates.append(r["rate"])
+            cur_platform = r.get("platform") or cur_platform
         print(json.dumps({"label": "regress/repeat", "rep": rep,
                           **(r or {"rate": None, "cfg": SMALL})}),
               flush=True)
     if not rates:
         print(json.dumps({"label": "regress",
                           "error": "every repeat failed"}), flush=True)
+        return 2
+    try:
+        # the ONE platform guard compare_files uses: a cross-platform
+        # verdict is a platform mismatch, not a perf result
+        regress.ensure_same_platform(cur_platform, base_platform,
+                                     cur_what="this run",
+                                     base_what=baseline)
+    except ValueError as e:
+        print(json.dumps({"label": "regress", "baseline": baseline,
+                          "error": str(e)}), flush=True)
         return 2
     verdict = regress.compare(rates, base_samples, metric=base_metric)
     print(json.dumps({"label": "regress", "baseline": baseline,
@@ -929,27 +1076,69 @@ def _lock_or_warn(max_wait_s=300.0):
         return None
 
 
+def _probe_platform(timeout_s: float = 20.0) -> dict:
+    """Platform decision in SECONDS, not by 480s stage-timeout discovery:
+    the typed staged probe (doctor.check_device) proves the device path
+    alive-or-wedged with a reason code, and the verdict — not a wedged
+    stage's corpse — decides the cpu fallback for every stage driver."""
+    probe = _load_doctor().check_device(timeout_s=timeout_s)
+    print(f"bench: device probe: {probe.get('status')}"
+          + (f" ({probe.get('reason')})" if probe.get("reason") else
+             f" platform={probe.get('platform')}")
+          + f" in {probe.get('elapsed_s')}s", file=sys.stderr)
+    return probe
+
+
+def _probe_or_force_cpu(force_cpu: bool) -> bool:
+    """The stage drivers' platform decision: an explicit --cpu skips the
+    probe; otherwise a failed probe forces the cpu fallback up front so a
+    wedged device path costs one probe timeout, not a full stage timeout
+    per repeat."""
+    if force_cpu:
+        return True
+    return _probe_platform().get("status") != "ok"
+
+
 def main():
     _lock_or_warn()
+    _sweep_stale_bench_dirs()
+    # the verdict rides the artifact as extras["device_probe"]
+    probe = _probe_platform()
     # dtype deliberately unset: measure_one picks bf16 on TPU, f32 elsewhere.
     # Headline runs the STANDARD forward: the CPU A/B (bench_ab_cpu.jsonl,
     # committed) measures decomposed ~10% behind standard off-chip, and
     # flipping the headline before on-chip evidence would front-run the
     # A/B's decision
     headline_cfg = dict(SMALL)
-    result = run_stage(headline_cfg)
-    if result is None:
+    fell_back = False
+    if probe.get("status") == "ok":
+        result = run_stage(headline_cfg)
+        if result is None:
+            # probe said alive but the stage still died — fall back, and
+            # the probe verdict in the artifact shows the contradiction
+            with _filtered_stderr():
+                result = measure_one(headline_cfg, force_cpu=True)
+            fell_back = True
+    else:
         with _filtered_stderr():
             result = measure_one(headline_cfg, force_cpu=True)
         fell_back = True
-    else:
-        fell_back = False
     rate, platform = result["rate"], result["platform"]
     on_tpu = platform == "tpu"
     base_rate = measure_reference_style_baseline()
 
     mfu = result["mfu"]
-    extras = {"mfu_headline": round(mfu, 6) if mfu is not None else None}
+    extras = {
+        "mfu_headline": mfu,
+        # what the headline MFU's denominator IS: the v5e bf16 datasheet
+        # peak on TPU, this host's measured GEMM ceiling off-chip —
+        # cpu_calibrated numbers are honest, not comparable to silicon
+        "mfu_basis": result.get("mfu_basis"),
+        # typed probe verdict + reason code (replaces the old
+        # "TPU-PATH-FAILED — see stderr" prose in the unit string)
+        "device_probe": {**probe, "cpu_fallback": fell_back},
+        "phases_headline": result.get("phases"),
+    }
     if on_tpu:
         for name, base in (("big_policy", BIG), ("pop10k", POP10K),
                            ("locomotion", LOCO)):
@@ -985,9 +1174,10 @@ def main():
                 if r else None
             )
 
+    # the unit names what was measured; the fallback story lives in the
+    # TYPED extras["device_probe"], not in prose stuffed into the unit
     unit = (f"env-steps/s/chip (Pendulum MLP64x64 pop4096 h200 "
-            f"standard/{result['dtype']}, {platform}")
-    unit += ", TPU-PATH-FAILED cpu fallback — see stderr)" if fell_back else ")"
+            f"standard/{result['dtype']}, {platform})")
     print(
         json.dumps(
             {
@@ -995,23 +1185,46 @@ def main():
                 "value": round(rate, 1),
                 "unit": unit,
                 "vs_baseline": round(rate / base_rate, 2),
+                "platform": platform,
                 "extras": extras,
             }
         )
     )
+    _cleanup_bench_workdir()
+
+
+_USAGE = """\
+usage: bench.py [MODE]
+
+no arguments        full headline benchmark (device probe decides the
+                    platform; prints exactly one JSON line)
+  --stage-ab        standard-vs-decomposed forward A/B
+  --obs-ab          telemetry-overhead A/B
+  --chaos [--selfcheck]   recovery-overhead A/B under injected faults
+  --serve [--selfcheck]   dynamic-batching serving A/B
+  --regress [BASELINE] [--repeats N] [--cpu]   gate vs newest BENCH_r*.json
+(--stage-one/--stage-chaos-one/--stage-serve-one are internal child modes)
+"""
 
 
 if __name__ == "__main__":
+    if "-h" in sys.argv or "--help" in sys.argv:
+        print(_USAGE, end="")
+        sys.exit(0)
     if "--stage-one" in sys.argv:
         cfg = json.loads(sys.argv[sys.argv.index("--stage-one") + 1])
         out = measure_one(cfg, force_cpu="--cpu" in sys.argv)
         print(json.dumps(out))
     elif "--stage-ab" in sys.argv:
         _lock_or_warn()
+        _sweep_stale_bench_dirs()
         stage_ab(force_cpu="--cpu" in sys.argv)
+        _cleanup_bench_workdir()
     elif "--obs-ab" in sys.argv:
         _lock_or_warn()
+        _sweep_stale_bench_dirs()
         stage_obs_ab(force_cpu="--cpu" in sys.argv)
+        _cleanup_bench_workdir()
     elif "--stage-chaos-one" in sys.argv:
         cfg = json.loads(sys.argv[sys.argv.index("--stage-chaos-one") + 1])
         print(json.dumps(measure_chaos_one(cfg)))
@@ -1027,8 +1240,11 @@ if __name__ == "__main__":
         repeats = 3
         if "--repeats" in sys.argv:
             repeats = int(sys.argv[sys.argv.index("--repeats") + 1])
-        sys.exit(stage_regress(baseline, repeats=repeats,
-                               force_cpu="--cpu" in sys.argv))
+        _sweep_stale_bench_dirs()
+        rc = stage_regress(baseline, repeats=repeats,
+                           force_cpu="--cpu" in sys.argv)
+        _cleanup_bench_workdir()
+        sys.exit(rc)
     elif "--serve" in sys.argv:
         # the selfcheck form runs inside run_lint.sh (tiny policy, CPU,
         # loopback only): skip the evidence lock a full measurement takes
@@ -1042,5 +1258,13 @@ if __name__ == "__main__":
         if "--selfcheck" not in sys.argv:
             _lock_or_warn()
         sys.exit(stage_chaos(selfcheck="--selfcheck" in sys.argv))
+    elif len(sys.argv) > 1:
+        # the default full bench takes NO arguments — a typo'd flag
+        # silently launching a multi-minute measurement is the worst
+        # possible "help" (this happened: `--help` ran the benchmark)
+        print(f"bench.py: unrecognized arguments: "
+              f"{' '.join(sys.argv[1:])}\n{_USAGE}",
+              end="", file=sys.stderr)
+        sys.exit(2)
     else:
         main()

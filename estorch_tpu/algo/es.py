@@ -411,6 +411,14 @@ class ES:
         # (host sample/eval/update, pooled obsnorm merge, engine compile
         # events) land in the same per-generation accumulator
         self.engine.telemetry = self.obs
+        # analytic FLOPs/bytes model of this configuration (obs/profile/):
+        # rides the first generation record so `obs profile` can turn the
+        # phase spans into achieved rates against a roofline.  Building it
+        # unravels the device param tree to host, so skip the whole thing
+        # when telemetry is off (set_cost_model would discard it anyway)
+        if self.obs.enabled:
+            self.obs.set_cost_model(self._build_cost_model())
+        self._cost_model_emitted = False
         self.best_reward = -np.inf
         self._best_flat: np.ndarray | None = None
         self._best_policy_host = None
@@ -682,6 +690,41 @@ class ES:
             n_proc = 1
         self.engine.set_n_proc(n_proc)
 
+    def _build_cost_model(self) -> dict | None:
+        """Analytic per-phase FLOPs/bytes for THIS configuration
+        (obs/profile/costmodel.py): policy matmul shapes from the live
+        parameter tree, population/horizon/noise-representation from the
+        config.  Diagnostic only — returns None rather than ever failing
+        construction (an exotic policy without 2-D kernels has no matmul
+        model, and that is a note in ``obs profile``, not an error)."""
+        from ..obs.profile.costmodel import generation_cost
+
+        try:
+            if self.backend == "host":
+                params = list(self.engine.master.parameters())
+                shapes = [tuple(p.shape) for p in params if p.dim() == 2]
+                param_dim = int(sum(p.numel() for p in params))
+                horizon = None  # host agents own their rollout length
+                dtype_bytes, episodes = 4, 1
+            else:
+                params = jax.tree_util.tree_leaves(
+                    self._spec.unravel(self.state.params_flat))
+                shapes = [tuple(int(d) for d in p.shape)
+                          for p in params if getattr(p, "ndim", 0) == 2]
+                param_dim = int(self._spec.dim)
+                horizon = int(self.config.horizon)
+                dtype_bytes = 2 if self._compute_dtype == "bfloat16" else 4
+                episodes = int(self.config.episodes_per_member)
+            if not shapes:
+                return None
+            return generation_cost(
+                population=self.population_size, matmul_shapes=shapes,
+                param_dim=param_dim, horizon=horizon,
+                episodes_per_member=episodes, mirrored=self._mirrored,
+                low_rank=self._low_rank, dtype_bytes=dtype_bytes)
+        except Exception:  # noqa: BLE001 — diagnostic, never construction
+            return None
+
     # ------------------------------------------- shared generation plumbing
 
     def _track_best(self, prev_state, fitness: np.ndarray) -> tuple[float, bool]:
@@ -725,6 +768,15 @@ class ES:
         # flush this generation's span accumulator into the record and
         # export the run-level counters (obs/summarize.py consumes both)
         record["phases"] = self.obs.take_phases()
+        # performance-attribution facts ride the same record: compile-
+        # ledger entries since the last flush, and (once per run) the
+        # analytic cost model — `obs profile` joins them with the spans
+        compile_events = self.obs.take_compile_events()
+        if compile_events:
+            record["compile_events"] = compile_events
+        if not self._cost_model_emitted and self.obs.cost_model is not None:
+            record["cost_model"] = self.obs.cost_model
+            self._cost_model_emitted = True
         self.obs.counters.inc("env_steps", steps)
         if record["n_failed"]:
             self.obs.counters.inc("rollout_failures", record["n_failed"])

@@ -238,7 +238,15 @@ class IW_ES(ES):
                 jnp.zeros((w,), jnp.float32),
             )
             jnp.asarray(out.params_flat).block_until_ready()
-        return time.perf_counter() - t0
+        dt = time.perf_counter() - t0
+        # one ledger entry for the whole reuse-window warm: reuse_window+1
+        # distinct XLA programs (noise_stats + one apply_weights_reuse per
+        # window length), traced+executed so only wall seconds are known
+        self.obs.compile_event("iwes_reuse_warm", dt,
+                               count_recompiles=self.reuse_window + 1,
+                               programs=self.reuse_window + 1,
+                               first_call=True)
+        return dt
 
     def _ratios(self, entry, st):
         """Per-old-member importance ratios λ under the CURRENT state.

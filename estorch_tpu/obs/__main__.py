@@ -1,4 +1,4 @@
-"""obs CLI: summarize / trace / regress / serve-metrics.
+"""obs CLI: summarize / trace / profile / regress / serve-metrics.
 
 Subcommands (docs/observability.md):
 
@@ -19,10 +19,25 @@ Subcommands (docs/observability.md):
       lanes keyed by manifest provenance.  ``manifest.json`` /
       ``heartbeat.json`` beside the JSONL are auto-discovered.
 
+  profile <run.jsonl> [--platform auto|cpu|tpu] [--json]
+      Per-phase performance attribution (docs/observability.md
+      "Profiling"): time share, achieved FLOP/s and bytes/s against the
+      platform roofline (v5e bf16 peak on TPU, a measured-GEMM
+      calibration on cpu), arithmetic intensity, MFU, and the compile
+      ledger with the analytic-vs-XLA cross-check.  Degenerate inputs
+      (phase-less records, truncated tail, zero compile events) degrade
+      to a noted report — the summarize/trace tolerance contract.
+      ``profile --selfcheck`` is the run_lint.sh gate: a synthetic run
+      with known FLOPs must produce exactly the expected MFU, and an
+      injected 30% eval slowdown must be flagged naming ``eval``.
+
   regress <current> --baseline <BENCH_*.json> [--label L] [--json]
       Statistical perf gate: robust medians + a noise band learned from
-      repeats.  Exit 0 pass, 1 regression.  ``regress --selfcheck`` is
-      the run_lint.sh gate for the gate.
+      repeats.  Exit 0 pass, 1 regression.  ``--phases`` gates per-phase
+      medians (two run JSONLs) so the verdict names the phase that
+      moved; mismatched platforms (cpu-fallback artifact vs TPU
+      baseline) are an error, not a verdict.  ``regress --selfcheck``
+      is the run_lint.sh gate for the gate.
 
   serve-metrics --run-dir DIR [--port N] [--port-file PATH]
       Prometheus /metrics sidecar over a run directory (heartbeat +
@@ -83,6 +98,25 @@ def build_parser() -> argparse.ArgumentParser:
                    help="flight-recorder dump_jsonl file: rendered as a "
                         "wall-clock marker lane")
 
+    pr = sub.add_parser("profile",
+                        help="per-phase MFU/roofline attribution of a "
+                             "run JSONL")
+    pr.add_argument("jsonl", nargs="?", default=None,
+                    help="run JSONL (one generation record per line)")
+    pr.add_argument("--platform", default="auto",
+                    choices=("auto", "cpu", "tpu"),
+                    help="roofline platform (auto: manifest.json beside "
+                         "the JSONL, else cpu)")
+    pr.add_argument("--manifest", default=None, metavar="PATH",
+                    help="run manifest for platform auto-detection "
+                         "(default: manifest.json beside the JSONL)")
+    pr.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable profile on stdout")
+    pr.add_argument("--selfcheck", action="store_true",
+                    help="prove the attribution math (known FLOPs -> "
+                         "known MFU; 30%% eval slowdown localized) and "
+                         "exit")
+
     r = sub.add_parser("regress",
                        help="perf gate: current measurement vs a "
                             "committed baseline")
@@ -95,6 +129,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="filter bench A/B rows by label on both sides")
     r.add_argument("--min-band-pct", type=float, default=None,
                    help="noise-band floor in percent (default 5)")
+    r.add_argument("--phases", action="store_true",
+                   help="gate per-phase span medians (two run JSONLs) — "
+                        "the verdict names the phase that moved")
     r.add_argument("--json", action="store_true", dest="as_json",
                    help="verdict as one JSON line (default: human line "
                         "+ JSON)")
@@ -213,6 +250,55 @@ def _cmd_trace(args) -> int:
     return 0
 
 
+def _cmd_profile(args) -> int:
+    from .profile import (find_cost_model, format_profile, platform_roofline,
+                          profile_records)
+    from .profile.report import selfcheck as profile_selfcheck
+
+    if args.selfcheck:
+        problems = profile_selfcheck()
+        if problems:
+            for pr in problems:
+                print(f"profile selfcheck: {pr}", file=sys.stderr)
+            return 1
+        print("obs profile selfcheck: OK (known-FLOPs MFU exact, ledger "
+              "round-trips the exposition parser, 30% eval slowdown "
+              "localized to eval)")
+        return 0
+    if not args.jsonl:
+        print("profile needs a run JSONL (or --selfcheck)", file=sys.stderr)
+        return 3
+    records = _load_tolerant(args.jsonl)
+    if records is None:
+        return 1
+    platform = args.platform
+    if platform == "auto":
+        platform = "cpu"
+        mf = _beside(args.jsonl, args.manifest, "manifest.json")
+        if mf:
+            try:
+                with open(mf) as f:
+                    devs = json.load(f).get("devices") or []
+                # the manifest schema (obs/manifest.py) is a LIST of
+                # per-device dicts; tolerate a bare dict too
+                if isinstance(devs, dict):
+                    devs = [devs]
+                if any(str(d.get("platform", "")).lower() == "tpu"
+                       for d in devs if isinstance(d, dict)):
+                    platform = "tpu"
+            except (OSError, ValueError) as e:
+                print(f"note: ignoring unreadable manifest {mf}: {e}",
+                      file=sys.stderr)
+    roofline = platform_roofline(platform)
+    p = profile_records(records, roofline,
+                        cost_model=find_cost_model(records))
+    if args.as_json:
+        print(json.dumps(p, default=float))
+    else:
+        print(format_profile(p))
+    return 0
+
+
 def _cmd_regress(args) -> int:
     from .export import regress as _regress
 
@@ -232,6 +318,34 @@ def _cmd_regress(args) -> int:
     kw = {}
     if args.min_band_pct is not None:
         kw["min_band_pct"] = args.min_band_pct
+    if args.phases:
+        if args.label is not None:
+            # phase records carry no labels — silently ignoring the
+            # filter would attribute a verdict to rows the user excluded
+            print("regress: --label filters bench A/B rows; --phases "
+                  "gates run-JSONL span records, which carry no labels "
+                  "— the two cannot combine", file=sys.stderr)
+            return 3
+        try:
+            verdict = _regress.compare_phase_files(args.current,
+                                                   args.baseline, **kw)
+        except (OSError, ValueError) as e:
+            print(f"regress: {e}", file=sys.stderr)
+            return 1
+        if not args.as_json:
+            if verdict["regressed_phases"]:
+                for name in verdict["regressed_phases"]:
+                    row = verdict["phases"][name]
+                    print(f"regress: REGRESSION in phase {name!r} — "
+                          f"{row['current_median_s']}s vs baseline "
+                          f"{row['baseline_median_s']}s (slowdown "
+                          f"{row['slowdown_pct']}%, band "
+                          f"{row['band_pct']}%)")
+            else:
+                print(f"regress: pass — {len(verdict['phases'])} phase(s) "
+                      "within their noise bands")
+        print(json.dumps(verdict, default=float))
+        return 0 if verdict["verdict"] == "pass" else 1
     try:
         verdict = _regress.compare_files(args.current, args.baseline,
                                          label=args.label, **kw)
@@ -268,6 +382,8 @@ def main(argv: list[str] | None = None) -> int:
         return _cmd_summarize(args)
     if args.cmd == "trace":
         return _cmd_trace(args)
+    if args.cmd == "profile":
+        return _cmd_profile(args)
     if args.cmd == "regress":
         return _cmd_regress(args)
     if args.cmd == "serve-metrics":

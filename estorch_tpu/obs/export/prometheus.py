@@ -59,10 +59,15 @@ _SANITIZE = re.compile(r"[^a-zA-Z0-9_:]")
 
 
 def is_gauge(name: str) -> bool:
-    """Counter-vs-gauge classification for one registry name."""
+    """Counter-vs-gauge classification for one registry name.
+
+    The ``compile_`` prefix covers the compile-ledger facts
+    (``compile_s_<program>``, ``compile_peak_bytes_<program>``, … —
+    obs/profile/ledger.py): last-write-wins per program, re-derivable
+    from the ledger, hence gauges."""
     return (name in GAUGE_NAMES
             or name.endswith(("_last", "_depth"))
-            or name.startswith("peak_"))
+            or name.startswith(("peak_", "compile_")))
 
 
 def metric_name(name: str) -> str:

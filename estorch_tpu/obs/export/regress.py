@@ -30,6 +30,21 @@ Accepted measurement files (auto-detected per line):
   filters; rows with null rate are skipped)
 * run JSONL records     — ``{"generation", "env_steps_per_sec", ...}``
   (supervisor-replayed generations are deduped, keeping the last)
+
+Two safeguards beyond the aggregate gate:
+
+* **platform guard** — a measurement that records its platform (the
+  ``device_probe`` extras new BENCH artifacts carry, or the platform
+  noted in the legacy unit string) is refused against a baseline from a
+  DIFFERENT platform: a cpu-fallback run "regressing" against a TPU
+  baseline is a platform mismatch, not a perf verdict, and emitting a
+  bogus verdict would be worse than an error;
+* **phase localization** (``obs regress --phases``, ``compare_phases``)
+  — per-phase medians of the span seconds every record carries
+  (``record["phases"]``, PR 2), each gated by its own learned noise
+  band, so the verdict names the phase that moved (``eval`` got 30%
+  slower) instead of drowning a localized regression in aggregate
+  host-load noise.
 """
 
 from __future__ import annotations
@@ -106,21 +121,20 @@ def extract_samples(lines: list[dict], label: str | None = None
     return samples, metric
 
 
-def load_measurement(path: str, label: str | None = None
-                     ) -> tuple[list[float], str]:
-    """Read one measurement file (JSON object or JSONL) into samples.
-    A truncated FINAL line (crash artifact) is tolerated; garbage
-    earlier in the file is an error."""
+def load_rows(path: str) -> list[dict]:
+    """The raw parsed rows of one measurement file: whole-file JSON
+    first (BENCH_*.json is an indented object), then JSONL with a
+    tolerated truncated FINAL line (crash artifact); garbage earlier in
+    the file is an error, as is an empty file."""
     with open(path) as f:
         text = f.read()
     lines = [ln for ln in text.splitlines() if ln.strip()]
-    rows: list[dict] = []
     if not lines:
         raise ValueError(f"{path}: empty file")
     try:
-        # whole-file JSON first: BENCH_*.json is an indented object
-        rows = [json.loads(text)]
+        return [json.loads(text)]
     except ValueError:
+        rows: list[dict] = []
         for i, ln in enumerate(lines):
             try:
                 rows.append(json.loads(ln))
@@ -128,6 +142,14 @@ def load_measurement(path: str, label: str | None = None
                 if i == len(lines) - 1:
                     break  # truncated tail: a crash mid-append
                 raise ValueError(f"{path} line {i + 1}: {e}") from e
+        return rows
+
+
+def load_measurement(path: str, label: str | None = None
+                     ) -> tuple[list[float], str]:
+    """Read one measurement file (JSON object or JSONL) into samples —
+    :func:`load_rows`'s tolerance rules, then :func:`extract_samples`."""
+    rows = load_rows(path)  # its errors already carry the path
     try:
         return extract_samples(rows, label=label)
     except ValueError as e:
@@ -162,16 +184,167 @@ def compare(current: list[float], baseline: list[float],
     }
 
 
+def measurement_platform(rows: list[dict]) -> str | None:
+    """The platform a measurement was taken on, when it says: the typed
+    ``extras.device_probe.platform`` new BENCH artifacts carry, a bare
+    ``platform`` key (stage rows), or — legacy artifacts — the platform
+    noted in the unit string (``"..., cpu)"`` / the old cpu-fallback
+    prose).  None when nothing states it (run JSONLs don't)."""
+    for row in rows:
+        if not isinstance(row, dict):
+            continue
+        for holder in (row, row.get("extras") or {}):
+            if not isinstance(holder, dict):
+                continue
+            probe = holder.get("device_probe")
+            if isinstance(probe, dict) and probe.get("platform"):
+                return str(probe["platform"])
+            if isinstance(holder.get("platform"), str):
+                return holder["platform"]
+        parsed = row.get("parsed")
+        unit = (parsed or {}).get("unit") if isinstance(parsed, dict) \
+            else row.get("unit")
+        if isinstance(unit, str):
+            low = unit.lower()
+            if "cpu fallback" in low or "cpu)" in low or ", cpu" in low:
+                return "cpu"
+            if "tpu)" in low or ", tpu" in low:
+                return "tpu"
+    return None
+
+
+def ensure_same_platform(cur_platform: str | None,
+                         base_platform: str | None,
+                         cur_what: str = "current",
+                         base_what: str = "baseline") -> None:
+    """Raise when both sides state a platform and they differ — a
+    platform mismatch is an ERROR, not a verdict: a cpu-fallback
+    artifact "regressing" 90% against a TPU baseline says nothing about
+    performance, and a bogus verdict would gate on it.  The ONE guard
+    shared by ``compare_files`` and ``bench.py --regress``."""
+    if cur_platform and base_platform and cur_platform != base_platform:
+        raise ValueError(
+            f"platform mismatch: {cur_what} was measured on "
+            f"{cur_platform!r} but {base_what} on {base_platform!r} — "
+            "perf verdicts only mean something within one platform "
+            "(re-baseline, or pass a same-platform artifact)")
+
+
 def compare_files(current_path: str, baseline_path: str,
                   label: str | None = None,
                   min_band_pct: float = DEFAULT_MIN_BAND_PCT) -> dict:
-    cur, metric = load_measurement(current_path, label=label)
-    base, base_metric = load_measurement(baseline_path, label=label)
+    cur_rows = load_rows(current_path)
+    base_rows = load_rows(baseline_path)
+    cur_platform = measurement_platform(cur_rows)
+    base_platform = measurement_platform(base_rows)
+    ensure_same_platform(cur_platform, base_platform,
+                         cur_what=f"current {current_path}",
+                         base_what=f"baseline {baseline_path}")
+    try:
+        cur, metric = extract_samples(cur_rows, label=label)
+    except ValueError as e:
+        raise ValueError(f"{current_path}: {e}") from e
+    try:
+        base, base_metric = extract_samples(base_rows, label=label)
+    except ValueError as e:
+        raise ValueError(f"{baseline_path}: {e}") from e
     out = compare(cur, base, metric=metric, min_band_pct=min_band_pct)
     if base_metric != metric:
         out["warning"] = (f"metric mismatch: current={metric!r} "
                           f"baseline={base_metric!r}")
+    if cur_platform or base_platform:
+        out["platform"] = cur_platform or base_platform
     return out
+
+
+# ---------------------------------------------------------------------
+# phase-localized gate: per-phase medians with per-phase noise bands
+# ---------------------------------------------------------------------
+
+def extract_phase_samples(records: list[dict]) -> dict[str, list[float]]:
+    """Per-generation seconds for every TOP-LEVEL phase across a run's
+    records (``record["phases"]``; nested ``parent/child`` spans are the
+    parent's internal breakdown and are not separately gated).
+    Supervisor-replayed generations are deduped keeping the last, the
+    same rule the aggregate extractor applies."""
+    gen_last: dict[int, dict] = {}
+    order: list[int] = []
+    anon: list[dict] = []
+    for row in records:
+        if not isinstance(row, dict) or not isinstance(
+                row.get("phases"), dict):
+            continue
+        g = row.get("generation")
+        if isinstance(g, int):
+            if g not in gen_last:
+                order.append(g)
+            gen_last[g] = row["phases"]
+        else:
+            anon.append(row["phases"])
+    out: dict[str, list[float]] = {}
+    for phases in [gen_last[g] for g in order] + anon:
+        for name, dur in phases.items():
+            if (isinstance(dur, (int, float)) and not isinstance(dur, bool)
+                    and math.isfinite(dur) and "/" not in name):
+                out.setdefault(name, []).append(float(dur))
+    return out
+
+
+def compare_phases(current: list[dict], baseline: list[dict],
+                   min_band_pct: float = DEFAULT_MIN_BAND_PCT) -> dict:
+    """Phase-localized verdict over two runs' records: each shared
+    top-level phase's median SECONDS gated by that phase's own learned
+    noise band — the verdict names the phase(s) that slowed instead of
+    drowning them in the aggregate.  Phases are durations, so here a
+    regression is the current median coming out ABOVE the band (slower),
+    the mirror of the rate gate's below."""
+    cur_phases = extract_phase_samples(current)
+    base_phases = extract_phase_samples(baseline)
+    shared = sorted(set(cur_phases) & set(base_phases))
+    if not shared:
+        raise ValueError(
+            "no shared top-level phases between the two runs (records "
+            "missing 'phases' spans — telemetry disabled, or pre-PR-2 "
+            "runs)")
+    phases: dict[str, dict] = {}
+    regressed: list[str] = []
+    for name in shared:
+        cur, base = cur_phases[name], base_phases[name]
+        cur_med, base_med = _median(cur), _median(base)
+        band = max(float(min_band_pct),
+                   _noise_band_pct(cur), _noise_band_pct(base))
+        slowdown = ((cur_med - base_med) / base_med * 100.0) if base_med \
+            else 0.0
+        verdict = "regress" if slowdown > band else "pass"
+        if verdict == "regress":
+            regressed.append(name)
+        phases[name] = {
+            "verdict": verdict,
+            "current_median_s": round(cur_med, 6),
+            "baseline_median_s": round(base_med, 6),
+            "slowdown_pct": round(slowdown, 2),
+            "band_pct": round(band, 2),
+            "improved": slowdown < -band,
+            "n_current": len(cur),
+            "n_baseline": len(base),
+        }
+    return {
+        "schema": REGRESS_SCHEMA,
+        "verdict": "regress" if regressed else "pass",
+        "metric": "phase_seconds",
+        "phases": phases,
+        "regressed_phases": regressed,
+    }
+
+
+def compare_phase_files(current_path: str, baseline_path: str,
+                        min_band_pct: float = DEFAULT_MIN_BAND_PCT) -> dict:
+    try:
+        return compare_phases(load_rows(current_path),
+                              load_rows(baseline_path),
+                              min_band_pct=min_band_pct)
+    except ValueError as e:
+        raise ValueError(f"{current_path} vs {baseline_path}: {e}") from e
 
 
 # ---------------------------------------------------------------------
@@ -267,4 +440,26 @@ def selfcheck() -> list[str]:
             empty_raised = True
         if not empty_raised:
             problems.append("empty measurement file did not raise")
+        # platform guard: a cpu-fallback artifact against a TPU baseline
+        # must be a platform-mismatch ERROR, never a verdict
+        tpu_base = os.path.join(d, "BENCH_tpu.json")
+        with open(tpu_base, "w") as f:
+            json.dump({"parsed": {"metric": "env_steps_per_sec_per_chip",
+                                  "value": 5e6,
+                                  "unit": "env-steps/s/chip (pendulum, "
+                                          "tpu)"}}, f)
+        cpu_cur = os.path.join(d, "BENCH_cpu.json")
+        with open(cpu_cur, "w") as f:
+            json.dump({"parsed": {"metric": "env_steps_per_sec_per_chip",
+                                  "value": 4e4, "unit": "env-steps/s/chip"},
+                       "extras": {"device_probe": {"status": "failed",
+                                                   "platform": "cpu"}}}, f)
+        try:
+            v = compare_files(cpu_cur, tpu_base)
+            problems.append(f"cpu-vs-tpu comparison produced a verdict "
+                            f"instead of a platform-mismatch error: {v}")
+        except ValueError as e:
+            if "platform mismatch" not in str(e):
+                problems.append(f"cpu-vs-tpu error lacks the platform-"
+                                f"mismatch diagnosis: {e}")
     return problems

@@ -24,6 +24,12 @@ segment becomes its own trace *process* lane keyed by the manifest's
 provenance (the dead child's heartbeat pid, the restart reason), and the
 boundary itself is an instant marker carrying the reason.
 
+Compile-ledger entries (``record["compile_events"]``, obs/profile/
+ledger.py) render as instant markers on a per-segment ``compiles`` lane
+at the carrying generation's start — compile seconds and XLA cost facts
+in the args, so "why is this generation wide" and "what did that
+program cost to build" are answered on the same timeline.
+
 Optional extra lanes: ``--events ring.jsonl`` (a flight-recorder
 ``dump_jsonl``) and the run dir's heartbeat render as instant events on
 a separate wall-clock lane (rebased to 0; the synthesized lanes and the
@@ -105,7 +111,8 @@ def export_trace(records: list[dict],
         trace_events.append({"ph": "M", "name": "process_name",
                              "pid": pid, "tid": 0,
                              "args": {"name": name}})
-        for tid, tname in ((1, "generations"), (2, "phases")):
+        for tid, tname in ((1, "generations"), (2, "phases"),
+                           (3, "compiles")):
             trace_events.append({"ph": "M", "name": "thread_name",
                                  "pid": pid, "tid": tid,
                                  "args": {"name": tname}})
@@ -169,6 +176,17 @@ def export_trace(records: list[dict],
                     })
                     k_off += k_dur
                 off += dur
+        compiles = rec.get("compile_events")
+        if isinstance(compiles, list):
+            for e in compiles:
+                if not isinstance(e, dict) or "program" not in e:
+                    continue
+                trace_events.append({
+                    "ph": "i", "s": "t",
+                    "name": f"compile:{e['program']}", "cat": "compile",
+                    "ts": _us(cursor), "pid": pid, "tid": 3,
+                    "args": {k: v for k, v in e.items() if k != "program"},
+                })
         cursor += wall
 
     # ---- wall-clock lane: flight-recorder events + heartbeat ----------

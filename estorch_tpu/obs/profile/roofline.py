@@ -1,0 +1,107 @@
+"""Platform rooflines: the denominators that make achieved rates honest.
+
+``obs profile`` divides per-phase achieved FLOP/s and bytes/s by a
+platform peak.  On TPU the peak is a datasheet fact (v5e bf16 MXU peak,
+HBM bandwidth — the same 197 TFLOP/s denominator bench.py has always
+used for ``mfu``).  On CPU there is no such number worth quoting: the
+"peak" of a loaded shared-core host is whatever it can actually do
+today — so the CPU roofline is MEASURED, not quoted: a short in-process
+GEMM (numpy → BLAS, the best compute this host offers python) and a
+large memcpy (stream bandwidth).  Every CPU-derived utilization is
+tagged ``cpu_calibrated`` so nobody mistakes "fraction of this host's
+measured GEMM rate" for an MFU against accelerator silicon.
+
+Deliberately jax-free (numpy + stdlib): bench.py's driver and the
+``obs profile`` CLI both need a roofline on hosts where the device
+runtime is wedged.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+# TPU v5e per-chip datasheet peaks: bf16 MXU FLOP/s (the bench.py
+# denominator since round 1) and HBM bandwidth
+V5E_BF16_PEAK_FLOPS = 197e12
+V5E_HBM_BYTES_PER_S = 819e9
+
+TPU_V5E_ROOFLINE = {
+    "platform": "tpu",
+    "basis": "tpu_v5e_bf16_peak",
+    "peak_flops_per_s": V5E_BF16_PEAK_FLOPS,
+    "peak_bytes_per_s": V5E_HBM_BYTES_PER_S,
+}
+
+_CPU_CACHE: dict | None = None
+
+
+def measure_cpu_roofline(budget_s: float = 0.25, gemm_n: int = 384,
+                         copy_mb: int = 32) -> dict:
+    """Measured CPU roofline: best-of-repeats GEMM FLOP/s + memcpy bytes/s.
+
+    Best-of (not median): the roofline is the *ceiling* this host can
+    reach, and on a loaded shared core every slow repeat is interference,
+    not capability.  ``budget_s`` bounds each of the two measurements.
+    """
+    n = int(gemm_n)
+    a = np.random.default_rng(0).standard_normal((n, n)).astype(np.float32)
+    b = np.random.default_rng(1).standard_normal((n, n)).astype(np.float32)
+    a @ b  # warm-up: BLAS thread pool + page faults outside the clock
+    flops_per_mm = 2.0 * n * n * n
+    best_flops = 0.0
+    deadline = time.perf_counter() + float(budget_s)
+    while time.perf_counter() < deadline:
+        t0 = time.perf_counter()
+        a @ b
+        dt = time.perf_counter() - t0
+        if dt > 0:
+            best_flops = max(best_flops, flops_per_mm / dt)
+
+    src = np.zeros(int(copy_mb) * 2**20 // 4, np.float32)
+    dst = np.empty_like(src)
+    np.copyto(dst, src)  # warm-up
+    moved = 2.0 * src.nbytes  # one read + one write per copy
+    best_bw = 0.0
+    deadline = time.perf_counter() + float(budget_s)
+    while time.perf_counter() < deadline:
+        t0 = time.perf_counter()
+        np.copyto(dst, src)
+        dt = time.perf_counter() - t0
+        if dt > 0:
+            best_bw = max(best_bw, moved / dt)
+    return {
+        "platform": "cpu",
+        "basis": "cpu_calibrated",
+        "peak_flops_per_s": best_flops,
+        "peak_bytes_per_s": best_bw,
+        "gemm_n": n,
+        "copy_mb": int(copy_mb),
+    }
+
+
+def platform_roofline(platform: str, measure: bool = True) -> dict:
+    """The roofline for ``platform``: datasheet on TPU, measured on CPU
+    (cached per process — the calibration GEMM should run once, not per
+    phase).  ``measure=False`` on CPU returns None-peaks with the
+    ``cpu_calibrated`` basis, for callers that only want the tag.
+
+    Any OTHER platform (gpu, …) gets None-peaks and no basis: the host
+    GEMM calibration measures this host's CPU, and dividing an
+    accelerator's rate by it would produce exactly the dishonest
+    cross-silicon number the basis tag exists to prevent — rates-only
+    reporting is the honest answer until that platform gets its own
+    denominator."""
+    global _CPU_CACHE
+    if platform == "tpu":
+        return dict(TPU_V5E_ROOFLINE)
+    if platform != "cpu":
+        return {"platform": str(platform), "basis": None,
+                "peak_flops_per_s": None, "peak_bytes_per_s": None}
+    if not measure:
+        return {"platform": "cpu", "basis": "cpu_calibrated",
+                "peak_flops_per_s": None, "peak_bytes_per_s": None}
+    if _CPU_CACHE is None:
+        _CPU_CACHE = measure_cpu_roofline()
+    return dict(_CPU_CACHE)

@@ -30,6 +30,7 @@ import os
 import time
 
 from .counters import Counters, NullCounters
+from .profile.ledger import CompileLedger, ledger_counters
 from .recorder import HEARTBEAT_ENV, FlightRecorder, Heartbeat
 
 OBS_DISABLE_ENV = "ESTORCH_OBS"  # "0" disables default-on telemetry
@@ -60,6 +61,11 @@ class Telemetry:
         self.generation = 0
         self._acc: dict[str, float] = {}
         self._stack: list[str] = []
+        # performance-attribution facts (obs/profile/): the per-program
+        # compile ledger and the run's analytic cost model — engines feed
+        # the first, ES sets the second, `obs profile` joins them
+        self.compile_ledger = CompileLedger()
+        self.cost_model: dict | None = None
 
     # ------------------------------------------------------------- factory
 
@@ -134,6 +140,51 @@ class Telemetry:
         if self.enabled and self.heartbeat is not None:
             self.heartbeat.beat(phase, self.generation,
                                 self.counters.snapshot())
+
+    # ------------------------------------------------- compile ledger
+
+    def set_cost_model(self, model: dict | None) -> None:
+        """Attach the run's analytic FLOPs/bytes model (obs/profile/
+        costmodel.py); ES writes it into the generation-0 record so
+        ``obs profile`` can turn phase seconds into achieved rates."""
+        if self.enabled:
+            self.cost_model = dict(model) if model else None
+
+    def compile_event(self, program: str, dur_s: float, compiled=None,
+                      count_recompiles: int = 1, **extra):
+        """Record one program compile: ledger entry (+ XLA cost facts
+        duck-typed off ``compiled`` when given), ``recompiles`` counter
+        (``count_recompiles`` programs — 0 when the caller counts its
+        own), per-program registry gauges for /metrics, and a flight-
+        recorder event.  Thread-safe primitives only (the serving
+        batcher records from its worker thread)."""
+        if not self.enabled:
+            return None
+        from .profile.costmodel import compiled_cost_facts
+
+        facts = compiled_cost_facts(compiled) if compiled is not None else {}
+        entry = self.compile_ledger.record(
+            program, dur_s, generation=self.generation, **facts, **extra)
+        if count_recompiles:
+            self.counters.inc("recompiles", count_recompiles)
+        # cumulative compile seconds across the run's programs (gauge:
+        # re-derivable from the ledger, last-write-wins by design)
+        self.counters.gauge("compile_time_s", round(sum(
+            e.get("compile_s", 0.0) for e in self.compile_ledger.entries()),
+            6))
+        for name, value in ledger_counters([entry]).items():
+            self.counters.gauge(name, value)
+        self.recorder.add("event", "compile", generation=self.generation,
+                          program=program, dur_s=dur_s)
+        return entry
+
+    def take_compile_events(self) -> list[dict]:
+        """Ledger entries recorded since the last flush — merged into the
+        generation record as ``compile_events`` (obs profile / obs trace
+        read them back)."""
+        if not self.enabled:
+            return []
+        return self.compile_ledger.take_new()
 
     # -------------------------------------------------------------- events
 

@@ -39,6 +39,11 @@ RECORD_SCHEMA: dict[str, tuple[tuple[type, ...], bool]] = {
     "sigma": ((float, int), False),
     "wall_time_s": ((float, int), True),
     "phases": ((dict,), False),
+    # performance attribution (obs/profile/): the compile ledger flushes
+    # into whichever record follows a compile; the analytic cost model
+    # rides the run's first record only
+    "compile_events": ((list,), False),
+    "cost_model": ((dict,), False),
 }
 
 # a record shaped exactly like ES._base_record + span merge emits — the
@@ -59,6 +64,21 @@ GOLDEN_RECORD = {
     "wall_time_s": 1.6,
     "phases": {"sample": 0.01, "eval": 1.2, "update": 0.3,
                "update/obsnorm_merge": 0.05},
+    "compile_events": [
+        {"program": "generation_step", "compile_s": 24.8, "generation": 0,
+         "xla_flops": 7.1e12, "peak_bytes": 2.5e9, "first_call": True},
+    ],
+    "cost_model": {"schema": 1, "flops_per_env_step": 8704,
+                   "bytes_per_env_step": 17924,
+                   "per_generation": {"sample": {"flops": 7.3e7,
+                                                 "bytes": 1.1e8},
+                                      "update": {"flops": 3.7e7,
+                                                 "bytes": 5.5e7}},
+                   "population": 4096, "param_dim": 4481,
+                   "noise_dim": 4481, "mirrored": True, "low_rank": 0,
+                   "episodes_per_member": 1, "dtype_bytes": 4,
+                   "matmul_shapes": [[3, 64], [64, 64], [64, 1]],
+                   "env_steps_per_generation": 819200},
 }
 
 
@@ -89,6 +109,15 @@ def validate_record(rec: dict) -> list[str]:
                   or isinstance(dur, bool) or dur < 0):
                 problems.append(f"phase {name!r} duration {dur!r} is not a "
                                 "non-negative number")
+    for i, e in enumerate(rec.get("compile_events") or []):
+        if not isinstance(e, dict) or not isinstance(e.get("program"), str):
+            problems.append(f"compile_events[{i}] lacks a program name")
+        elif (not isinstance(e.get("compile_s"), (int, float))
+              or isinstance(e.get("compile_s"), bool)
+              or e["compile_s"] < 0):
+            problems.append(f"compile_events[{i}] compile_s "
+                            f"{e.get('compile_s')!r} is not a "
+                            "non-negative number")
     return problems
 
 

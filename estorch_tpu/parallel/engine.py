@@ -986,11 +986,14 @@ class ESEngine:
         import time as _time
 
         t0 = _time.perf_counter()
-        self._generation_step.lower(state).compile()
+        compiled = self._generation_step.lower(state).compile()
         dt = _time.perf_counter() - t0
-        self.telemetry.counters.inc("recompiles")
-        self.telemetry.counters.gauge("compile_time_s", dt)
-        self.telemetry.event("compile", what="generation_step", dur_s=dt)
+        # ledger entry + recompiles counter + per-program gauges + ring
+        # event in one call; `compiled` contributes XLA's own FLOPs/bytes/
+        # peak-memory estimates where this jax version exposes them
+        # (obs/profile/ledger.py)
+        self.telemetry.compile_event("generation_step", dt,
+                                     compiled=compiled, first_call=True)
         return dt
 
     def compile_split(self, state: ESState) -> float:
@@ -998,16 +1001,24 @@ class ESEngine:
         center eval) used by the novelty family; returns seconds spent."""
         import time as _time
 
-        t0 = _time.perf_counter()
-        self._evaluate.lower(state).compile()
+        total = 0.0
         dummy_w = jnp.zeros((self.config.population_size,), jnp.float32)
-        self._apply_weights.lower(state, dummy_w).compile()
-        self._center_eval.lower(state).compile()
-        dt = _time.perf_counter() - t0
-        self.telemetry.counters.inc("recompiles", 3)
-        self.telemetry.counters.gauge("compile_time_s", dt)
-        self.telemetry.event("compile", what="split_path", dur_s=dt)
-        return dt
+        for program, lowered in (
+            ("evaluate", lambda: self._evaluate.lower(state)),
+            ("apply_weights", lambda: self._apply_weights.lower(state,
+                                                               dummy_w)),
+            ("center_eval", lambda: self._center_eval.lower(state)),
+        ):
+            t0 = _time.perf_counter()
+            compiled = lowered().compile()
+            dt = _time.perf_counter() - t0
+            # per-program ledger entries: the split path's three programs
+            # have very different costs, and the ledger is what tells
+            # them apart (one blended "split_path" entry could not)
+            self.telemetry.compile_event(program, dt, compiled=compiled,
+                                         first_call=True)
+            total += dt
+        return total
 
     def generation_step(self, state: ESState):
         """Fused ES generation: returns (new_state, metrics dict)."""

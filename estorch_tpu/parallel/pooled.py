@@ -295,13 +295,20 @@ class PooledEngine:
             acts.block_until_ready()
         else:
             self._batch_actions(thetas[:warm_n], obs).block_until_ready()
+        fwd_dt = _time.perf_counter() - t0
+        # the forward warm is a traced-and-executed jit call (its compile
+        # can't be split from the warm execution), so its ledger entry
+        # carries wall seconds only — the AOT'd update below contributes
+        # XLA cost facts via its Compiled object
+        self.telemetry.compile_event("pooled_forward", fwd_dt,
+                                     first_call=True)
+        t1 = _time.perf_counter()
         dummy_w = jnp.zeros((self.config.population_size,), jnp.float32)
-        self.core._apply_weights.lower(state, dummy_w).compile()
-        dt = _time.perf_counter() - t0
-        self.telemetry.counters.inc("recompiles", 2)
-        self.telemetry.counters.gauge("compile_time_s", dt)
-        self.telemetry.event("compile", what="pooled_forward+update", dur_s=dt)
-        return dt
+        compiled = self.core._apply_weights.lower(state, dummy_w).compile()
+        self.telemetry.compile_event(
+            "apply_weights", _time.perf_counter() - t1, compiled=compiled,
+            first_call=True)
+        return _time.perf_counter() - t0
 
     compile_split = compile
 

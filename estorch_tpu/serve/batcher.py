@@ -187,8 +187,15 @@ class DynamicBatcher:
             # measured bit-consistency gate (see verify_stable_buckets);
             # the verification forwards also pre-compile every kept bucket,
             # so they count toward `recompiles` exactly once here
+            t0 = time.perf_counter()
             stable, excluded = verify_stable_buckets(
                 batch_fn, self.obs_shape, ladder)
+            # one ledger entry for the verification pass (it IS the
+            # ladder's compile cost); recompiles are counted per bucket
+            # below, so count_recompiles=0 here
+            self.obs.compile_event(
+                "bucket_verify", time.perf_counter() - t0,
+                count_recompiles=0, buckets=len(ladder), first_call=True)
             self.buckets = stable
             self.buckets_excluded = excluded
             for b in excluded:
@@ -321,7 +328,8 @@ class DynamicBatcher:
         obs = self.obs
         n = len(batch)
         bucket = self._bucket(n)
-        if bucket not in self._buckets_seen:
+        new_bucket = bucket not in self._buckets_seen
+        if new_bucket:
             # one XLA compile per bucket shape — this counter staying
             # ≤ len(self.buckets) under mixed load is the test contract
             self._buckets_seen.add(bucket)
@@ -352,6 +360,14 @@ class DynamicBatcher:
             obs.counters.inc("batch_errors_total")
             obs.event("batch_error", error=repr(e)[:200])
         dt = time.perf_counter() - t_predict
+        if new_bucket and err is None:
+            # a lazily-compiled bucket's first call is compile-dominated:
+            # its wall seconds are the closest thing to a compile time
+            # the dispatch path can observe (count_recompiles=0 — the
+            # seen-check above already counted it).  compile_event uses
+            # thread-safe primitives only, per the worker-thread contract
+            obs.compile_event(f"bucket_{bucket}", dt, count_recompiles=0,
+                              bucket=bucket, first_call=True)
         obs.counters.inc("predict_time_s_total", dt)
         obs.counters.gauge("batch_predict_ms_last", round(dt * 1e3, 3))
         obs.counters.inc("batches_total")

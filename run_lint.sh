@@ -17,6 +17,15 @@ echo "== obs selfcheck =="
 # before a JSONL consumer parses mismatched records
 python -m estorch_tpu.obs summarize --selfcheck
 
+echo "== obs profile selfcheck =="
+# performance-attribution gate (estorch_tpu/obs/profile/): a synthetic
+# run with known per-step FLOPs must produce exactly the expected MFU,
+# compile-ledger entries must round-trip the Prometheus exposition
+# parser, degenerate inputs must degrade to a note (never a crash), and
+# an injected 30% eval-phase slowdown must be flagged NAMING the eval
+# phase.  Stdlib+numpy, sub-second.
+python -m estorch_tpu.obs profile --selfcheck
+
 echo "== obs regress selfcheck =="
 # perf-gate gate (estorch_tpu/obs/export/regress.py): the statistical
 # regression detector must flag a synthetic 30% slowdown injected into a

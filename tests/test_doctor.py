@@ -49,6 +49,100 @@ class TestProbeClassifier:
         assert "backend exploded" in out["stderr_tail"]
 
 
+class TestCheckDevice:
+    """The typed staged probe (check_device): reason-code taxonomy on
+    the pure classifier, hang classification on controlled children that
+    wedge at a KNOWN stage, and the healthy path against this image's
+    CPU backend."""
+
+    def test_classifier_taxonomy(self):
+        c = doctor.classify_device_probe
+        ok = "PROBE_START\nPROBE_JAX_OK\nPROBE_DEVICES_OK cpu 1\n" \
+             "PROBE_COMPILE_OK\nPROBE_EXEC_OK\n"
+        assert c(ok, False, 0) == ("ok", None)
+        assert c("", True, None) == ("failed", "init-hang")
+        assert c("PROBE_START\nPROBE_JAX_OK\n", True, None) == \
+            ("failed", "init-hang")
+        assert c("PROBE_START\nPROBE_JAX_OK\nPROBE_DEVICES_OK cpu 1\n",
+                 True, None) == ("failed", "compile-hang")
+        assert c("PROBE_START\nPROBE_JAX_OK\nPROBE_DEVICES_OK cpu 1\n"
+                 "PROBE_COMPILE_OK\n", True, None) == \
+            ("failed", "exec-hang")
+        # failed FAST before device init: the backend said no — not a wedge
+        assert c("PROBE_START\nPROBE_JAX_OK\n", False, 1) == \
+            ("failed", "no-device")
+        # failed fast AFTER devices existed: error, read the stderr
+        assert c("PROBE_START\nPROBE_JAX_OK\nPROBE_DEVICES_OK cpu 1\n",
+                 False, 1) == ("failed", "error")
+
+    def test_healthy_cpu_probe_is_fast_and_typed(self):
+        """On this image's CPU backend the full staged probe (import →
+        devices → compile → execute) must come back ok in seconds — the
+        <30s platform-decision contract bench.py builds on."""
+        out = doctor.check_device(timeout_s=60.0)
+        assert out["status"] == "ok"
+        assert out["platform"] == "cpu"
+        assert out["n_devices"] >= 1
+        assert "reason" not in out
+        assert out["elapsed_s"] < 30.0
+
+    def test_compile_hang_classified(self, monkeypatch):
+        monkeypatch.setattr(doctor, "_STAGED_PROBE", (
+            'print("PROBE_START", flush=True)\n'
+            'print("PROBE_JAX_OK", flush=True)\n'
+            'print("PROBE_DEVICES_OK cpu 1", flush=True)\n'
+            "import time; time.sleep(60)\n"))
+        out = doctor.check_device(timeout_s=1.0)
+        assert out["status"] == "failed"
+        assert out["reason"] == "compile-hang"
+        assert out["platform"] == "cpu"  # the layer that DID answer
+
+    def test_init_hang_classified(self, monkeypatch):
+        monkeypatch.setattr(doctor, "_STAGED_PROBE", (
+            'print("PROBE_START", flush=True)\n'
+            "import time; time.sleep(60)\n"))
+        out = doctor.check_device(timeout_s=1.0)
+        assert out["status"] == "failed"
+        assert out["reason"] == "init-hang"
+
+    def test_no_device_failure_is_fast(self, monkeypatch):
+        monkeypatch.setattr(doctor, "_STAGED_PROBE", (
+            'print("PROBE_START", flush=True)\n'
+            'import sys\n'
+            'print("no backend here", file=sys.stderr)\n'
+            "sys.exit(1)\n"))
+        out = doctor.check_device(timeout_s=30.0)
+        assert out["status"] == "failed"
+        assert out["reason"] == "no-device"
+        assert "no backend here" in out["stderr_tail"]
+        assert out["elapsed_s"] < 10.0
+
+    def test_platform_pin_reaches_child(self, monkeypatch):
+        monkeypatch.setattr(doctor, "_STAGED_PROBE", (
+            "import os, sys\n"
+            'plat = os.environ.get("JAX_PLATFORMS", "unset")\n'
+            'print("PROBE_DEVICES_OK", plat, 1, flush=True)\n'
+            "sys.exit(1)\n"))
+        out = doctor.check_device(timeout_s=30.0, platform="tpu")
+        # the stub echoes the env pin back through the DEVICES marker
+        assert out["requested_platform"] == "tpu"
+        assert out["platform"] == "tpu"
+
+    def test_report_gains_device_probe_row(self, monkeypatch):
+        monkeypatch.setattr(
+            doctor, "check_device",
+            lambda timeout_s=20.0, platform=None: {
+                "status": "failed", "reason": "init-hang",
+                "elapsed_s": timeout_s, "timeout_s": timeout_s})
+        rep = doctor.report(timeout_s=5)
+        assert rep["device_probe"]["reason"] == "init-hang"
+        # ONE staged probe serves both rows: the legacy device summary
+        # is derived from the same verdict (a *-hang reason = wedged),
+        # so a wedged host pays one timeout, not two serial ones
+        assert rep["device"]["status"] == "wedged"
+        assert rep["device"]["timeout_s"] == 5
+
+
 class TestOptionalDeps:
     def test_missing_parent_package_never_crashes(self, monkeypatch):
         """find_spec('pkg.sub') raises ModuleNotFoundError when pkg itself
@@ -247,9 +341,12 @@ class TestServeCheck:
 
 class TestReport:
     def test_report_shape_and_hints(self, monkeypatch):
-        monkeypatch.setattr(doctor, "probe_device",
-                            lambda timeout_s: {"status": "wedged",
-                                               "timeout_s": timeout_s})
+        monkeypatch.setattr(
+            doctor, "check_device",
+            lambda timeout_s=20.0, platform=None: {
+                "status": "failed", "reason": "init-hang",
+                "elapsed_s": timeout_s, "timeout_s": timeout_s,
+                "stderr_tail": ""})
         rep = doctor.report()
         assert rep["device"]["status"] == "wedged"
         assert "cpu" in rep["hint"]
@@ -267,19 +364,21 @@ class TestReport:
                                                monkeypatch):
         from estorch_tpu.obs import Heartbeat
 
-        monkeypatch.setattr(doctor, "probe_device",
-                            lambda timeout_s: {"status": "healthy",
-                                               "platform": "cpu",
-                                               "n_devices": 8})
+        monkeypatch.setattr(
+            doctor, "check_device",
+            lambda timeout_s=20.0, platform=None: {
+                "status": "ok", "platform": "cpu", "n_devices": 8,
+                "elapsed_s": 1.0, "timeout_s": timeout_s})
         Heartbeat(str(tmp_path / "heartbeat.json")).beat("update", 11)
         rep = doctor.report(run_dir=str(tmp_path))
         assert rep["obs"]["heartbeat"]["generation"] == 11
 
     def test_cli_json_and_exit_code(self, monkeypatch, capsys):
-        monkeypatch.setattr(doctor, "probe_device",
-                            lambda timeout_s: {"status": "healthy",
-                                               "platform": "cpu",
-                                               "n_devices": 8})
+        monkeypatch.setattr(
+            doctor, "check_device",
+            lambda timeout_s=20.0, platform=None: {
+                "status": "ok", "platform": "cpu", "n_devices": 8,
+                "elapsed_s": 1.0, "timeout_s": timeout_s})
         rc = doctor.main(["--timeout", "5"])
         rep = json.loads(capsys.readouterr().out)
         assert rc == 0
