@@ -325,7 +325,20 @@ def measure_one(cfg, force_cpu=False):
 
     env, pk = _env_and_policy(cfg)
     on_tpu = not force_cpu and jax.devices()[0].platform == "tpu"
-    dtype = cfg.get("dtype", "bfloat16" if on_tpu else "float32")
+    # the param-sharded engine (estorch_tpu/parallel/sharded.py,
+    # docs/sharding.md) is f32-only; replicated rows keep the platform
+    # default
+    shard = bool(cfg.get("shard"))
+    dtype = cfg.get("dtype",
+                    "float32" if shard
+                    else ("bfloat16" if on_tpu else "float32"))
+    shard_kwargs = {}
+    if shard:
+        shard_kwargs = dict(
+            shard_params=True,
+            model_shards=cfg.get("model_shards"),
+            noise_mode=cfg.get("noise_mode", "auto"),
+        )
     es = ES(
         policy=MLPPolicy,
         agent=JaxAgent,
@@ -346,6 +359,7 @@ def measure_one(cfg, force_cpu=False):
         # stage parent set.  The --obs-ab rows pass an explicit bool to
         # measure the spans' own overhead
         telemetry=cfg.get("telemetry"),
+        **shard_kwargs,
     )
     gens = cfg.get("gens", 5)
     es.train(1, verbose=False)  # warm-up generation (compile + AOT sanity)
@@ -379,7 +393,13 @@ def measure_one(cfg, force_cpu=False):
     # cpu_calibrated so nobody reads it against accelerator silicon
     from estorch_tpu.obs.profile import platform_roofline, profile_records
 
-    flops_per_step = policy_flops_per_member_step(cfg)
+    # MFU numerator comes from the run's OWN cost model when one was
+    # built (shard-aware since the sharded engine landed: noise mode,
+    # low-rank forward term, per-device attribution ride along); the
+    # static helper is the fallback for telemetry-off rows
+    cost_model = getattr(es.obs, "cost_model", None) or {}
+    flops_per_step = (cost_model.get("flops_per_env_step")
+                      or policy_flops_per_member_step(cfg))
     if platform == "tpu":
         roof = platform_roofline("tpu")
         mfu = rate * flops_per_step / roof["peak_flops_per_s"]
@@ -417,7 +437,7 @@ def measure_one(cfg, force_cpu=False):
         compile_block = prof.get("compile")
     except Exception as e:  # noqa: BLE001 — attribution must not kill a row
         print(f"bench: phase attribution failed: {e!r}", file=sys.stderr)
-    return {
+    out = {
         "rate": rate,
         "platform": platform,
         "dtype": dtype,
@@ -429,6 +449,20 @@ def measure_one(cfg, force_cpu=False):
         "peak_rss_gb": peak_rss,
         "cfg": cfg,
     }
+    if shard:
+        # peak-memory extras: XLA's per-device argument/output/temp bytes
+        # for the compiled (sharded, donated) generation program — with
+        # sharded inputs those ARE shard sizes (compile ledger contract)
+        out["shard"] = {
+            "noise_mode": es.engine.noise_mode,
+            "mesh": {"pop": es.engine.pop_shards,
+                     "model": es.engine.model_shards},
+            "per_device_peak_bytes": es.engine.memory_facts().get(
+                "peak_bytes"),
+            "mfu_from_cost_model": bool(
+                cost_model.get("flops_per_env_step")),
+        }
+    return out
 
 
 def measure_reference_style_baseline(budget_s=6.0) -> float:
@@ -780,6 +814,152 @@ def stage_chaos(selfcheck=False):
         "pass": recovered,
     }), flush=True)
     return 0 if recovered else 1
+
+
+def measure_shard_ab(cfg):
+    """Child body for --stage-shard-ab-one: replicated vs param-sharded
+    same-seed A/B on the virtual CPU mesh (estorch_tpu/parallel/sharded.py,
+    docs/sharding.md).  Three legs in one process:
+
+    1. numerical — a table-noise sharded run must match the replicated
+       fused path allclose at f32 (reduction order is the only licensed
+       difference);
+    2. memory — per-device peak bytes (compile ledger memory_analysis;
+       shard sizes for sharded inputs) of the sharded program vs the
+       replicated program's on the SAME config;
+    3. sharded row — the program-noise sharded config's rate + MFU from
+       the shard-aware cost model (the headline row's recipe).
+    """
+    from estorch_tpu.utils import enable_compilation_cache, force_cpu_backend
+
+    force_cpu_backend(8)
+    enable_compilation_cache()
+    import numpy as np
+    import optax
+
+    from estorch_tpu import ES, JaxAgent, MLPPolicy
+    from estorch_tpu.envs import SyntheticEnv
+
+    env = SyntheticEnv()
+    pk = {"action_dim": env.action_dim, "hidden": tuple(cfg["hidden"]),
+          "discrete": False, "action_scale": 1.0}
+    common = dict(
+        policy=MLPPolicy, agent=JaxAgent, optimizer=optax.adam,
+        population_size=cfg["population"], sigma=0.05,
+        policy_kwargs=pk,
+        agent_kwargs={"env": env, "horizon": cfg["horizon"]},
+        optimizer_kwargs={"learning_rate": 1e-2}, seed=0,
+        eval_chunk=cfg.get("eval_chunk", 8),
+        table_size=cfg.get("table_size", 1 << 21),
+        telemetry=True,
+    )
+    gens = int(cfg.get("gens", 3))
+    out = {"cfg": cfg}
+
+    def ledger_peak(es, program):
+        for rec in es.history:
+            for e in rec.get("compile_events", []):
+                if e.get("program") == program and "peak_bytes" in e:
+                    return e["peak_bytes"]
+        return None
+
+    es_r = ES(**common)
+    es_r.train(gens, verbose=False)
+    es_s = ES(shard_params=True, noise_mode="table",
+              model_shards=cfg.get("model_shards"), **common)
+    es_s.train(gens, verbose=False)
+    a = np.asarray(es_r.state.params_flat)
+    b = np.asarray(es_s.state.params_flat)
+    max_rel = float(np.max(np.abs(a - b) / np.maximum(np.abs(a), 1e-6)))
+    out["numerical"] = {
+        "match": bool(np.allclose(a, b, rtol=2e-4, atol=1e-5)),
+        "max_rel_err": max_rel,
+        "steps_equal": all(
+            r1["env_steps"] == r2["env_steps"]
+            for r1, r2 in zip(es_r.history, es_s.history)),
+        "generations": gens,
+    }
+    # the sharded headline-row recipe: program noise, rate + MFU from
+    # the shard-aware cost model
+    prog_cfg = {**cfg, "shard": True, "telemetry": True}
+    prog_cfg.pop("table_size", None)
+    row = measure_one(prog_cfg, force_cpu=False)  # backend already forced
+    out["sharded_row"] = {
+        "rate": round(row["rate"], 1),
+        "mfu": row["mfu"],
+        "mfu_basis": row["mfu_basis"],
+        **(row.get("shard") or {}),
+    }
+    # memory verdict: the SCALING mode (program noise — the sharded
+    # default) vs the replicated program, per-device.  The table-mode
+    # peak is reported but not gated: its 4·table_size replicated
+    # argument is counted by memory_analysis while the replicated
+    # engine's closed-over table lowers as an embedded constant the
+    # arg/temp accounting does not see — comparing those two would be
+    # apples to oranges (the parity mode exists for numerics, not scale)
+    rep_peak = ledger_peak(es_r, "generation_step")
+    prog_peak = out["sharded_row"].get("per_device_peak_bytes")
+    out["memory"] = {
+        "replicated_per_device_peak_bytes": rep_peak,
+        "sharded_per_device_peak_bytes": prog_peak,
+        "sharded_table_mode_peak_bytes": ledger_peak(
+            es_s, "generation_step_sharded"),
+        "ratio": (round(prog_peak / rep_peak, 4)
+                  if rep_peak and prog_peak else None),
+        # the analytic replicated bound the test narrative uses: params
+        # + adam moments, f32, on EVERY device when replicated
+        "replicated_state_bytes": int(3 * es_r.engine.spec.dim * 4),
+    }
+    return out
+
+
+def stage_shard_ab(selfcheck=False):
+    """Replicated-vs-sharded A/B via the stage protocol; the selfcheck
+    form is the run_lint.sh gate.  Exit 0 only when the sharded path (1)
+    matches the replicated fused path numerically at the same seed, (2)
+    fits in LESS per-device memory than the replicated program on the
+    same config, and (3) produces a non-null MFU from the shard-aware
+    cost model."""
+    cfg = ({"env": "synthetic", "hidden": [64, 64], "population": 32,
+            "horizon": 50, "gens": 3, "eval_chunk": 8}
+           if selfcheck else
+           {"env": "synthetic", "hidden": [768, 768], "population": 64,
+            "horizon": 100, "gens": 3, "eval_chunk": 8})
+    argv = [sys.executable, __file__, "--stage-shard-ab-one",
+            json.dumps(cfg)]
+    try:
+        r = subprocess.run(
+            argv, timeout=900, capture_output=True, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    except subprocess.TimeoutExpired:
+        print(json.dumps({"label": "shard/ab",
+                          "error": "timeout after 900s"}), flush=True)
+        return 1
+    try:
+        last = [ln for ln in r.stdout.strip().splitlines()
+                if ln.startswith("{")][-1]
+        row = json.loads(last)
+    except (IndexError, ValueError):
+        print(json.dumps({"label": "shard/ab",
+                          "error": f"stage exited {r.returncode}",
+                          "stderr_tail": r.stderr[-800:]}), flush=True)
+        return 1
+    num = row.get("numerical") or {}
+    mem = row.get("memory") or {}
+    srow = row.get("sharded_row") or {}
+    mem_ok = (mem.get("ratio") is not None and mem["ratio"] < 1.0)
+    verdict = {
+        "label": "shard/ab",
+        "numerical_match": bool(num.get("match")),
+        "max_rel_err": num.get("max_rel_err"),
+        "steps_equal": bool(num.get("steps_equal")),
+        "memory": mem,
+        "sharded_row": srow,
+        "pass": (bool(num.get("match")) and bool(num.get("steps_equal"))
+                 and mem_ok and srow.get("mfu") is not None),
+    }
+    print(json.dumps(verdict), flush=True)
+    return 0 if verdict["pass"] else 1
 
 
 def measure_serve_one(cfg):
@@ -1139,6 +1319,21 @@ def main():
         "device_probe": {**probe, "cpu_fallback": fell_back},
         "phases_headline": result.get("phases"),
     }
+    # the sharded headline row (docs/sharding.md): the big-policy shape on
+    # the param-sharded engine — in-program noise, donated generations,
+    # MFU from the shard-aware cost model, per-device peak bytes from the
+    # compile ledger.  Measured on both platforms (f32 by engine contract)
+    shard_cfg = {**BIG, "shard": True, "gens": 3 if on_tpu else 2}
+    r = run_stage(shard_cfg, timeout_s=600 if on_tpu else 1200,
+                  force_cpu=not on_tpu)
+    extras["sharded"] = (
+        {"rate": round(r["rate"], 1),
+         "mfu": round(r["mfu"], 6) if r["mfu"] is not None else None,
+         "dtype": r["dtype"],
+         **({} if on_tpu else {"cpu_relative": True}),
+         **(r.get("shard") or {})}
+        if r else None
+    )
     if on_tpu:
         for name, base in (("big_policy", BIG), ("pop10k", POP10K),
                            ("locomotion", LOCO)):
@@ -1202,8 +1397,11 @@ no arguments        full headline benchmark (device probe decides the
   --obs-ab          telemetry-overhead A/B
   --chaos [--selfcheck]   recovery-overhead A/B under injected faults
   --serve [--selfcheck]   dynamic-batching serving A/B
+  --shard-ab [--selfcheck]  replicated vs param-sharded same-seed A/B
+                    (numerical match + per-device peak bytes + MFU row)
   --regress [BASELINE] [--repeats N] [--cpu]   gate vs newest BENCH_r*.json
-(--stage-one/--stage-chaos-one/--stage-serve-one are internal child modes)
+(--stage-one/--stage-chaos-one/--stage-serve-one/--stage-shard-ab-one are
+ internal child modes)
 """
 
 
@@ -1228,6 +1426,16 @@ if __name__ == "__main__":
     elif "--stage-chaos-one" in sys.argv:
         cfg = json.loads(sys.argv[sys.argv.index("--stage-chaos-one") + 1])
         print(json.dumps(measure_chaos_one(cfg)))
+    elif "--stage-shard-ab-one" in sys.argv:
+        cfg = json.loads(sys.argv[sys.argv.index("--stage-shard-ab-one") + 1])
+        print(json.dumps(measure_shard_ab(cfg)))
+    elif "--shard-ab" in sys.argv:
+        # the selfcheck form runs inside run_lint.sh (tiny config, forced
+        # CPU mesh in the child): skip the evidence lock a full
+        # measurement takes
+        if "--selfcheck" not in sys.argv:
+            _lock_or_warn()
+        sys.exit(stage_shard_ab(selfcheck="--selfcheck" in sys.argv))
     elif "--stage-serve-one" in sys.argv:
         cfg = json.loads(sys.argv[sys.argv.index("--stage-serve-one") + 1])
         print(json.dumps(measure_serve_one(cfg)))

@@ -115,6 +115,10 @@ class ES:
         obs_probe_episodes: int = 1,
         obs_warmup_episodes: int = 0,
         telemetry=None,
+        shard_params: bool = False,
+        model_shards: int | None = None,
+        partition_rules=None,
+        noise_mode: str = "auto",
     ):
         # telemetry first: every backend-init path below runs with spans/
         # counters available.  None → default-on honoring ESTORCH_OBS /
@@ -148,6 +152,25 @@ class ES:
                 "obs_warmup_episodes warm-starts the running obs stats; "
                 "it requires obs_norm=True"
             )
+        # hyperscale param sharding (parallel/sharded.py, docs/sharding.md):
+        # params + optimizer state sharded over a (pop, model) mesh per
+        # regex partition rules, ε generated in-program, generation_step
+        # donated — for policies too big to replicate per device
+        self._shard_params = bool(shard_params)
+        self._model_shards = model_shards
+        self._partition_rules = partition_rules
+        if noise_mode not in ("auto", "program", "table"):
+            raise ValueError(
+                f"noise_mode must be auto|program|table, got {noise_mode!r}")
+        self._noise_mode = (
+            "program" if noise_mode == "auto" else noise_mode)
+        if not shard_params and (model_shards is not None
+                                 or partition_rules is not None
+                                 or noise_mode != "auto"):
+            raise ValueError(
+                "model_shards/partition_rules/noise_mode configure the "
+                "param-sharded engine; pass shard_params=True"
+            )
 
         self._policy_arg = policy
         self._policy_kwargs = dict(policy_kwargs or {})
@@ -160,6 +183,11 @@ class ES:
         # the host marker, so it is checked first; `env` only routes to the
         # device path when it is a JaxEnv (pure reset/step + static dims).
         if hasattr(self.agent, "rollout"):
+            if shard_params:
+                raise ValueError(
+                    "shard_params is a device-path option "
+                    "(parallel/sharded.py); host torch agents replicate"
+                )
             if compute_dtype != "float32":
                 raise ValueError(
                     "compute_dtype is a device/pooled-path option; the host "
@@ -209,6 +237,12 @@ class ES:
             self.backend = "device"
         elif hasattr(self.agent, "env_name"):
             # pooled path: C++ envpool stepping + device-batched inference
+            if shard_params:
+                raise ValueError(
+                    "shard_params needs device-native rollouts: the pooled "
+                    "path materializes per-member thetas host-side, the "
+                    "exact replicate the sharded engine exists to avoid"
+                )
             if self._obs_warmup_episodes:
                 raise ValueError(
                     "obs_warmup_episodes is a device-path option; the "
@@ -236,12 +270,39 @@ class ES:
         def vbn_ref(vbn_key):
             return collect_reference_batch(self.env, vbn_key, n_steps=vbn_batch)
 
+        if self._shard_params and mesh is None:
+            from ..parallel.mesh import hyperscale_mesh
+
+            devs = (
+                [device] if device is not None
+                and not isinstance(device, (list, tuple)) else device
+            )
+            mesh = hyperscale_mesh(model_shards=self._model_shards,
+                                   devices=devs)
         flat, state_key = self._init_flax_common(
             policy, dict(policy_kwargs or {}), optimizer,
             dict(optimizer_kwargs or {}), obs0, self.agent.rollout_horizon,
             vbn_ref, table_size, eval_chunk, grad_chunk, weight_decay,
             mesh, device,
         )
+        if self._shard_params:
+            from ..parallel.sharded import ShardedESEngine
+
+            if self._recurrent:
+                raise ValueError(
+                    "shard_params currently supports feedforward policies; "
+                    "recurrent carries stay on the replicated engine "
+                    "(docs/sharding.md)"
+                )
+            self.engine = ShardedESEngine(
+                self.env, self._policy_apply, self._spec, self.table,
+                self.optimizer, self.config, self.mesh,
+                partition_rules=self._partition_rules,
+                noise_mode=self._noise_mode,
+            )
+            self.state = self.engine.init_state(flat, state_key)
+            self._post_engine_init()
+            return
         dec_apply = None
         if self._decomposed:
             from ..models.decomposed import mlp_decomposed_apply, supports_decomposed
@@ -369,7 +430,12 @@ class ES:
 
         self._policy_apply = policy_apply
         flat, self._spec = make_param_spec(params)
-        self.table = make_noise_table(table_size, seed=self.seed)
+        # sharded program-mode noise never touches a table — don't spend
+        # 4·table_size bytes of HBM on one (the whole point of in-program ε)
+        self.table = (
+            None if (self._shard_params and self._noise_mode != "table")
+            else make_noise_table(table_size, seed=self.seed)
+        )
         self.optimizer = _as_optax(optimizer, optimizer_kwargs)
         self.mesh = mesh if mesh is not None else population_mesh(
             [device] if device is not None and not isinstance(device, (list, tuple)) else device
@@ -625,7 +691,14 @@ class ES:
                     self.state, metrics = self.engine.generation_step(
                         prev_state)
                 with obs.phase("device"):
-                    jax.block_until_ready(self.state.params_flat)
+                    if self._shard_params:
+                        # donated sharded state: fence on the sharded
+                        # leaves — .params_flat would GATHER the full
+                        # vector every generation
+                        jax.block_until_ready(
+                            jax.tree_util.tree_leaves(self.state.params))
+                    else:
+                        jax.block_until_ready(self.state.params_flat)
                 with obs.phase("host_sync"):
                     fitness = np.asarray(metrics["fitness"])
             else:
@@ -653,7 +726,13 @@ class ES:
                 reason = ("non-finite parameters/update norm after the "
                           "optimizer step")
             if reason is not None:
-                self.state = prev_state
+                if self._shard_params:
+                    # the donated program already rolled back in-program
+                    # (same generation, params/opt untouched —
+                    # parallel/sharded.py); prev_state's buffers are gone
+                    pass
+                else:
+                    self.state = prev_state
                 rejected_streak += 1
                 obs.counters.inc("generations_rejected")
                 obs.event("generation_rejected", reason=reason,
@@ -670,6 +749,7 @@ class ES:
             record = self._base_record(
                 prev_state, fitness, int(metrics["steps"]),
                 float(np.asarray(metrics["grad_norm"])), dt,
+                metrics=metrics if self._shard_params else None,
             )
             self._emit_record(record, log_fn, verbose)
             done += 1
@@ -717,22 +797,39 @@ class ES:
                 episodes = int(self.config.episodes_per_member)
             if not shapes:
                 return None
+            mesh = getattr(self, "mesh", None)
+            n_devices = int(mesh.devices.size) if mesh is not None else 1
+            model_shards = 1
+            if self._shard_params:
+                from ..parallel.mesh import MODEL_AXIS
+
+                model_shards = int(dict(zip(
+                    mesh.axis_names, mesh.devices.shape))[MODEL_AXIS])
             return generation_cost(
                 population=self.population_size, matmul_shapes=shapes,
                 param_dim=param_dim, horizon=horizon,
                 episodes_per_member=episodes, mirrored=self._mirrored,
-                low_rank=self._low_rank, dtype_bytes=dtype_bytes)
+                low_rank=self._low_rank, dtype_bytes=dtype_bytes,
+                noise=(self._noise_mode if self._shard_params else "table"),
+                n_devices=n_devices, model_shards=model_shards)
         except Exception:  # noqa: BLE001 — diagnostic, never construction
             return None
 
     # ------------------------------------------- shared generation plumbing
 
-    def _track_best(self, prev_state, fitness: np.ndarray) -> tuple[float, bool]:
+    def _track_best(self, prev_state, fitness: np.ndarray,
+                    metrics: dict | None = None) -> tuple[float, bool]:
         """Best-member snapshot (reference: es.best_policy/best_reward).
         Returns (generation max, whether a new best was set).
 
         NaN-aware: failed members (host fault tolerance marks them NaN) must
         not disable best tracking or poison the metrics.
+
+        ``metrics`` is the sharded path's donated-state protocol: the
+        generation program already reconstructed the best member's θ
+        (``metrics["best_theta"]``, sharded) because ``prev_state`` —
+        which ``member_params`` would need — was donated; the gather
+        happens only on improvement.
         """
         finite_any = np.isfinite(fitness).any()
         gen_best = float(np.nanmax(fitness)) if finite_any else float("nan")
@@ -740,14 +837,23 @@ class ES:
         if improved:
             self.best_reward = gen_best
             idx = int(np.nanargmax(fitness))
-            self._best_flat = np.asarray(self.engine.member_params(prev_state, idx))
+            if metrics is not None and "best_theta" in metrics:
+                from jax.flatten_util import ravel_pytree
+
+                self._best_flat = np.asarray(
+                    ravel_pytree(metrics["best_theta"])[0])
+            else:
+                self._best_flat = np.asarray(
+                    self.engine.member_params(prev_state, idx))
         return gen_best, improved
 
-    def _base_record(self, prev_state, fitness, steps, grad_norm, dt) -> dict:
+    def _base_record(self, prev_state, fitness, steps, grad_norm, dt,
+                     metrics: dict | None = None) -> dict:
         with self.obs.phase("record"):
             # best-member snapshot can dispatch a device program
             # (member_params) — it deserves phase attribution too
-            gen_best, improved = self._track_best(prev_state, fitness)
+            gen_best, improved = self._track_best(prev_state, fitness,
+                                                  metrics)
         finite_any = np.isfinite(fitness).any()
         record = {
             "generation": self.generation,
@@ -760,7 +866,11 @@ class ES:
             "env_steps": steps,
             "env_steps_per_sec": steps / dt if dt > 0 else 0.0,
             "grad_norm": grad_norm,
-            "sigma": float(np.asarray(prev_state.sigma))
+            # the sharded path donates prev_state — its pre-step σ rides
+            # the metrics instead of a (deleted) state buffer
+            "sigma": float(np.asarray(metrics["sigma"]))
+            if metrics is not None and "sigma" in metrics
+            else float(np.asarray(prev_state.sigma))
             if hasattr(prev_state, "sigma") and prev_state.sigma is not None
             else self.sigma,
             "wall_time_s": dt,
@@ -820,7 +930,17 @@ class ES:
             "low_rank": self._low_rank,
             "decomposed": self._decomposed,
             "streamed": self._streamed,
+            "shard_params": self._shard_params,
         }
+        if self._shard_params:
+            from ..parallel.mesh import partition_rules_to_json
+
+            cfg["noise_mode"] = self._noise_mode
+            cfg["mesh_axes"] = dict(zip(
+                self.mesh.axis_names,
+                [int(s) for s in self.mesh.devices.shape]))
+            cfg["partition_rules"] = partition_rules_to_json(
+                self.engine.partition_rules)
         mesh = getattr(self, "mesh", None)
         devices = list(mesh.devices.flat) if mesh is not None else None
         return collect_manifest(config=cfg, devices=devices, extra=extra)

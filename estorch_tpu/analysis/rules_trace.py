@@ -297,3 +297,213 @@ def check_missing_donation(ctx: ModuleContext):
             "drops the old value, as update loops do)",
             ctx.qualnames[fn]))
     return out
+
+
+# ---------------------------------------------------------------------
+# R10 unsharded-capture
+# ---------------------------------------------------------------------
+#
+# A jit application that spells out in_shardings/out_shardings is a
+# SHARDED program: its operands are placed per an explicit mesh layout.
+# A host-materialized array (np.random output, a large np constant, a
+# file load) closed over by such a program bypasses that placement — it
+# lowers as a baked-in constant, REPLICATED on every device (at
+# hyperscale sizes that is the exact per-device copy the sharding
+# exists to avoid), bloats the serialized executable past the
+# persistent-cache ceiling, and — for np.random — freezes untracked
+# host RNG into the trace.  Pass it as an operand (device_put with a
+# NamedSharding) or generate it in-program (ops/noise.py).
+#
+# Conservative by the R02/R03 philosophy: only provable host
+# materializations are flagged (np.random.*, np.load/loadtxt/fromfile,
+# and sized constructors whose LITERAL element count is large); jnp
+# arrays, small constants, and anything reaching the program as an
+# argument stay silent.
+
+_HOST_LOAD_CALLS = {"numpy.load", "numpy.loadtxt", "numpy.fromfile"}
+_HOST_SIZED_CTORS = {"numpy.zeros", "numpy.ones", "numpy.full",
+                     "numpy.empty", "numpy.arange"}
+_LARGE_ELEMENTS = 1 << 16  # 64k floats = 256 KiB — replicate-worthy
+
+
+def _const_int(node: ast.AST):
+    """Best-effort literal integer evaluation (Constant / unary / binop
+    arithmetic incl. shifts — the `1 << 20` idiom); None when unknown."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return node.value
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        v = _const_int(node.operand)
+        return -v if v is not None else None
+    if isinstance(node, ast.BinOp):
+        lv, rv = _const_int(node.left), _const_int(node.right)
+        if lv is None or rv is None:
+            return None
+        try:
+            if isinstance(node.op, ast.Add):
+                return lv + rv
+            if isinstance(node.op, ast.Sub):
+                return lv - rv
+            if isinstance(node.op, ast.Mult):
+                return lv * rv
+            if isinstance(node.op, ast.FloorDiv):
+                return lv // rv
+            if isinstance(node.op, ast.Pow):
+                return lv ** rv
+            if isinstance(node.op, ast.LShift):
+                return lv << rv
+        except Exception:  # noqa: BLE001 — overflow/zero-div in user code
+            return None
+    return None
+
+
+def _literal_elements(call: ast.Call):
+    """Element count of a sized-constructor call when its shape argument
+    is fully literal; None otherwise (stays silent — R02/R03 philosophy)."""
+    if not call.args:
+        return None
+    shape = call.args[0]
+    if isinstance(shape, (ast.Tuple, ast.List)):
+        total = 1
+        for el in shape.elts:
+            v = _const_int(el)
+            if v is None:
+                return None
+            total *= v
+        return total
+    return _const_int(shape)
+
+
+def _host_array_bindings(ctx: ModuleContext) -> dict[str, str]:
+    """{name: why} for MODULE-LEVEL names bound to provably
+    host-materialized arrays.
+
+    Module-level only, by the conservative contract: a bare name is not a
+    scope — recording function-local assigns would flag any jitted
+    function whose parameter or enclosing-scope operand merely SHARES a
+    name with some unrelated local elsewhere in the file (e.g. a helper's
+    own `table = np.random...` poisoning a legitimate `table` operand
+    parameter in another function).  Module-level constants are the
+    capture pattern the rule exists for, and their names are unambiguous."""
+    from .engine import enclosing_defs
+
+    enclosing = enclosing_defs(ctx.tree)
+    out: dict[str, str] = {}
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Assign) or not isinstance(node.value, ast.Call):
+            continue
+        if enclosing.get(node) is not None:
+            continue  # function-local binding: not a module constant
+        resolved = ctx.resolve(node.value.func)
+        if resolved is None:
+            continue
+        why = None
+        if resolved.startswith("numpy.random."):
+            why = f"`{resolved}` output (host RNG, untracked by jax)"
+        elif resolved in _HOST_LOAD_CALLS:
+            why = f"`{resolved}` result"
+        elif resolved in _HOST_SIZED_CTORS:
+            n = _literal_elements(node.value)
+            if n is not None and n >= _LARGE_ELEMENTS:
+                why = f"`{resolved}` constant of {n:,} elements"
+        if why is None:
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                out[tgt.id] = why
+    return out
+
+
+def _has_sharding_kwargs(keywords: list[ast.keyword]) -> bool:
+    return any(kw.arg in ("in_shardings", "out_shardings")
+               for kw in keywords)
+
+
+def _sharded_jit_targets(ctx: ModuleContext):
+    """Yield (fn_or_lambda, report_node) for every function a
+    sharding-spelling jit application provably traces: ``jax.jit(f,
+    in_shardings=...)`` with a Name/attribute/lambda argument, plus the
+    ``@partial(jax.jit, out_shardings=...)`` decorator form."""
+    for node in ast.walk(ctx.tree):
+        if (isinstance(node, ast.Call) and _jit_head(ctx, node.func)
+                and _has_sharding_kwargs(node.keywords) and node.args):
+            tgt = node.args[0]
+            if isinstance(tgt, ast.Lambda):
+                yield tgt, node
+                continue
+            name = (tgt.id if isinstance(tgt, ast.Name)
+                    else tgt.attr if isinstance(tgt, ast.Attribute)
+                    else None)
+            if name:
+                for fn in ctx.defs_by_name.get(name, []):
+                    yield fn, node
+    for fn in ctx.qualnames:
+        for dec in getattr(fn, "decorator_list", []):
+            if not isinstance(dec, ast.Call):
+                continue
+            head = ctx.resolve(dec.func)
+            is_partial = (head is not None
+                          and head.rsplit(".", 1)[-1] == "partial")
+            if (is_partial and dec.args and _jit_head(ctx, dec.args[0])
+                    and _has_sharding_kwargs(dec.keywords)):
+                yield fn, fn
+            elif _jit_head(ctx, dec.func) and _has_sharding_kwargs(dec.keywords):
+                yield fn, fn
+
+
+def _bound_names(fn: ast.AST) -> set[str]:
+    """Names the function body binds (params + stores anywhere inside,
+    nested defs included — a capture must come from OUTSIDE)."""
+    args = fn.args
+    bound = {a.arg for a in (args.posonlyargs + args.args
+                             + args.kwonlyargs)}
+    if args.vararg:
+        bound.add(args.vararg.arg)
+    if args.kwarg:
+        bound.add(args.kwarg.arg)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            bound.add(node.id)
+        elif isinstance(node, _FN_NODES):
+            bound.add(node.name)
+            for a in (node.args.posonlyargs + node.args.args
+                      + node.args.kwonlyargs):
+                bound.add(a.arg)
+        elif isinstance(node, ast.Lambda):
+            for a in (node.args.posonlyargs + node.args.args
+                      + node.args.kwonlyargs):
+                bound.add(a.arg)
+    return bound
+
+
+@rule("R10", "unsharded-capture", "warning",
+      "host-materialized array closed over by a sharded jitted program")
+def check_unsharded_capture(ctx: ModuleContext):
+    r = get_rule("R10")
+    host = _host_array_bindings(ctx)
+    if not host:
+        return []
+    out = []
+    seen: set[tuple[int, str]] = set()
+    for fn, report in _sharded_jit_targets(ctx):
+        bound = _bound_names(fn)
+        for node in ast.walk(fn):
+            if (not isinstance(node, ast.Name)
+                    or not isinstance(node.ctx, ast.Load)
+                    or node.id not in host or node.id in bound):
+                continue
+            key = (getattr(fn, "lineno", 0), node.id)
+            if key in seen:
+                continue
+            seen.add(key)
+            qualname = ctx.qualnames.get(fn, "<lambda>")
+            out.append(make_finding(
+                ctx, r, node,
+                f"`{node.id}` ({host[node.id]}) is closed over by a "
+                "sharded jitted program — it lowers as a constant, "
+                "replicated on every device despite the explicit "
+                "shardings",
+                "pass it as an operand (jax.device_put with a "
+                "NamedSharding, listed in in_shardings) or generate it "
+                "in-program (jax.random)",
+                qualname))
+    return out

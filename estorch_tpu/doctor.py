@@ -135,6 +135,44 @@ def classify_device_probe(out: str, timed_out: bool, returncode
     return "failed", "error"
 
 
+def _run_staged_probe(script: str, timeout_s: float, env: dict) -> dict:
+    """Run a marker-printing probe script in a killed-on-timeout child.
+
+    The ONE subprocess harness every staged probe shares (device + mesh):
+    file-captured stdout/stderr (a pipe's partials die with the kill; a
+    file needs no reader thread that could itself block), hard timeout,
+    SIGKILL + bounded reap with the un-reapable (D-state) child reported
+    as a finding of its own.  Returns {out, err, timed_out, returncode,
+    unreapable, elapsed_s} for the caller's classifier to shape.
+    """
+    import tempfile
+    import time
+
+    t0 = time.perf_counter()
+    with tempfile.TemporaryFile("w+") as fo, \
+            tempfile.TemporaryFile("w+") as fe:
+        proc = subprocess.Popen([sys.executable, "-c", script],
+                                stdout=fo, stderr=fe, text=True, env=env)
+        timed_out = False
+        unreapable = False
+        try:
+            proc.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            timed_out = True
+            proc.kill()
+            try:
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                unreapable = True  # D-state child: itself a finding
+        fo.seek(0), fe.seek(0)
+        out_text, err_text = fo.read(), fe.read()
+    return {
+        "out": out_text, "err": err_text, "timed_out": timed_out,
+        "returncode": proc.returncode, "unreapable": unreapable,
+        "elapsed_s": round(time.perf_counter() - t0, 2),
+    }
+
+
 def check_device(timeout_s: float = 20.0,
                  platform: str | None = None) -> dict:
     """Prove the device path alive-or-wedged in SECONDS with a typed
@@ -150,48 +188,110 @@ def check_device(timeout_s: float = 20.0,
     discipline).
     """
     import os
-    import tempfile
-    import time
 
     env = dict(os.environ)
     if platform is not None:
         env["JAX_PLATFORMS"] = platform
-    t0 = time.perf_counter()
-    with tempfile.TemporaryFile("w+") as fo, \
-            tempfile.TemporaryFile("w+") as fe:
-        proc = subprocess.Popen([sys.executable, "-c", _STAGED_PROBE],
-                                stdout=fo, stderr=fe, text=True, env=env)
-        timed_out = False
-        unreapable = False
-        try:
-            proc.wait(timeout=timeout_s)
-        except subprocess.TimeoutExpired:
-            timed_out = True
-            proc.kill()
-            try:
-                proc.wait(timeout=5)
-            except subprocess.TimeoutExpired:
-                unreapable = True  # D-state child: itself a finding
-        fo.seek(0), fe.seek(0)
-        out_text, err_text = fo.read(), fe.read()
-    status, reason = classify_device_probe(out_text, timed_out,
-                                           proc.returncode)
+    run = _run_staged_probe(_STAGED_PROBE, timeout_s, env)
+    status, reason = classify_device_probe(run["out"], run["timed_out"],
+                                           run["returncode"])
     result: dict = {
         "status": status,
-        "elapsed_s": round(time.perf_counter() - t0, 2),
+        "elapsed_s": run["elapsed_s"],
         "timeout_s": timeout_s,
     }
     if platform is not None:
         result["requested_platform"] = platform
-    for ln in out_text.splitlines():
+    for ln in run["out"].splitlines():
         if ln.startswith("PROBE_DEVICES_OK"):
             _, plat, n = ln.split()
             result["platform"] = plat
             result["n_devices"] = int(n)
     if reason is not None:
         result["reason"] = reason
-        result["stderr_tail"] = err_text[-500:]
-    if unreapable:
+        result["stderr_tail"] = run["err"][-500:]
+    if run["unreapable"]:
+        result["unreapable_child"] = True
+    return result
+
+
+# mesh probe: proves the param-sharded path (parallel/sharded.py,
+# docs/sharding.md) can run on THIS host's virtual CPU mesh — 2-D mesh
+# build, partition-rule resolution over a dummy tree, and one sharded
+# dummy program (donated params operand, explicit out_shardings)
+# compiled AND executed.  Forced onto the CPU backend in the child so
+# the probe cannot touch (or wedge on) a real device runtime.
+_MESH_PROBE = """
+import sys
+print("MESH_START", flush=True)
+from estorch_tpu.utils import force_cpu_backend
+force_cpu_backend(8)
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+from estorch_tpu.parallel.mesh import (DEFAULT_PARTITION_RULES,
+                                       hyperscale_mesh,
+                                       match_partition_rules)
+mesh = hyperscale_mesh(2, 4)
+print("MESH_BUILD_OK", mesh.devices.size, flush=True)
+tree = {"dense": {"kernel": jnp.zeros((8, 16)), "bias": jnp.zeros((16,))}}
+sh = match_partition_rules(DEFAULT_PARTITION_RULES, tree, mesh)
+params = jax.device_put(tree, sh)
+print("MESH_RULES_OK", flush=True)
+fn = jax.jit(
+    lambda p: jax.tree_util.tree_map(lambda x: x * 2.0, p),
+    donate_argnums=(0,), in_shardings=(sh,), out_shardings=sh)
+compiled = fn.lower(params).compile()
+print("MESH_COMPILE_OK", flush=True)
+out = compiled(params)
+jax.block_until_ready(out)
+print("MESH_EXEC_OK", flush=True)
+"""
+
+_MESH_STAGES = (
+    ("MESH_BUILD_OK", "mesh-build"),
+    ("MESH_RULES_OK", "partition-rules"),
+    ("MESH_COMPILE_OK", "sharded-compile"),
+    ("MESH_EXEC_OK", "sharded-exec"),
+)
+
+
+def classify_mesh_probe(out: str, timed_out: bool, returncode
+                        ) -> tuple[str, str | None]:
+    """(status, failed-stage) from the mesh probe's markers — pure, so
+    the taxonomy is unit-testable without a mesh."""
+    markers = {ln.split()[0] for ln in out.splitlines() if ln.strip()}
+    if "MESH_EXEC_OK" in markers and not timed_out and returncode == 0:
+        return "ok", None
+    for marker, stage in _MESH_STAGES:
+        if marker not in markers:
+            return "failed", stage
+    return "failed", "sharded-exec"
+
+
+def check_mesh(timeout_s: float = 90.0) -> dict:
+    """Can the param-sharded engine run here?  A staged subprocess builds
+    the 2-D virtual-CPU mesh, resolves the default partition rules, and
+    compiles+executes one donated sharded program — the first missing
+    marker names the failing layer (jax too old for NamedSharding jit,
+    broken virtual-device config, GSPMD lowering failure, ...)."""
+    import os
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    run = _run_staged_probe(_MESH_PROBE, timeout_s, env)
+    status, stage = classify_mesh_probe(run["out"], run["timed_out"],
+                                        run["returncode"])
+    result: dict = {
+        "status": status,
+        "elapsed_s": run["elapsed_s"],
+        "timeout_s": timeout_s,
+    }
+    if status != "ok":
+        result["failed_stage"] = stage
+        result["timed_out"] = run["timed_out"]
+        result["stderr_tail"] = run["err"][-500:]
+    if run["unreapable"]:
         result["unreapable_child"] = True
     return result
 
@@ -615,6 +715,7 @@ def report(timeout_s: float = 45.0, run_dir: str | None = None,
         "device": dev,
         "device_probe": probe,
         "native": check_native_pool(),
+        "mesh": check_mesh(),
         "optional": check_optional_deps(),
         "host": check_host(),
         "obs": check_obs(run_dir),

@@ -58,7 +58,10 @@ def generation_cost(*, population: int, matmul_shapes, param_dim: int,
                     episodes_per_member: int = 1,
                     mirrored: bool = True,
                     low_rank: int = 0,
-                    dtype_bytes: int = 4) -> dict:
+                    dtype_bytes: int = 4,
+                    noise: str = "table",
+                    n_devices: int = 1,
+                    model_shards: int = 1) -> dict:
     """Per-phase FLOPs/bytes for ONE generation of this configuration.
 
     ``horizon`` may be None (host agents own their rollout length); the
@@ -67,10 +70,27 @@ def generation_cost(*, population: int, matmul_shapes, param_dim: int,
     which is also what ``obs profile`` does even when horizon is known,
     so early-terminating envs (done masks) are charged only for the
     steps they actually ran.
+
+    ``noise="program"`` (the param-sharded engine's in-program ε,
+    parallel/sharded.py) changes the BYTE model: no table rows are ever
+    read — ε is generated in-registers — so sample/update traffic is the
+    param-sized center/accumulator only.  RNG hashing FLOPs are not
+    modeled (coarse-model contract; they scale like the scaled-add the
+    model does count).
+
+    ``n_devices``/``model_shards`` record the mesh and add a
+    ``sharding`` block with PER-DEVICE unit costs: an env-step's forward
+    is split over the ``model`` axis, so a per-chip MFU that divides
+    whole-program FLOPs by chip seconds must use
+    ``per_device_flops_per_env_step × total steps``, not pretend each
+    chip ran every step's full forward — the "per-shard attribution"
+    that keeps sharded MFU honest.
     """
     matmul_shapes = [tuple(int(d) for d in s) for s in matmul_shapes]
     population = int(population)
     param_dim = int(param_dim)
+    n_devices = max(int(n_devices), 1)
+    model_shards = max(int(model_shards), 1)
     fwd = matmul_flops(matmul_shapes)
     if low_rank:
         noise_dim = lowrank_noise_dim(matmul_shapes, int(low_rank), param_dim)
@@ -80,22 +100,26 @@ def generation_cost(*, population: int, matmul_shapes, param_dim: int,
     else:
         noise_dim = param_dim
         fwd_step = fwd
-    # distinct table rows read per generation: one per antithetic PAIR
-    # when mirrored (both members share the row), one per member otherwise
+    # distinct noise rows per generation: one per antithetic PAIR when
+    # mirrored (both members share the row), one per member otherwise
     rows = population // 2 if mirrored else population
+    # table rows are HBM traffic; in-program rows are RNG output and
+    # never touch memory (streamed straight into the scaled-add/FMA)
+    row_read_bytes = 0 if noise == "program" else rows * noise_dim * dtype_bytes
     per_gen = {
         # theta = params + sigma·sign·eps: one scaled add over the noise
-        # vector per member; bytes = the table rows + the center read
+        # vector per member; bytes = the noise rows (table mode only)
+        # plus the center read per member
         "sample": {
             "flops": 2 * population * noise_dim,
-            "bytes": (rows * noise_dim + population * param_dim)
-            * dtype_bytes,
+            "bytes": row_read_bytes + population * param_dim * dtype_bytes,
         },
-        # rank-weighted noise sum: one FMA per table element per row;
-        # bytes = re-reading every row plus the param-sized accumulator
+        # rank-weighted noise sum: one FMA per noise element per row;
+        # bytes = re-reading every row (table mode) plus the param-sized
+        # accumulator
         "update": {
             "flops": 2 * rows * noise_dim,
-            "bytes": (rows * noise_dim + param_dim) * dtype_bytes,
+            "bytes": row_read_bytes + param_dim * dtype_bytes,
         },
     }
     out = {
@@ -114,8 +138,20 @@ def generation_cost(*, population: int, matmul_shapes, param_dim: int,
         "low_rank": int(low_rank),
         "episodes_per_member": int(episodes_per_member),
         "dtype_bytes": int(dtype_bytes),
+        "noise": str(noise),
         "matmul_shapes": [list(s) for s in matmul_shapes],
     }
+    if n_devices > 1 or model_shards > 1:
+        out["sharding"] = {
+            "n_devices": n_devices,
+            "model_shards": model_shards,
+            "pop_shards": n_devices // model_shards,
+            # one env-step's forward work per chip (split over model)
+            "per_device_flops_per_env_step": fwd_step / model_shards,
+            # resident center bytes per chip — the replicated-vs-sharded
+            # memory argument in one number (docs/sharding.md)
+            "per_device_param_bytes": param_dim * dtype_bytes / model_shards,
+        }
     if horizon is not None:
         steps = population * int(horizon) * int(episodes_per_member)
         out["env_steps_per_generation"] = steps

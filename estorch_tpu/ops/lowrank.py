@@ -117,6 +117,26 @@ def make_lowrank_spec(params: Any, rank: int) -> LowRankSpec:
     )
 
 
+def lowrank_program_factors(rank: int, m: int, n: int, key: jax.Array):
+    """In-program (A, B) factors for one leaf/row — the sharded path's
+    table-free twin of :meth:`LowRankSpec.unpack` (parallel/sharded.py):
+    instead of unpacking factors from a table slice, they are generated
+    from the (key, generation, row, leaf) chain (ops/noise.py).  Same
+    statistics (entries of A·Bᵀ/√r are zero-mean unit-variance), same
+    savings (the update einsum never materializes dense E)."""
+    return (
+        jax.random.normal(jax.random.fold_in(key, 0), (m, rank), jnp.float32),
+        jax.random.normal(jax.random.fold_in(key, 1), (n, rank), jnp.float32),
+    )
+
+
+def lowrank_program_leaf_noise(rank: int, m: int, n: int, key: jax.Array) -> jax.Array:
+    """Dense E = A·Bᵀ/√r from in-program factors (the eval-side form; the
+    update side keeps the factors and einsums them — no dense E)."""
+    a, b = lowrank_program_factors(rank, m, n, key)
+    return (a @ b.T) / jnp.sqrt(jnp.float32(rank))
+
+
 def dense_kernel(spec_rank: int, a, b):
     """One layer's dense E from its unpacked factors (oracle/snapshot path)."""
     if b is None:

@@ -108,6 +108,47 @@ def member_offsets(pair_offsets: jax.Array) -> jax.Array:
     return jnp.repeat(pair_offsets, 2)
 
 
+# ---------------------------------------------------------------------------
+# in-program noise (the hyperscale sharded path, parallel/sharded.py)
+# ---------------------------------------------------------------------------
+#
+# The table above is the SECOND of three noise representations; at
+# param-sharded scale even the table is a liability (128 MiB replicated
+# HBM, and table offsets address the FLAT param vector — a layout a
+# sharded tree no longer has).  The third representation generates ε
+# inside the jitted program, keyed on (key, generation, row, leaf):
+# threefry is counter-based, so the values are identical on every mesh
+# shape and no ε buffer ever exists host-side or whole on one device —
+# under GSPMD each device computes exactly its shard of each normal()
+# (the same no-materialization idea as the ops/pallas_noise.py streamed
+# kernels, moved from DMA engines into the RNG).  These three helpers
+# define THE keying contract in one place so the eval-side perturbation
+# and the update-side reduction can never diverge.
+
+
+def leaf_noise_keys(gen_key: jax.Array, n_leaves: int) -> list[jax.Array]:
+    """Per-leaf base keys for one generation's in-program noise.
+
+    ``gen_key`` is the per-generation offset stream key (engine
+    ``_gen_keys``); leaf ``i`` of the param tree (tree_flatten order)
+    draws from ``fold_in(gen_key, i)``.  Static count → a Python list,
+    resolved at trace time."""
+    return [jax.random.fold_in(gen_key, i) for i in range(n_leaves)]
+
+
+def row_noise_key(leaf_key: jax.Array, row: jax.Array) -> jax.Array:
+    """Key for noise row ``row`` (pair index when mirrored, member index
+    otherwise) of one leaf — the (key, generation, row, leaf) chain's
+    last link.  ``row`` may be traced (vmapped over chunks)."""
+    return jax.random.fold_in(leaf_key, row)
+
+
+def program_noise(leaf_key: jax.Array, row: jax.Array, shape) -> jax.Array:
+    """One leaf's ε for one noise row, generated in-program: ~N(0,1),
+    deterministic in (leaf_key, row), identical on any mesh."""
+    return jax.random.normal(row_noise_key(leaf_key, row), shape, jnp.float32)
+
+
 @partial(jax.jit, static_argnames=("dim",))
 def member_noise(table: NoiseTable, offsets: jax.Array, signs: jax.Array, dim: int) -> jax.Array:
     """Materialize signed noise rows for a batch of members: (n, dim).

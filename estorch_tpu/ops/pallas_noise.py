@@ -24,6 +24,16 @@ Two kernels share the grid shape:
 Both run in interpret mode on CPU (equivalence-tested against the pure-JAX
 paths in tests/test_pallas_noise.py) and compile to Mosaic on TPU.  The
 ``interpret`` default follows the backend.
+
+Relation to the param-sharded path: these kernels make TABLE noise
+never-materialized by streaming DMA; the sharded engine
+(parallel/sharded.py) takes the same no-materialization goal one step
+further by deleting the table — ε is generated in-program from the
+(key, generation, row, leaf) chain (ops/noise.py program family) under
+partitionable threefry, so each device's RNG emits exactly its shard of
+each noise block straight into the scaled-add/FMA.  Same design
+pressure, moved from the DMA engines into the bit generator; these
+kernels remain the replicated engine's path.
 """
 
 from __future__ import annotations

@@ -1,13 +1,28 @@
 from .engine import EngineConfig, ESEngine, ESState, EvalResult
-from .mesh import POP_AXIS, pairs_per_device, population_mesh, single_device_mesh
+from .mesh import (
+    DEFAULT_PARTITION_RULES,
+    MODEL_AXIS,
+    POP_AXIS,
+    hyperscale_mesh,
+    match_partition_rules,
+    padded_count,
+    pairs_per_device,
+    partition_rules_from_json,
+    partition_rules_to_json,
+    population_mesh,
+    single_device_mesh,
+)
 from .multihost import (
+    global_hyperscale_mesh,
     global_population_mesh,
     initialize as initialize_distributed,
     leader_only,
     process_info,
 )
+from .sharded import ShardedESEngine, ShardedESState
 
 __all__ = [
+    "global_hyperscale_mesh",
     "global_population_mesh",
     "initialize_distributed",
     "leader_only",
@@ -16,8 +31,17 @@ __all__ = [
     "ESEngine",
     "ESState",
     "EvalResult",
+    "ShardedESEngine",
+    "ShardedESState",
+    "DEFAULT_PARTITION_RULES",
+    "MODEL_AXIS",
     "POP_AXIS",
+    "hyperscale_mesh",
+    "match_partition_rules",
+    "padded_count",
     "pairs_per_device",
+    "partition_rules_from_json",
+    "partition_rules_to_json",
     "population_mesh",
     "single_device_mesh",
 ]

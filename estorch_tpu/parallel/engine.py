@@ -43,7 +43,7 @@ from ..ops.gradient import es_gradient, rank_weighted_noise_sum
 from ..ops.noise import NoiseTable, member_offsets, pair_signs, sample_pair_offsets
 from ..ops.params import ParamSpec
 from ..ops.ranks import centered_rank_safe
-from .mesh import POP_AXIS, pairs_per_device
+from .mesh import POP_AXIS, padded_count, pairs_per_device
 
 
 @dataclasses.dataclass(frozen=True)
@@ -419,17 +419,29 @@ class ESEngine:
         self.config = config
         self.mesh = mesh
         self.n_devices = mesh.devices.size
+        # Populations whose pair/member count does not divide the mesh are
+        # PADDED up to the next multiple with zero-weighted ghost members:
+        # ghosts re-evaluate clamped noise rows (values irrelevant), are
+        # sliced out of the gathered fitness before ranking, and their
+        # rank weights are zero-padded before the update slice — so they
+        # cannot move the parameters.  rows_* is the noise-row structure
+        # (pairs when mirrored, members otherwise); only the REAL row
+        # count is ever sampled from the table, so a padded run's noise
+        # stream is identical to the same population on a dividing mesh.
         if config.mirrored:
             self.pairs_local = pairs_per_device(config.population_size, self.n_devices)
             self.members_local = 2 * self.pairs_local
+            self.rows_global = config.population_size // 2
+            self.rows_padded = self.pairs_local * self.n_devices
         else:
-            if config.population_size % self.n_devices != 0:
-                raise ValueError(
-                    f"population ({config.population_size}) must divide evenly "
-                    f"over {self.n_devices} devices"
-                )
             self.pairs_local = None  # unmirrored: no pair structure
-            self.members_local = config.population_size // self.n_devices
+            self.members_local = (
+                padded_count(config.population_size, self.n_devices)
+                // self.n_devices
+            )
+            self.rows_global = config.population_size
+            self.rows_padded = self.members_local * self.n_devices
+        self.members_padded = self.members_local * self.n_devices
         self.eval_chunk = _choose_eval_chunk(config.eval_chunk, self.members_local)
 
         self._obs_norm = config.obs_norm  # always False when env is None
@@ -608,32 +620,53 @@ class ESEngine:
         okey, rkey = _gen_keys(state)
         d = jax.lax.axis_index(POP_AXIS)
         if cfg.mirrored:
-            all_pair_offsets = sample_pair_offsets(
+            all_pair_offsets = self._pad_rows(sample_pair_offsets(
                 okey, cfg.population_size // 2, self.table.size, self.noise_dim
-            )
+            ))
             pair_offs = jax.lax.dynamic_slice(
                 all_pair_offsets, (d * self.pairs_local,), (self.pairs_local,)
             )
             member_offs = member_offsets(pair_offs)
             signs = pair_signs(self.members_local)
-            pair_keys = jax.random.split(rkey, cfg.population_size // 2)
+            pair_keys = self._pad_rows(
+                jax.random.split(rkey, cfg.population_size // 2))
             local_pair_keys = jax.lax.dynamic_slice(
                 pair_keys, (d * self.pairs_local, 0), (self.pairs_local, pair_keys.shape[1])
             )
             member_keys = jnp.repeat(local_pair_keys, 2, axis=0)
             return pair_offs, member_offs, signs, member_keys
-        all_offsets = sample_pair_offsets(
+        all_offsets = self._pad_rows(sample_pair_offsets(
             okey, cfg.population_size, self.table.size, self.noise_dim
-        )
+        ))
         member_offs = jax.lax.dynamic_slice(
             all_offsets, (d * self.members_local,), (self.members_local,)
         )
         signs = jnp.ones((self.members_local,), jnp.float32)
-        keys = jax.random.split(rkey, cfg.population_size)
+        keys = self._pad_rows(jax.random.split(rkey, cfg.population_size))
         member_keys = jax.lax.dynamic_slice(
             keys, (d * self.members_local, 0), (self.members_local, keys.shape[1])
         )
         return member_offs, member_offs, signs, member_keys
+
+    def _pad_rows(self, x: jax.Array) -> jax.Array:
+        """Pad a per-row array (offsets / pair keys) to the padded row
+        count by repeating row 0 — ghost rows carry zero weight in every
+        reduction, so the clamped values are never observable."""
+        pad = self.rows_padded - self.rows_global
+        if pad == 0:
+            return x
+        ghost = jnp.broadcast_to(x[:1], (pad,) + x.shape[1:])
+        return jnp.concatenate([x, ghost], axis=0)
+
+    def _pad_member_weights(self, weights: jax.Array) -> jax.Array:
+        """Zero-pad per-member rank weights from the real population to
+        the padded member count (the update-side half of the ghost-member
+        contract: clamped rows × zero weights contribute nothing)."""
+        pad = self.members_padded - self.config.population_size
+        if pad == 0:
+            return weights
+        return jnp.concatenate(
+            [weights, jnp.zeros((pad,), weights.dtype)])
 
     def _eval_local(self, state: ESState, member_offs, signs, member_keys):
         """Rollout this device's members in eval_chunk-sized compiled chunks."""
@@ -775,10 +808,25 @@ class ESEngine:
         return self._scan_chunks(chunk_body, member_offs, signs, member_keys, n_chunks)
 
     def _gather_global(self, fitness_local, bc_local, steps_local):
-        """Device-major all_gather → identical global arrays on every device."""
+        """Device-major all_gather → identical global arrays on every device.
+
+        Padded runs: the gathered arrays are sliced back to the REAL
+        population (ghost members vanish before ranking/metrics) and
+        ghost steps are masked out of the env-steps count so throughput
+        numbers never include padding work."""
+        cfg = self.config
         fitness = jax.lax.all_gather(fitness_local, POP_AXIS).reshape(-1)
         bc = jax.lax.all_gather(bc_local, POP_AXIS).reshape(-1, self.bc_dim)
-        steps = jax.lax.psum(steps_local.sum(), POP_AXIS)
+        if self.members_padded == cfg.population_size:
+            steps = jax.lax.psum(steps_local.sum(), POP_AXIS)
+        else:
+            d = jax.lax.axis_index(POP_AXIS)
+            idx = d * self.members_local + jnp.arange(self.members_local)
+            alive = idx < cfg.population_size
+            steps = jax.lax.psum(
+                jnp.where(alive, steps_local, 0).sum(), POP_AXIS)
+            fitness = fitness[: cfg.population_size]
+            bc = bc[: cfg.population_size]
         return fitness, bc, steps
 
     def _local_grad(self, state: ESState, weights, reduction_offs):
@@ -789,6 +837,7 @@ class ESEngine:
         """
         cfg = self.config
         d = jax.lax.axis_index(POP_AXIS)
+        weights = self._pad_member_weights(weights)
         w_local = jax.lax.dynamic_slice(
             weights, (d * self.members_local,), (self.members_local,)
         )

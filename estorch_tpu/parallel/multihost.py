@@ -43,7 +43,7 @@ from __future__ import annotations
 
 import jax
 
-from .mesh import population_mesh
+from .mesh import hyperscale_mesh, population_mesh
 
 
 def initialize(
@@ -111,6 +111,22 @@ def global_population_mesh():
     list; the mesh (and hence the psum) spans every chip in the job.
     """
     return population_mesh(jax.devices())
+
+
+def global_hyperscale_mesh(pop_shards: int | None = None,
+                           model_shards: int | None = None):
+    """2-D (pop, model) mesh over ALL devices of ALL processes — the
+    param-sharded engine (parallel/sharded.py) at pod scale.
+
+    Same global-view contract as the 1-D mesh: every process runs the
+    identical jitted program against the global mesh, GSPMD routes the
+    model-axis collectives over ICI within a slice and DCN across.  On a
+    pod, keep ``model`` within a slice (model_shards ≤ chips per slice)
+    so the per-layer collectives never cross DCN; the ``pop`` axis
+    tolerates the slower links (its only traffic is the psum'd update
+    and the fitness gather).
+    """
+    return hyperscale_mesh(pop_shards, model_shards, devices=jax.devices())
 
 
 def process_info() -> dict:

@@ -1,0 +1,631 @@
+"""Param-sharded hyperscale ES engine — no tree ever whole on one device.
+
+The fused engine (parallel/engine.py) replicates the full param tree on
+every device, so the largest trainable policy is capped by one chip's HBM
+(ROADMAP open item 1).  This engine implements the "Evolution Strategies
+at the Hyperscale" recipe (PAPERS.md, arxiv 2511.16652) on a 2-D
+``(pop, model)`` mesh (parallel/mesh.py):
+
+- **Sharded state.**  Params and optimizer state live as TREES whose
+  leaves are sharded over ``model`` per regex partition rules
+  (:func:`~estorch_tpu.parallel.mesh.match_partition_rules`, SNIPPETS.md
+  [1]); optax's param-shaped subtrees resolve through the SAME rules, so
+  adam's moments shard exactly like the weights they smooth.
+- **In-program noise.**  ε is generated inside the jitted program, keyed
+  on ``(key, generation, row, leaf)`` (ops/noise.py ``program_noise``):
+  threefry is counter-based, so every mesh shape computes identical
+  values while each device materializes only its shard of each (chunked)
+  noise block — ε never exists host-side or whole on one device.  With
+  ``config.low_rank`` the 2-D leaves where factoring saves draw
+  ``A·Bᵀ/√r`` factors instead (ops/lowrank.py
+  ``lowrank_program_factors``) and the update einsums the factors — no
+  dense E anywhere.  ``noise_mode="table"`` instead slices the classic
+  HBM table per leaf (same values as the replicated engine — the
+  numerical-parity mode the sharded A/B gates on).
+- **Donated on-chip generations.**  ``generation_step`` is ONE jitted
+  program with ``donate_argnums=(0,)`` and ``out_shardings`` equal to
+  the input state shardings: sample→eval→update runs in place, and the
+  only param-sized traffic per generation is the psum'd update GSPMD
+  inserts for the weighted-noise contraction — never a replicated tree.
+
+Everything global-view (``jit`` + ``NamedSharding`` constraints, not
+``shard_map``): the program is written against full logical shapes and
+GSPMD partitions it, which is what makes the numerics mesh-shape
+invariant (values identical on (1, N), (N, 1), or (a, b) meshes up to
+f32 reduction order — the forward's contractions over model-sharded
+dims and the update psum may reassociate, so cross-path comparisons are
+``allclose`` at f32, not bit-equal; docs/sharding.md).
+
+Scope: feedforward device-native envs, f32, one episode per member.
+obs_norm / decomposed / streamed / noise_kernel / recurrent carries stay
+on the replicated engine (their machinery assumes a replicated flat
+vector); the ctor rejects them loudly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.flatten_util import ravel_pytree
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..envs.rollout import make_rollout
+from ..obs.spans import NULL_TELEMETRY
+from ..ops.gradient import fold_mirrored_weights
+from ..ops.lowrank import lowrank_program_factors, lowrank_program_leaf_noise
+from ..ops.noise import (NoiseTable, leaf_noise_keys, program_noise,
+                         row_noise_key, sample_pair_offsets)
+from ..ops.params import ParamSpec
+from ..ops.ranks import centered_rank_safe
+from .engine import EngineConfig, _choose_eval_chunk, _gen_keys
+from .mesh import (DEFAULT_PARTITION_RULES, MODEL_AXIS, POP_AXIS,
+                   match_partition_rules, padded_count, sharding_summary)
+
+NOISE_MODES = ("program", "table")
+
+
+def _rng_scope(partitionable: bool):
+    """Program-mode dispatch/trace scope: the partitionable threefry
+    implementation, without which GSPMD cannot shard in-program normal()
+    generation — each device would materialize every FULL noise block as
+    a temp, the exact replicate this engine exists to avoid (measured:
+    ~1.9× the replicated path's per-device peak at 900k params; with the
+    flag it drops well under).  Scoped, not global: the flag changes the
+    random stream, and the legacy stream is load-bearing everywhere else
+    (the noise table's values are pinned by goldens; table-mode parity
+    with the replicated engine needs legacy fold_in/split).  The jit
+    trace cache keys on the config, so every dispatch of a program-mode
+    computation must re-enter this scope."""
+    if partitionable:
+        return jax.threefry_partitionable(True)
+    import contextlib
+
+    return contextlib.nullcontext()
+
+
+class ShardedESState(NamedTuple):
+    """Training state whose params/opt_state leaves are device-sharded.
+
+    Unlike :class:`~estorch_tpu.parallel.engine.ESState` the params are a
+    TREE (sharding is per-leaf, per the partition rules), not a flat
+    vector.  ``params_flat`` gathers for host-side consumers (best-member
+    snapshots, bundle export, inspection) — it materializes the full
+    vector on the default device, so it is an inspection API, not a
+    training-path one.
+    """
+
+    params: Any  # pytree, leaves sharded per partition rules
+    opt_state: Any  # optax state, param-shaped subtrees sharded likewise
+    key: jax.Array  # replicated PRNG key (folded with generation)
+    generation: jax.Array  # () int32, replicated
+    sigma: jax.Array  # () float32, replicated
+
+    @property
+    def params_flat(self) -> jax.Array:
+        """Gathered flat center vector (ravel_pytree order — identical to
+        the replicated path's ``ParamSpec`` layout)."""
+        return ravel_pytree(self.params)[0]
+
+
+class ShardedESEngine:
+    """Param-sharded twin of :class:`~estorch_tpu.parallel.engine.ESEngine`.
+
+    Same ``generation_step(state) -> (state, metrics)`` protocol (fitness /
+    steps / grad_norm / n_valid / update_finite), so ``ES.train`` drives it
+    unchanged.
+    """
+
+    telemetry = NULL_TELEMETRY
+
+    def __init__(
+        self,
+        env: Any,
+        policy_apply: Callable[..., Any],
+        spec: ParamSpec,
+        table: NoiseTable | None,
+        optimizer: optax.GradientTransformation,
+        config: EngineConfig,
+        mesh: Mesh,
+        partition_rules=None,
+        noise_mode: str = "program",
+    ):
+        for flag in ("decomposed", "streamed", "noise_kernel", "obs_norm"):
+            if getattr(config, flag):
+                raise ValueError(
+                    f"{flag} is a replicated-engine option; the sharded "
+                    "path's noise/state layout replaces it (docs/sharding.md)"
+                )
+        if config.compute_dtype != "float32":
+            raise ValueError(
+                "the sharded engine runs in float32 (the parity contract "
+                "vs the replicated path is stated at f32)"
+            )
+        if config.episodes_per_member != 1:
+            raise ValueError(
+                "episodes_per_member is a replicated-engine option for now")
+        if env is None:
+            raise ValueError(
+                "the sharded engine fuses eval+update on-chip; it has no "
+                "update-only mode (use ESEngine for the pooled path)")
+        if noise_mode not in NOISE_MODES:
+            raise ValueError(
+                f"noise_mode must be one of {NOISE_MODES}, got {noise_mode!r}")
+        if noise_mode == "table":
+            if table is None:
+                raise ValueError("noise_mode='table' needs a NoiseTable")
+            if config.low_rank:
+                raise ValueError(
+                    "low_rank noise is generated in-program on the sharded "
+                    "path (noise_mode='program'); the table packs full-rank "
+                    "rows only"
+                )
+        missing = {POP_AXIS, MODEL_AXIS} - set(mesh.axis_names)
+        if missing:
+            raise ValueError(
+                f"sharded engine needs a ({POP_AXIS!r}, {MODEL_AXIS!r}) "
+                f"mesh (parallel/mesh.py::hyperscale_mesh); {mesh.axis_names} "
+                f"is missing {sorted(missing)}"
+            )
+
+        self.env = env
+        self.policy_apply = policy_apply
+        self.spec = spec
+        self.table = table
+        self.optimizer = optimizer
+        self.config = config
+        self.mesh = mesh
+        self.noise_mode = noise_mode
+        self.n_devices = int(mesh.devices.size)
+        axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        self.pop_shards = int(axis_sizes[POP_AXIS])
+        self.model_shards = int(axis_sizes[MODEL_AXIS])
+        self.bc_dim = int(env.bc_dim)
+
+        # ---- param-tree layout (tree_flatten order == ravel order) ----
+        params_shape = jax.eval_shape(
+            spec.unravel, jax.ShapeDtypeStruct((spec.dim,), jnp.float32))
+        leaves, self._treedef = jax.tree_util.tree_flatten(params_shape)
+        self.leaf_shapes = [tuple(int(d) for d in l.shape) for l in leaves]
+        import math
+
+        self.leaf_sizes = [math.prod(s) if s else 1 for s in self.leaf_shapes]
+        offs, pos = [], 0
+        for sz in self.leaf_sizes:
+            offs.append(pos)
+            pos += sz
+        self.leaf_flat_offsets = offs  # table-mode: leaf start within a row
+
+        # low_rank: which leaves draw factored noise — the SAME
+        # (m+n)·r < m·n save-or-dense rule as ops/lowrank.py specs
+        self._factored: dict[int, tuple[int, int]] = {}
+        if config.low_rank:
+            r = int(config.low_rank)
+            for i, shape in enumerate(self.leaf_shapes):
+                if len(shape) == 2 and r * (shape[0] + shape[1]) < shape[0] * shape[1]:
+                    self._factored[i] = (shape[0], shape[1])
+
+        # ---- partition rules → shardings (params + optax state) ----
+        self.partition_rules = tuple(
+            partition_rules if partition_rules is not None
+            else DEFAULT_PARTITION_RULES)
+        self.param_shardings = match_partition_rules(
+            self.partition_rules, params_shape, mesh)
+        opt_shape = jax.eval_shape(optimizer.init, params_shape)
+        self.opt_shardings = match_partition_rules(
+            self.partition_rules, opt_shape, mesh)
+        self._repl = NamedSharding(mesh, P())
+        self.state_shardings = ShardedESState(
+            params=self.param_shardings,
+            opt_state=self.opt_shardings,
+            key=self._repl,
+            generation=self._repl,
+            sigma=self._repl,
+        )
+        self._param_sharding_leaves = jax.tree_util.tree_leaves(
+            self.param_shardings,
+            is_leaf=lambda x: isinstance(x, NamedSharding))
+        # member/row-batched noise blocks: pop axis on the batch dim, the
+        # leaf's own spec on the rest
+        self._batched_shardings = [
+            NamedSharding(mesh, P(POP_AXIS, *sh.spec))
+            for sh in self._param_sharding_leaves
+        ]
+
+        # ---- population layout (ghost-padded like the replicated path) --
+        cfg = config
+        if cfg.mirrored:
+            if cfg.population_size % 2 != 0:
+                raise ValueError(
+                    "mirrored sampling needs an even population, got "
+                    f"{cfg.population_size}")
+            self.rows_global = cfg.population_size // 2
+        else:
+            self.rows_global = cfg.population_size
+        self.members_padded = padded_count(cfg.population_size, self.pop_shards)
+        per_shard = self.members_padded // self.pop_shards
+        req = max(1, cfg.eval_chunk // self.pop_shards) if cfg.eval_chunk > 0 else 0
+        chunk_per_shard = _choose_eval_chunk(req, per_shard)
+        self.eval_chunk = chunk_per_shard * self.pop_shards
+        self.n_eval_chunks = self.members_padded // self.eval_chunk
+        # update reduction chunking over noise rows
+        self.rows_padded = padded_count(self.rows_global, self.pop_shards)
+        rows_per_shard = self.rows_padded // self.pop_shards
+        greq = max(1, cfg.grad_chunk // self.pop_shards) if cfg.grad_chunk > 0 else 0
+        gchunk_per_shard = _choose_eval_chunk(greq, rows_per_shard)
+        self.grad_chunk = gchunk_per_shard * self.pop_shards
+        self.n_grad_chunks = self.rows_padded // self.grad_chunk
+
+        self._rollout = make_rollout(env, policy_apply, cfg.horizon)
+
+        # metrics shardings: scalars/vectors replicated, the in-program
+        # best-member tree sharded exactly like the params it perturbs
+        metrics_shardings = {
+            "fitness": self._repl, "bc": self._repl, "steps": self._repl,
+            "grad_norm": self._repl, "n_valid": self._repl,
+            "update_finite": self._repl, "sigma": self._repl,
+            "best_theta": self.param_shardings,
+        }
+        # table mode threads the table as a replicated OPERAND, not a
+        # closure: a closed-over array lowers as an embedded HLO constant
+        # — at table size that bloats the module past the persistent
+        # cache's 2 GB proto ceiling and re-uploads per compile
+        if noise_mode == "table":
+            self._generation_step = jax.jit(
+                self._generation_body,
+                donate_argnums=(0,),
+                in_shardings=(self.state_shardings, self._repl),
+                out_shardings=(self.state_shardings, metrics_shardings),
+            )
+        else:
+            self._generation_step = jax.jit(
+                lambda state: self._generation_body(state, None),
+                donate_argnums=(0,),
+                in_shardings=(self.state_shardings,),
+                out_shardings=(self.state_shardings, metrics_shardings),
+            )
+        self._compiled_facts: dict | None = None
+
+    # ------------------------------------------------------------- noise
+
+    def _row_noise(self, i: int, leaf_key, offsets, rows: jax.Array,
+                   table_data=None) -> jax.Array:
+        """(k, *leaf_shape) noise for leaf ``i`` over row indices ``rows``.
+
+        program mode: generated from the (key, generation, row, leaf)
+        chain; table mode: the leaf's slice of each row's table window
+        (``table_data`` is the traced operand) — value-identical to the
+        replicated engine's ε."""
+        shape = self.leaf_shapes[i]
+        if self.noise_mode == "table":
+            size, loff = self.leaf_sizes[i], self.leaf_flat_offsets[i]
+            data = table_data
+
+            def one(row):
+                start = offsets[row] + loff
+                return jax.lax.dynamic_slice(data, (start,), (size,)).reshape(shape)
+
+            return jax.vmap(one)(rows)
+        if i in self._factored:
+            m, n = self._factored[i]
+            r = int(self.config.low_rank)
+
+            def one(row):
+                return lowrank_program_leaf_noise(
+                    r, m, n, row_noise_key(leaf_key, row))
+
+            return jax.vmap(one)(rows)
+        return jax.vmap(lambda row: program_noise(leaf_key, row, shape))(rows)
+
+    def _leaf_keys(self, okey):
+        if self.noise_mode == "table":
+            return [None] * len(self.leaf_shapes)
+        return leaf_noise_keys(okey, len(self.leaf_shapes))
+
+    def _offsets(self, okey):
+        if self.noise_mode != "table":
+            return None
+        return sample_pair_offsets(
+            okey, self.rows_global, self.table.size, self.spec.dim)
+
+    # ------------------------------------------------------------- eval
+
+    def _member_rows_signs(self, ids: jax.Array):
+        if self.config.mirrored:
+            rows = jnp.minimum(ids // 2, self.rows_global - 1)
+            signs = jnp.where(ids % 2 == 0, 1.0, -1.0).astype(jnp.float32)
+        else:
+            rows = jnp.minimum(ids, self.rows_global - 1)
+            signs = jnp.ones(ids.shape, jnp.float32)
+        return rows, signs
+
+    def _eval_chunk_body(self, state, offsets, leaf_keys, member_keys, ids,
+                         table_data):
+        """Evaluate one chunk of (global) member ids: build the chunk's
+        perturbed trees leaf-by-leaf (each block sharded (pop, *rule)) and
+        vmap the rollout over members."""
+        rows, signs = self._member_rows_signs(ids)
+        keys = jnp.take(member_keys, rows, axis=0)
+        scale = state.sigma * signs  # (chunk,)
+        leaves = jax.tree_util.tree_leaves(state.params)
+        theta_leaves = []
+        for i, leaf in enumerate(leaves):
+            eps = self._row_noise(i, leaf_keys[i], offsets, rows, table_data)
+            eps = jax.lax.with_sharding_constraint(
+                eps, self._batched_shardings[i])
+            b = scale.reshape((ids.shape[0],) + (1,) * leaf.ndim)
+            theta_leaves.append(leaf[None] + b * eps)
+        theta = jax.tree_util.tree_unflatten(self._treedef, theta_leaves)
+        res = jax.vmap(self._rollout, in_axes=(0, 0))(theta, keys)
+        return res.total_reward, res.bc, res.steps
+
+    def _eval_all(self, state, offsets, leaf_keys, rkey, table_data):
+        cfg = self.config
+        # rollout keys: one per PAIR when mirrored (common random numbers
+        # across the ± twins), one per member otherwise — the replicated
+        # engine's exact keying, so table-mode fitness matches it
+        member_keys = jax.random.split(rkey, self.rows_global)
+        ids = jnp.arange(self.members_padded, dtype=jnp.int32)
+        if self.n_eval_chunks == 1:
+            f, bc, st = self._eval_chunk_body(
+                state, offsets, leaf_keys, member_keys, ids, table_data)
+        else:
+            def body(_, ids_c):
+                return 0, self._eval_chunk_body(
+                    state, offsets, leaf_keys, member_keys, ids_c, table_data)
+
+            _, (f, bc, st) = jax.lax.scan(
+                body, 0, ids.reshape(self.n_eval_chunks, self.eval_chunk))
+            f = f.reshape(self.members_padded)
+            bc = bc.reshape(self.members_padded, self.bc_dim)
+            st = st.reshape(self.members_padded)
+        alive = jnp.arange(self.members_padded) < cfg.population_size
+        steps = jnp.where(alive, st, 0).sum()
+        return (f[: cfg.population_size], bc[: cfg.population_size], steps)
+
+    # ------------------------------------------------------------- update
+
+    def _weighted_noise_sum(self, state, offsets, leaf_keys, weights,
+                            table_data):
+        """grad tree = Σ_rows w_row · ε_row / (population · σ), chunked
+        over rows; each leaf's accumulator stays sharded like the leaf —
+        the contraction over the pop-sharded chunk axis is the ONE psum'd
+        param-sized transfer of the generation."""
+        cfg = self.config
+        if cfg.mirrored:
+            row_w = fold_mirrored_weights(weights)  # (rows_global,)
+        else:
+            row_w = weights
+        pad = self.rows_padded - self.rows_global
+        rows = jnp.arange(self.rows_padded, dtype=jnp.int32)
+        rows = jnp.minimum(rows, self.rows_global - 1)
+        if pad:
+            row_w = jnp.concatenate([row_w, jnp.zeros((pad,), row_w.dtype)])
+        leaves = jax.tree_util.tree_leaves(state.params)
+        rank = int(cfg.low_rank) if cfg.low_rank else 0
+
+        def chunk_contrib(i, leaf_key, rows_c, w_c):
+            if rank and i in self._factored:
+                m, n = self._factored[i]
+
+                def factors(row):
+                    return lowrank_program_factors(
+                        rank, m, n, row_noise_key(leaf_key, row))
+
+                a, b = jax.vmap(factors)(rows_c)  # (k, m, r), (k, n, r)
+                return jnp.einsum(
+                    "kmr,knr->mn", a * w_c[:, None, None], b
+                ) / jnp.sqrt(jnp.float32(rank))
+            eps = self._row_noise(i, leaf_key, offsets, rows_c, table_data)
+            eps = jax.lax.with_sharding_constraint(
+                eps, self._batched_shardings[i])
+            return jnp.tensordot(w_c, eps, axes=1)
+
+        if self.n_grad_chunks == 1:
+            acc = [
+                jax.lax.with_sharding_constraint(
+                    chunk_contrib(i, leaf_keys[i], rows, row_w),
+                    self._param_sharding_leaves[i])
+                for i in range(len(leaves))
+            ]
+        else:
+            rows_cs = rows.reshape(self.n_grad_chunks, self.grad_chunk)
+            w_cs = row_w.reshape(self.n_grad_chunks, self.grad_chunk)
+
+            def body(acc, xs):
+                rows_c, w_c = xs
+                new = [
+                    jax.lax.with_sharding_constraint(
+                        acc[i] + chunk_contrib(i, leaf_keys[i], rows_c, w_c),
+                        self._param_sharding_leaves[i])
+                    for i in range(len(acc))
+                ]
+                return new, None
+
+            acc0 = [
+                jax.lax.with_sharding_constraint(
+                    jnp.zeros(self.leaf_shapes[i], jnp.float32),
+                    self._param_sharding_leaves[i])
+                for i in range(len(leaves))
+            ]
+            acc, _ = jax.lax.scan(body, acc0, (rows_cs, w_cs))
+        denom = jnp.float32(cfg.population_size) * state.sigma
+        grad_leaves = [a / denom for a in acc]
+        return jax.tree_util.tree_unflatten(self._treedef, grad_leaves)
+
+    # ------------------------------------------------------------- body
+
+    def _generation_body(self, state: ShardedESState, table_data):
+        cfg = self.config
+        okey, rkey = _gen_keys(state)
+        offsets = self._offsets(okey)
+        leaf_keys = self._leaf_keys(okey)
+        fitness, bc, steps = self._eval_all(
+            state, offsets, leaf_keys, rkey, table_data)
+        weights, n_valid = centered_rank_safe(fitness)
+        grad = self._weighted_noise_sum(
+            state, offsets, leaf_keys, weights, table_data)
+        if cfg.weight_decay > 0.0:
+            grad = jax.tree_util.tree_map(
+                lambda g, p: g - cfg.weight_decay * p, grad, state.params)
+        gnorm = jnp.sqrt(sum(
+            jnp.sum(jnp.square(g)) for g in jax.tree_util.tree_leaves(grad)))
+        neg = jax.tree_util.tree_map(jnp.negative, grad)
+        updates, new_opt_state = self.optimizer.update(
+            neg, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_sigma = state.sigma
+        if cfg.sigma_decay != 1.0:
+            new_sigma = jnp.maximum(
+                state.sigma * cfg.sigma_decay, cfg.sigma_min)
+        params_finite = jnp.array(True)
+        for leaf in jax.tree_util.tree_leaves(new_params):
+            params_finite = jnp.logical_and(
+                params_finite, jnp.isfinite(leaf).all())
+        update_finite = jnp.logical_and(jnp.isfinite(gnorm), params_finite)
+        # In-program anomaly rollback: donation destroys the caller's
+        # pre-step buffers, so the restore the replicated path's ES.train
+        # does host-side ("reject instead of training on poison",
+        # docs/resilience.md) happens HERE — a rejected generation emits
+        # the input state unchanged (same generation → the deterministic
+        # re-run contract holds) and ES.train only counts/announces it.
+        ok = jnp.logical_and(update_finite, n_valid >= 2)
+
+        def keep(new, old):
+            return jax.tree_util.tree_map(
+                lambda n, o: jnp.where(ok, n, o), new, old)
+
+        new_state = ShardedESState(
+            params=keep(new_params, state.params),
+            opt_state=keep(new_opt_state, state.opt_state),
+            key=state.key,
+            generation=jnp.where(ok, state.generation + 1, state.generation),
+            sigma=jnp.where(ok, new_sigma, state.sigma),
+        )
+        # In-program best-member reconstruction: ES.train snapshots the
+        # generation's best θ on improvement; with the pre-step center
+        # donated it cannot be rebuilt host-side afterwards, so the
+        # program emits it — sharded like the params (per-device cost =
+        # one extra param shard; the host gathers only on improvement).
+        safe_fit = jnp.where(jnp.isfinite(fitness), fitness, -jnp.inf)
+        best_rows, best_signs = self._member_rows_signs(
+            jnp.argmax(safe_fit)[None])
+        best_leaves = []
+        for i, leaf in enumerate(jax.tree_util.tree_leaves(state.params)):
+            eps = self._row_noise(
+                i, leaf_keys[i], offsets, best_rows, table_data)[0]
+            best_leaves.append(jax.lax.with_sharding_constraint(
+                leaf + state.sigma * best_signs[0] * eps,
+                self._param_sharding_leaves[i]))
+        metrics = {
+            "fitness": fitness,
+            "bc": bc,
+            "steps": steps,
+            "grad_norm": gnorm,
+            "n_valid": n_valid,
+            "update_finite": update_finite,
+            # pre-step σ for the record: ES.train logs prev_state.sigma on
+            # the replicated path; that buffer is donated here
+            "sigma": state.sigma,
+            "best_theta": jax.tree_util.tree_unflatten(
+                self._treedef, best_leaves),
+        }
+        return new_state, metrics
+
+    # ------------------------------------------------------------- public
+
+    def init_state(self, params_flat: jax.Array, key: jax.Array) -> ShardedESState:
+        import chex
+
+        chex.assert_shape(params_flat, (self.spec.dim,))
+        chex.assert_tree_all_finite(params_flat)
+        params = jax.device_put(
+            self.spec.unravel(jnp.asarray(params_flat)), self.param_shardings)
+        # init the optimizer state ON the mesh: out_shardings places the
+        # param-shaped moments without a replicated round-trip
+        opt_state = jax.jit(
+            self.optimizer.init, out_shardings=self.opt_shardings)(params)
+        return ShardedESState(
+            params=params,
+            opt_state=opt_state,
+            key=jax.device_put(key, self._repl),
+            generation=jax.device_put(jnp.int32(0), self._repl),
+            sigma=jax.device_put(jnp.float32(self.config.sigma), self._repl),
+        )
+
+    def compile(self, state: ShardedESState) -> float:
+        """AOT-compile the donated generation program; returns seconds.
+
+        The compile ledger entry carries XLA's own per-device argument/
+        output/temp byte sizes (``memory_analysis``) — with sharded
+        inputs those ARE shard sizes, which is how the bench A/B and the
+        acceptance test state per-device peak bytes."""
+        import time as _time
+
+        t0 = _time.perf_counter()
+        args = (state, self.table.data) if self.noise_mode == "table" else (state,)
+        with _rng_scope(self.noise_mode == "program"):
+            compiled = self._generation_step.lower(*args).compile()
+        dt = _time.perf_counter() - t0
+        from ..obs.profile.costmodel import compiled_cost_facts
+
+        self._compiled_facts = compiled_cost_facts(compiled)
+        self.telemetry.compile_event("generation_step_sharded", dt,
+                                     compiled=compiled, first_call=True)
+        return dt
+
+    def memory_facts(self) -> dict:
+        """XLA per-device byte facts of the compiled generation program
+        ({} before :meth:`compile` or when the jax version hides them)."""
+        return dict(self._compiled_facts or {})
+
+    def generation_step(self, state: ShardedESState):
+        """Fused sharded ES generation: (new_state, metrics)."""
+        if self.noise_mode == "table":
+            return self._generation_step(state, self.table.data)
+        with _rng_scope(True):
+            return self._generation_step(state)
+
+    def member_params(self, state: ShardedESState, member_index: int) -> jax.Array:
+        """One member's flat θ (ravel order) — host convenience for
+        best-member snapshots (reference's ``best_policy``).
+
+        Computed EAGERLY on the default device from the gathered center:
+        the same ``(key, generation, row, leaf)`` noise functions as the
+        in-program paths (so the reconstruction is exact), but outside
+        the mesh program — a one-member gather is inspection traffic, and
+        keeping it off the mesh sidesteps GSPMD resharding of a
+        scalar-indexed program for no training-path benefit."""
+        with _rng_scope(self.noise_mode == "program"):
+            return self._member_params_eager(state, member_index)
+
+    def _member_params_eager(self, state, member_index):
+        okey, _ = _gen_keys(state)
+        offsets = self._offsets(okey)
+        leaf_keys = self._leaf_keys(okey)
+        idx = int(member_index)
+        if self.config.mirrored:
+            row, sign = idx // 2, (1.0 if idx % 2 == 0 else -1.0)
+        else:
+            row, sign = idx, 1.0
+        row = jnp.int32(row)
+        table_data = self.table.data if self.noise_mode == "table" else None
+        flats = []
+        for i, leaf in enumerate(jax.tree_util.tree_leaves(state.params)):
+            eps = self._row_noise(
+                i, leaf_keys[i], offsets, row[None], table_data)[0]
+            flats.append(
+                (jax.device_get(leaf) + jax.device_get(
+                    state.sigma * sign * eps)).reshape(-1))
+        import numpy as np
+
+        return jnp.asarray(np.concatenate(flats))
+
+    def sharding_report(self) -> dict[str, str]:
+        """{leaf path: resolved spec} — what the rules did, incl. any
+        divisibility fallbacks (manifests, tests, docs examples)."""
+        params_shape = jax.eval_shape(
+            self.spec.unravel, jax.ShapeDtypeStruct((self.spec.dim,), jnp.float32))
+        return sharding_summary(params_shape, self.param_shardings)

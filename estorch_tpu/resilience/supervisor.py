@@ -49,9 +49,24 @@ from . import chaos as _chaos
 
 def _snapshot(es) -> dict:
     """Everything ``es.train(1)`` may mutate, cheap to capture (states are
-    immutable NamedTuples; lists are shallow-copied)."""
+    immutable NamedTuples; lists are shallow-copied).
+
+    Param-sharded exception: the sharded engine DONATES its state, so a
+    by-reference snapshot would hold buffers the very next generation
+    deletes — the restore would hand back corpses ("buffer has been
+    deleted or donated") instead of resuming.  Those states are deep-
+    copied device-side (`.copy()` preserves each leaf's sharding); one
+    extra state copy per generation is the price of rollback on the
+    donated path, paid only under run_resilient.
+    """
+    state = es.state
+    if getattr(es, "_shard_params", False):
+        import jax
+
+        state = jax.tree_util.tree_map(
+            lambda x: x.copy() if hasattr(x, "copy") else x, state)
     snap = {
-        "state": es.state,
+        "state": state,
         "generation": es.generation,
         "history_len": len(es.history),
         "best_reward": es.best_reward,

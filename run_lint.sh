@@ -42,6 +42,15 @@ echo "== chaos selfcheck =="
 # no device touch.
 python bench.py --chaos --selfcheck
 
+echo "== shard-ab selfcheck =="
+# param-sharded gate (estorch_tpu/parallel/sharded.py, docs/sharding.md):
+# a same-seed sharded run must match the replicated fused path allclose
+# at f32, the program-noise sharded program must fit in LESS per-device
+# memory than the replicated one (compile-ledger memory_analysis), and
+# the sharded row must report a non-null MFU from the shard-aware cost
+# model.  Virtual CPU mesh in a child process, tiny config.
+python bench.py --shard-ab --selfcheck
+
 echo "== loadgen smoke =="
 # the load generator validated against an in-process stdlib echo server
 # (closed+open loop, latency percentiles, response indexing).  Run as a

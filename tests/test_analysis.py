@@ -862,6 +862,153 @@ class TestR09:
         """, "R09")
 
 
+
+# ---------------------------------------------------------------------
+# R10 unsharded-capture
+# ---------------------------------------------------------------------
+
+class TestR10:
+    def test_np_random_closure_flagged(self):
+        found = findings("""
+            import numpy as np
+            import jax
+
+            TABLE = np.random.randn(1 << 20)
+
+            def body(x):
+                return x + TABLE[:3].sum()
+
+            step = jax.jit(body, in_shardings=(None,), out_shardings=None)
+        """, "R10")
+        assert len(found) == 1
+        assert "TABLE" in found[0].message
+        assert "replicated" in found[0].message
+
+    def test_large_constant_closure_flagged(self):
+        found = findings("""
+            import numpy as np
+            import jax
+
+            MASK = np.zeros((4096, 4096))
+
+            def body(x):
+                return x * MASK
+
+            step = jax.jit(body, out_shardings=None)
+        """, "R10")
+        assert len(found) == 1
+        assert "16,777,216 elements" in found[0].message
+
+    def test_method_and_lambda_forms_flagged(self):
+        """The engine idiom: jit(self._body, in_shardings=...) and the
+        lambda wrapper both count as sharded programs."""
+        found = findings("""
+            import numpy as np
+            import jax
+
+            SEEDS = np.random.randint(0, 100, (8,))
+
+            class Engine:
+                def _body(self, state):
+                    return state + SEEDS[0]
+
+                def __init__(self, sh):
+                    self.step = jax.jit(self._body, in_shardings=(sh,))
+                    self.step2 = jax.jit(lambda s: s * SEEDS[1],
+                                         out_shardings=sh)
+        """, "R10")
+        assert len(found) == 2
+
+    def test_partial_decorator_form_flagged(self):
+        found = findings("""
+            from functools import partial
+
+            import numpy as np
+            import jax
+
+            BIG = np.arange(1 << 20)
+
+            @partial(jax.jit, in_shardings=(None,))
+            def body(x):
+                return x + BIG[0]
+        """, "R10")
+        assert len(found) == 1
+
+    def test_operand_passing_clean(self):
+        """The fix shape: the host array reaches the program as an
+        argument, placed by in_shardings — no capture."""
+        assert not findings("""
+            import numpy as np
+            import jax
+
+            TABLE = np.random.randn(1 << 20)
+
+            def body(x, table):
+                return x + table[:3].sum()
+
+            step = jax.jit(body, in_shardings=(None, None))
+            out = step(1.0, TABLE)
+        """, "R10")
+
+    def test_small_constant_clean(self):
+        assert not findings("""
+            import numpy as np
+            import jax
+
+            SMALL = np.zeros((4,))
+
+            def body(x):
+                return x + SMALL[0]
+
+            step = jax.jit(body, in_shardings=(None,))
+        """, "R10")
+
+    def test_unsharded_jit_clean(self):
+        """Plain jit (no sharding kwargs) is R03/R04 territory, not R10:
+        a replicated program replicates by definition."""
+        assert not findings("""
+            import numpy as np
+            import jax
+
+            TABLE = np.random.randn(1 << 20)
+
+            def body(x):
+                return x + TABLE[0]
+
+            step = jax.jit(body)
+        """, "R10")
+
+    def test_unrelated_local_name_collision_clean(self):
+        """A helper's own local `table = np.random...` must not poison a
+        legitimately-passed operand PARAMETER of the same bare name in
+        another function — host bindings are module-level only."""
+        assert not findings("""
+            import numpy as np
+            import jax
+
+            def setup():
+                table = np.random.randn(1 << 20)
+                return table
+
+            def make(sh, table):
+                return jax.jit(lambda s: s + table, in_shardings=(sh,))
+        """, "R10")
+
+    def test_local_rebinding_clean(self):
+        """A name the body binds itself is not a capture."""
+        assert not findings("""
+            import numpy as np
+            import jax
+
+            TABLE = np.random.randn(1 << 20)
+
+            def body(x):
+                TABLE = x * 2
+                return TABLE
+
+            step = jax.jit(body, in_shardings=(None,))
+        """, "R10")
+
 # ---------------------------------------------------------------------
 # engine / CLI / config / baseline mechanics
 # ---------------------------------------------------------------------
@@ -887,7 +1034,7 @@ class TestEngine:
     def test_every_rule_registered(self):
         ids = [r.id for r in all_rules()]
         assert ids == ["R01", "R02", "R03", "R04", "R05", "R06", "R07",
-                       "R08", "R09"]
+                       "R08", "R09", "R10"]
 
     def test_syntax_error_becomes_finding(self):
         found = analyze_source("bad.py", "def broken(:\n")
@@ -1020,7 +1167,8 @@ class TestConfig:
         cfg = load_config(os.path.join(root, "pyproject.toml"))
         assert cfg.baseline == "esguard_baseline.json"
         assert cfg.rule_ids([r.id for r in all_rules()]) == [
-            "R01", "R02", "R03", "R04", "R05", "R06", "R07", "R08", "R09"]
+            "R01", "R02", "R03", "R04", "R05", "R06", "R07", "R08", "R09",
+            "R10"]
 
 
 class TestCLI:

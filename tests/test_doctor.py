@@ -143,6 +143,55 @@ class TestCheckDevice:
         assert rep["device"]["timeout_s"] == 5
 
 
+class TestMeshCheck:
+    """The param-sharded mesh probe (check_mesh): can the 2-D virtual
+    CPU mesh build, the default partition rules resolve, and one donated
+    sharded program compile+execute here?  (docs/sharding.md)"""
+
+    def test_classifier_taxonomy(self):
+        c = doctor.classify_mesh_probe
+        ok = ("MESH_START\nMESH_BUILD_OK 8\nMESH_RULES_OK\n"
+              "MESH_COMPILE_OK\nMESH_EXEC_OK\n")
+        assert c(ok, False, 0) == ("ok", None)
+        assert c("MESH_START\n", True, None) == ("failed", "mesh-build")
+        assert c("MESH_START\nMESH_BUILD_OK 8\n", False, 1) == \
+            ("failed", "partition-rules")
+        assert c("MESH_START\nMESH_BUILD_OK 8\nMESH_RULES_OK\n",
+                 True, None) == ("failed", "sharded-compile")
+        assert c("MESH_START\nMESH_BUILD_OK 8\nMESH_RULES_OK\n"
+                 "MESH_COMPILE_OK\n", False, 1) == \
+            ("failed", "sharded-exec")
+
+    def test_healthy_mesh_probe(self):
+        out = doctor.check_mesh(timeout_s=120.0)
+        assert out["status"] == "ok", out
+        assert "failed_stage" not in out
+
+    def test_failing_stage_named(self, monkeypatch):
+        monkeypatch.setattr(doctor, "_MESH_PROBE", (
+            'print("MESH_START", flush=True)\n'
+            'print("MESH_BUILD_OK 8", flush=True)\n'
+            'raise RuntimeError("no rules for you")\n'))
+        out = doctor.check_mesh(timeout_s=30.0)
+        assert out["status"] == "failed"
+        assert out["failed_stage"] == "partition-rules"
+        assert "no rules for you" in out["stderr_tail"]
+
+    def test_report_gains_mesh_row(self, monkeypatch):
+        """report() carries the mesh verdict without re-running the
+        heavy probe here (stubbed like the device row's test)."""
+        monkeypatch.setattr(doctor, "check_mesh",
+                            lambda **kw: {"status": "ok", "elapsed_s": 0.1,
+                                          "timeout_s": 90.0})
+        monkeypatch.setattr(doctor, "check_device",
+                            lambda timeout_s=20.0, platform=None: {
+                                "status": "ok", "platform": "cpu",
+                                "n_devices": 8, "elapsed_s": 0.1,
+                                "timeout_s": timeout_s})
+        rep = doctor.report(timeout_s=5.0)
+        assert rep["mesh"]["status"] == "ok"
+
+
 class TestOptionalDeps:
     def test_missing_parent_package_never_crashes(self, monkeypatch):
         """find_spec('pkg.sub') raises ModuleNotFoundError when pkg itself

@@ -127,9 +127,32 @@ class TestMeshValidation:
         with pytest.raises(ValueError, match="even"):
             pairs_per_device(65, 8)
 
-    def test_indivisible_pairs_rejected(self):
-        with pytest.raises(ValueError, match="multiple"):
-            pairs_per_device(34, 8)  # 17 pairs over 8 devices
+    def test_indivisible_pairs_padded(self, setup, devices8):
+        """Regression for the old hard-error case: 17 pairs over 8 devices
+        used to raise "use a population that is a multiple of 2·n_devices";
+        now the population is ghost-padded (zero-weighted, clamped rows)
+        and trains IDENTICALLY to the same population on one device —
+        padding must be unobservable in fitness, steps, and the update."""
+        assert pairs_per_device(34, 8) == 3  # ceil(17/8): padded pairs
+        cfg = EngineConfig(population_size=34, sigma=0.1, horizon=30)
+        e8 = ESEngine(setup["env"], setup["apply"], setup["spec"],
+                      setup["table"], setup["opt"], cfg, population_mesh())
+        e1 = ESEngine(setup["env"], setup["apply"], setup["spec"],
+                      setup["table"], setup["opt"], cfg, single_device_mesh())
+        s8 = e8.init_state(setup["flat"], jax.random.PRNGKey(7))
+        s1 = e1.init_state(setup["flat"], jax.random.PRNGKey(7))
+        for gen in range(2):
+            s8, m8 = e8.generation_step(s8)
+            s1, m1 = e1.generation_step(s1)
+            assert m8["fitness"].shape == (34,)
+            np.testing.assert_array_equal(
+                np.asarray(m8["fitness"]), np.asarray(m1["fitness"]),
+                err_msg=f"padded fitness diverged at gen {gen}")
+            assert int(m8["steps"]) == int(m1["steps"])
+            np.testing.assert_allclose(
+                np.asarray(s8.params_flat), np.asarray(s1.params_flat),
+                rtol=2e-5, atol=1e-6,
+                err_msg=f"padded update diverged at gen {gen}")
 
     def test_member_reconstruction_matches_eval_perturbation(self, setup):
         """member_params(i) must be exactly the θ the engine evaluated for i."""
