@@ -58,6 +58,21 @@ measurement cannot take down the bench — round-1 lesson):
                                         the run_lint.sh gate: nonzero exit
                                         when recovery did not actually
                                         recover
+    bench.py --async-ab [--selfcheck]   barrier-vs-async scheduler A/B
+                                        (estorch_tpu/algo/scheduler.py,
+                                        docs/async.md): the same tiny
+                                        host run under an identical
+                                        deterministic straggler plan,
+                                        once through ES.train's barrier
+                                        loop and once through the event-
+                                        driven fold scheduler — medians
+                                        + a noise band learned from
+                                        interleaved repeats (obs
+                                        regress), gating the >=1.25x
+                                        throughput win, step ≈
+                                        max(eval, update) from the
+                                        per-phase spans, and the zero-
+                                        silent-drop fold accounting
     bench.py --regress [BASELINE.json]  perf gate (estorch_tpu/obs/export/
                                         regress.py): measure the headline
                                         config `--repeats` times (fresh
@@ -685,17 +700,15 @@ def stage_obs_ab(force_cpu=False, gens=3, repeats=3):
         }), flush=True)
 
 
-def measure_chaos_one(cfg):
-    """Child body for --stage-chaos-one: a tiny host-backend ES with fork
-    workers, optionally under a kill-one-worker-every-K-generations chaos
-    plan, measured in generations/sec.  Host path only: construction
-    imports jax but never touches the device runtime, so this stays safe
-    on a wedged-tunnel machine (run_lint exports JAX_PLATFORMS=cpu on
-    top)."""
+def _tiny_host_es(cfg, worker_mode="process"):
+    """Shared tiny host-backend ES for the chaos / async-ab stages: a
+    4→8→2 torch MLP and a quadratic-fitness agent whose rollout runs
+    ``work_s`` of sleep (GIL-released, like a real env stepping in C) —
+    enough per-member cost that generations have a cadence for
+    stragglers to perturb."""
     import torch
 
     from estorch_tpu import ES
-    from estorch_tpu.resilience.chaos import CHAOS_ENV, ChaosPlan
 
     class TinyPolicy(torch.nn.Module):
         def __init__(self):
@@ -707,29 +720,77 @@ def measure_chaos_one(cfg):
         def forward(self, x):
             return self.net(x)
 
+    work_s = float(cfg.get("work_s", 0.0))
+
     class QuadAgent:
         def rollout(self, policy):
             with torch.no_grad():
                 v = torch.nn.utils.parameters_to_vector(policy.parameters())
                 r = -float((v**2).sum())
+            if work_s:
+                time.sleep(work_s)
             self.last_episode_steps = 1
             return r
+
+    return ES(TinyPolicy, QuadAgent, torch.optim.Adam,
+              population_size=int(cfg.get("population", 16)), sigma=0.05,
+              seed=0, optimizer_kwargs={"lr": 0.01}, table_size=1 << 12,
+              worker_mode=worker_mode)
+
+
+def _async_accounting(es):
+    """The zero-silent-drop invariant, read once from the event log +
+    counters (docs/async.md): every dispatched member is consumed (fold
+    or fresh), discarded with evidence, or lost to a counted worker
+    death.  Both async gates (--chaos mixed leg, --async-ab) report
+    THIS block, so they can never check different invariants."""
+    log = es.async_event_log
+    counters = es.obs.counters.snapshot()
+    consumed = sum(len(u["consumed"]) for u in log.updates)
+    dispatched = len(log.dispatches) * es.population_size
+    return {
+        "results_folded": int(counters.get("results_folded", 0)),
+        "stale_discarded": int(counters.get("stale_discarded", 0)),
+        "results_lost": int(counters.get("results_lost", 0)),
+        "consumed": consumed,
+        "dispatched": dispatched,
+        "accounting_ok": (dispatched == consumed + len(log.discarded)
+                          + len(log.lost)),
+    }
+
+
+def measure_chaos_one(cfg):
+    """Child body for --stage-chaos-one: a tiny host-backend ES with fork
+    workers, optionally under a chaos plan (worker kills, and — the
+    mixed-fault async leg — straggler stalls with jitter), measured in
+    generations/sec.  ``cfg["async"]`` routes through the event-driven
+    scheduler (estorch_tpu/algo/scheduler.py) instead of the barrier
+    loop.  Host path only: construction imports jax but never touches
+    the device runtime, so this stays safe on a wedged-tunnel machine
+    (run_lint exports JAX_PLATFORMS=cpu on top)."""
+    from estorch_tpu.resilience.chaos import CHAOS_ENV, ChaosPlan
 
     gens = int(cfg.get("gens", 60))
     n_proc = int(cfg.get("n_proc", 2))
     if cfg.get("chaos"):
         plan = ChaosPlan.generate(
-            seed=0, n_generations=gens, kill_every=int(cfg["kill_every"]),
+            seed=0, n_generations=gens,
+            kill_every=int(cfg.get("kill_every", 0)),
             n_workers=n_proc,
+            straggler_every=int(cfg.get("straggler_every", 0)),
+            straggler_sleep_s=float(cfg.get("sleep_s", 1.0)),
+            straggler_jitter_s=float(cfg.get("jitter_s", 0.0)),
+            population_size=int(cfg.get("population", 16)),
         )
         os.environ[CHAOS_ENV] = plan.to_json()
-    es = ES(TinyPolicy, QuadAgent, torch.optim.Adam,
-            population_size=int(cfg.get("population", 16)), sigma=0.05,
-            seed=0, optimizer_kwargs={"lr": 0.01}, table_size=1 << 12,
-            worker_mode="process")
+    es = _tiny_host_es(cfg, worker_mode="process")
     es.train(1, n_proc=n_proc, verbose=False)  # warm-up: fork the pool
     t0 = time.perf_counter()
-    es.train(gens, n_proc=n_proc, verbose=False)
+    if cfg.get("async"):
+        es.train_async(gens, n_proc=n_proc, verbose=False,
+                       max_stale=int(cfg.get("max_stale", 4096)))
+    else:
+        es.train(gens, n_proc=n_proc, verbose=False)
     dt = time.perf_counter() - t0
     counters = es.obs.counters.snapshot()
     out = {
@@ -742,6 +803,8 @@ def measure_chaos_one(cfg):
         "generations_rejected": int(counters.get("generations_rejected", 0)),
         "cfg": cfg,
     }
+    if cfg.get("async"):
+        out.update(_async_accounting(es))
     es.engine.close()
     return out
 
@@ -750,14 +813,22 @@ def stage_chaos(selfcheck=False):
     """Recovery-overhead A/B (chaos vs clean) via the stage protocol; the
     selfcheck form is the run_lint.sh gate.  Returns the process exit
     code: 0 when recovery actually recovered (full participation under
-    worker kills), 1 otherwise."""
+    worker kills, and the async scheduler survived the MIXED
+    straggler+kill plan with its accounting intact), 1 otherwise."""
     gens = 24 if selfcheck else 60
     kill_every = 8 if selfcheck else 20
     base = {"gens": gens, "kill_every": kill_every, "population": 16,
             "n_proc": 2}
+    # the mixed-fault async leg: the SAME kills plus a straggler stall
+    # (with jitter) every kill_every//2 generations, driven through the
+    # event-driven scheduler — both fault classes against the async path
+    mixed = {**base, "chaos": True, "async": True,
+             "straggler_every": max(kill_every // 2, 2),
+             "sleep_s": 0.3, "jitter_s": 0.2, "work_s": 0.002}
     rows = {}
-    for label, chaos in (("clean", False), ("chaos", True)):
-        cfg = {**base, "chaos": chaos}
+    for label, cfg in (("clean", {**base, "chaos": False}),
+                       ("chaos", {**base, "chaos": True}),
+                       ("mixed_async", mixed)):
         argv = [sys.executable, __file__, "--stage-chaos-one",
                 json.dumps(cfg)]
         # a pre-existing ESTORCH_CHAOS in the caller's environment
@@ -785,9 +856,10 @@ def stage_chaos(selfcheck=False):
         print(json.dumps({"label": f"chaos/{label}", **rows[label]}),
               flush=True)
     clean, chaos = rows.get("clean"), rows.get("chaos")
-    if not clean or not chaos:
+    mixed_row = rows.get("mixed_async")
+    if not clean or not chaos or not mixed_row:
         print(json.dumps({"label": "chaos/recovery", "error":
-                          "one or both stages failed"}), flush=True)
+                          "one or more stages failed"}), flush=True)
         return 1
     overhead = (clean["gps"] - chaos["gps"]) / clean["gps"] * 100.0
     expected_kills = gens // kill_every
@@ -801,6 +873,17 @@ def stage_chaos(selfcheck=False):
         and chaos["workers_respawned"] >= expected_kills - 1
         and chaos["n_failed_total"] == 0
     )
+    # the async leg's contract is different by design: a killed worker's
+    # in-flight slice is LOST (counted), not retried — recovery means
+    # the scheduler finished every update anyway, respawned the killed
+    # workers, and accounted every dispatched member (consumed /
+    # discarded / lost), with zero silent drops
+    mixed_ok = (
+        mixed_row["generations"] == gens + 1
+        and mixed_row["chaos_worker_kills"] >= expected_kills
+        and mixed_row["workers_respawned"] >= expected_kills - 1
+        and bool(mixed_row.get("accounting_ok"))
+    )
     print(json.dumps({
         "label": "chaos/recovery",
         "clean_gps": clean["gps"],
@@ -811,9 +894,178 @@ def stage_chaos(selfcheck=False):
         "members_retried": chaos["members_retried"],
         "n_failed_total": chaos["n_failed_total"],
         "full_participation": chaos["n_failed_total"] == 0,
-        "pass": recovered,
+        "mixed_async": {
+            "gps": mixed_row["gps"],
+            "worker_kills": mixed_row["chaos_worker_kills"],
+            "workers_respawned": mixed_row["workers_respawned"],
+            "results_folded": mixed_row.get("results_folded"),
+            "stale_discarded": mixed_row.get("stale_discarded"),
+            "results_lost": mixed_row.get("results_lost"),
+            "accounting_ok": mixed_row.get("accounting_ok"),
+            "pass": mixed_ok,
+        },
+        "pass": recovered and mixed_ok,
     }), flush=True)
-    return 0 if recovered else 1
+    return 0 if (recovered and mixed_ok) else 1
+
+
+def measure_async_one(cfg):
+    """Child body for --stage-async-one: ONE leg of the sync-vs-async
+    A/B — a tiny host thread-worker ES under a deterministic straggler
+    plan (sleep + jitter every K generations), driven either by the
+    barrier loop (``ES.train``) or the event-driven scheduler
+    (``ES.train_async``, estorch_tpu/algo/scheduler.py).  Both legs see
+    the IDENTICAL plan (jitter is seeded by event id), so the only
+    variable is the scheduling.  Prints one JSON row with the rate and
+    — async leg — the fold/discard/lost accounting and the per-phase
+    step-vs-max evidence the driver gates on."""
+    from estorch_tpu.resilience.chaos import CHAOS_ENV, ChaosPlan
+
+    gens = int(cfg.get("gens", 20))
+    n_proc = int(cfg.get("n_proc", 2))
+    plan = ChaosPlan.generate(
+        seed=0, n_generations=gens,
+        straggler_every=int(cfg.get("straggler_every", 2)),
+        straggler_sleep_s=float(cfg.get("sleep_s", 0.3)),
+        straggler_jitter_s=float(cfg.get("jitter_s", 0.2)),
+        population_size=int(cfg.get("population", 16)),
+    )
+    os.environ[CHAOS_ENV] = plan.to_json()
+    es = _tiny_host_es(cfg, worker_mode="thread")
+    t0 = time.perf_counter()
+    if cfg.get("async"):
+        es.train_async(gens, n_proc=n_proc, verbose=False,
+                       max_stale=int(cfg.get("max_stale", 4096)))
+    else:
+        es.train(gens, n_proc=n_proc, verbose=False)
+    dt = time.perf_counter() - t0
+    # per-update step-vs-max evidence from the recorded phase spans:
+    # wall ≈ max(eval, update) is the async promise (the sync barrier
+    # loop's wall is their SUM plus the straggler stall)
+    walls, maxes = [], []
+    for r in es.history:
+        ph = r.get("phases") or {}
+        ev, up = float(ph.get("eval", 0.0)), float(ph.get("update", 0.0))
+        if ev or up:
+            walls.append(float(r["wall_time_s"]))
+            maxes.append(max(ev, up))
+    import statistics
+
+    step_max_ratio = (
+        round(statistics.median(walls) / statistics.median(maxes), 3)
+        if maxes and statistics.median(maxes) > 0 else None)
+    counters = es.obs.counters.snapshot()
+    out = {
+        "mode": "async" if cfg.get("async") else "sync",
+        "gps": round(gens / dt, 3),
+        "wall_s": round(dt, 3),
+        "generations": len(es.history),
+        "step_max_ratio": step_max_ratio,
+        "n_failed_total": int(sum(r["n_failed"] for r in es.history)),
+        "cfg": cfg,
+    }
+    if cfg.get("async"):
+        out.update(
+            **_async_accounting(es),
+            overlap_efficiency=counters.get("overlap_efficiency"),
+            stale_reuse_ratio=counters.get("stale_reuse_ratio"),
+        )
+    es.engine.close()
+    return out
+
+
+def stage_async_ab(selfcheck=False):
+    """Sync-barrier vs async-scheduler A/B under an injected straggler
+    plan (ISSUE 9 acceptance; the selfcheck form is the run_lint.sh
+    gate).  Interleaved repeats per arm (the --obs-ab loaded-host
+    discipline), medians + a noise band learned from the repeats via
+    ``obs regress``.  Exit 0 only when (1) async generation throughput
+    beats sync by >= 1.25x beyond the learned band, (2) the async leg's
+    step time ≈ max(eval, update) per the recorded spans, and (3) the
+    zero-silent-drop accounting holds — every late result folded with a
+    recorded weight or counted discarded/lost."""
+    regress = _load_obs_regress()
+    base = ({"gens": 14, "population": 16, "n_proc": 2,
+             "straggler_every": 2, "sleep_s": 0.25, "jitter_s": 0.15,
+             "work_s": 0.002, "max_stale": 4096}
+            if selfcheck else
+            {"gens": 30, "population": 16, "n_proc": 2,
+             "straggler_every": 2, "sleep_s": 0.4, "jitter_s": 0.25,
+             "work_s": 0.004, "max_stale": 4096})
+    repeats = 2 if selfcheck else 3
+    rates = {"sync": [], "async": []}
+    async_rows = []
+    for rep in range(repeats):
+        for mode in ("sync", "async"):
+            cfg = {**base, "async": mode == "async"}
+            argv = [sys.executable, __file__, "--stage-async-one",
+                    json.dumps(cfg)]
+            child_env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+            child_env.pop("ESTORCH_CHAOS", None)  # the stage owns its plan
+            try:
+                r = subprocess.run(argv, timeout=600, capture_output=True,
+                                   text=True, env=child_env)
+                last = [ln for ln in r.stdout.strip().splitlines()
+                        if ln.startswith("{")][-1]
+                row = json.loads(last)
+            except subprocess.TimeoutExpired:
+                print(json.dumps({"label": f"async/{mode}", "rep": rep,
+                                  "error": "timeout after 600s"}),
+                      flush=True)
+                continue
+            except (IndexError, ValueError):
+                print(json.dumps({"label": f"async/{mode}", "rep": rep,
+                                  "error": f"stage exited {r.returncode}",
+                                  "stderr_tail": r.stderr[-800:]}),
+                      flush=True)
+                continue
+            rates[mode].append(row["gps"])
+            if mode == "async":
+                async_rows.append(row)
+            print(json.dumps({"label": f"async/{mode}", "rep": rep,
+                              **row}), flush=True)
+    if not rates["sync"] or not rates["async"]:
+        print(json.dumps({"label": "async/ab",
+                          "error": "one or both arms have no samples"}),
+              flush=True)
+        return 1
+    # medians + learned noise band: async as "current" vs sync as the
+    # baseline — an honest win must clear the band AND the 1.25x floor
+    verdict = regress.compare(rates["async"], rates["sync"],
+                              metric="generations_per_sec")
+    ratio = (verdict["current_median"] / verdict["baseline_median"]
+             if verdict["baseline_median"] else None)
+    folded = sum(r.get("results_folded", 0) for r in async_rows)
+    accounting_ok = all(r.get("accounting_ok") for r in async_rows)
+    step_ratios = [r["step_max_ratio"] for r in async_rows
+                   if r.get("step_max_ratio") is not None]
+    import statistics
+
+    step_max = (round(statistics.median(step_ratios), 3)
+                if step_ratios else None)
+    ok = (
+        ratio is not None and ratio >= 1.25
+        and bool(verdict.get("improved"))
+        and accounting_ok
+        and folded > 0  # the straggler plan MUST have exercised the fold
+        and step_max is not None and step_max <= 1.35
+    )
+    print(json.dumps({
+        "label": "async/ab",
+        "sync_median_gps": verdict["baseline_median"],
+        "async_median_gps": verdict["current_median"],
+        "ratio": round(ratio, 3) if ratio else None,
+        "band_pct": verdict["band_pct"],
+        "improved_beyond_band": bool(verdict.get("improved")),
+        "results_folded": folded,
+        "stale_discarded": sum(r.get("stale_discarded", 0)
+                               for r in async_rows),
+        "results_lost": sum(r.get("results_lost", 0) for r in async_rows),
+        "accounting_ok": accounting_ok,
+        "async_step_vs_max_phase": step_max,
+        "pass": ok,
+    }), flush=True)
+    return 0 if ok else 1
 
 
 def measure_shard_ab(cfg):
@@ -1396,12 +1648,19 @@ no arguments        full headline benchmark (device probe decides the
   --stage-ab        standard-vs-decomposed forward A/B
   --obs-ab          telemetry-overhead A/B
   --chaos [--selfcheck]   recovery-overhead A/B under injected faults
+                    (clean vs kills vs a mixed straggler+kill plan on
+                     the async scheduler)
+  --async-ab [--selfcheck]  sync barrier loop vs event-driven async
+                    scheduler under an injected straggler plan
+                    (medians + learned noise band via obs regress;
+                     gates the >=1.25x throughput win and the
+                     zero-silent-drop accounting)
   --serve [--selfcheck]   dynamic-batching serving A/B
   --shard-ab [--selfcheck]  replicated vs param-sharded same-seed A/B
                     (numerical match + per-device peak bytes + MFU row)
   --regress [BASELINE] [--repeats N] [--cpu]   gate vs newest BENCH_r*.json
-(--stage-one/--stage-chaos-one/--stage-serve-one/--stage-shard-ab-one are
- internal child modes)
+(--stage-one/--stage-chaos-one/--stage-async-one/--stage-serve-one/
+ --stage-shard-ab-one are internal child modes)
 """
 
 
@@ -1426,6 +1685,15 @@ if __name__ == "__main__":
     elif "--stage-chaos-one" in sys.argv:
         cfg = json.loads(sys.argv[sys.argv.index("--stage-chaos-one") + 1])
         print(json.dumps(measure_chaos_one(cfg)))
+    elif "--stage-async-one" in sys.argv:
+        cfg = json.loads(sys.argv[sys.argv.index("--stage-async-one") + 1])
+        print(json.dumps(measure_async_one(cfg)))
+    elif "--async-ab" in sys.argv:
+        # the selfcheck form runs inside run_lint.sh (tiny host config,
+        # no device): skip the evidence lock a full measurement takes
+        if "--selfcheck" not in sys.argv:
+            _lock_or_warn()
+        sys.exit(stage_async_ab(selfcheck="--selfcheck" in sys.argv))
     elif "--stage-shard-ab-one" in sys.argv:
         cfg = json.loads(sys.argv[sys.argv.index("--stage-shard-ab-one") + 1])
         print(json.dumps(measure_shard_ab(cfg)))

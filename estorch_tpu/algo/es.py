@@ -716,15 +716,7 @@ class ES:
             # restore the pre-generation state; silently keeping a NaN
             # update would poison every subsequent generation
             n_valid = metrics.get("n_valid")
-            reason = None
-            if n_valid is not None and int(n_valid) < 2:
-                reason = (
-                    f"only {int(n_valid)}/{self.population_size} population "
-                    "members produced valid fitness — cannot form an update"
-                )
-            elif not bool(np.asarray(metrics.get("update_finite", True))):
-                reason = ("non-finite parameters/update norm after the "
-                          "optimizer step")
+            reason = self._update_anomaly(metrics)
             if reason is not None:
                 if self._shard_params:
                     # the donated program already rolled back in-program
@@ -754,6 +746,86 @@ class ES:
             self._emit_record(record, log_fn, verbose)
             done += 1
         return self
+
+    def _update_anomaly(self, metrics) -> str | None:
+        """The ONE definition of a rejectable generation (shared by
+        ``train`` and the async schedulers, algo/scheduler.py): returns
+        the rejection reason or None (docs/resilience.md)."""
+        n_valid = metrics.get("n_valid")
+        if n_valid is not None and int(n_valid) < 2:
+            return (
+                f"only {int(n_valid)}/{self.population_size} population "
+                "members produced valid fitness — cannot form an update"
+            )
+        if not bool(np.asarray(metrics.get("update_finite", True))):
+            return ("non-finite parameters/update norm after the "
+                    "optimizer step")
+        return None
+
+    # ------------------------------------------------- async generations
+
+    def train_async(
+        self,
+        n_steps: int,
+        n_proc: int = 1,
+        log_fn: Callable[[dict], None] | None = None,
+        verbose: bool = True,
+        max_consecutive_rejections: int = 3,
+        strategy: str = "auto",
+        max_stale: int = 16,
+        iw_clip: float = 2.0,
+        replay=None,
+    ):
+        """Barrier-free generations (docs/async.md, algo/scheduler.py).
+
+        ``strategy``: ``"fold"`` (host backend) runs the event-driven
+        scheduler — rollouts are member/slice tasks on worker queues,
+        an update fires per population's-worth of ARRIVED results, and
+        late results (chaos stragglers, slow pooled workers) fold into
+        the current update with clipped importance weights keyed on the
+        σ/θ they were sampled under, instead of being waited on.
+        ``"overlap"`` (device/pooled/sharded; also valid on host)
+        pipelines generation g+1's program dispatch with generation g's
+        host-side tail — bit-identical to ``train``.  ``"auto"`` picks
+        fold on host, overlap elsewhere.
+
+        ``max_stale``: fold horizon in center versions — older results
+        are discarded with evidence (``stale_discarded``).  ``iw_clip``:
+        IMPACT-style truncation of the mean-normalized importance
+        ratios.  ``replay``: an :class:`~estorch_tpu.algo.scheduler.
+        AsyncEventLog` (or its dict form) — re-drive that recorded
+        schedule instead of running live; bit-identical parameters.
+        The live run's log is left on ``es.async_event_log``.
+        """
+        from .scheduler import GenerationScheduler, train_overlap
+
+        if strategy not in ("auto", "fold", "overlap"):
+            raise ValueError(
+                f"strategy must be auto|fold|overlap, got {strategy!r}")
+        if strategy == "auto":
+            strategy = "fold" if self.backend == "host" else "overlap"
+        self._setup_n_proc(n_proc)
+        if strategy == "overlap":
+            if replay is not None:
+                raise ValueError(
+                    "replay re-drives a fold-mode event log; the overlap "
+                    "scheduler is bit-identical to train() already")
+            return train_overlap(
+                self, n_steps, log_fn=log_fn, verbose=verbose,
+                max_consecutive_rejections=max_consecutive_rejections)
+        sched = GenerationScheduler(
+            self, max_stale=max_stale, iw_clip=iw_clip,
+            max_consecutive_rejections=max_consecutive_rejections)
+        if replay is not None:
+            return sched.replay(replay, log_fn=log_fn, verbose=verbose,
+                                n_steps=n_steps)
+        return sched.run(n_steps, log_fn=log_fn, verbose=verbose)
+
+    @property
+    def async_event_log(self):
+        """The last ``train_async`` fold run's deterministic event log
+        (None before any fold-mode run)."""
+        return getattr(self, "_async_log", None)
 
     def _setup_n_proc(self, n_proc: int) -> None:
         if self.backend != "host":
@@ -875,6 +947,13 @@ class ES:
             else self.sigma,
             "wall_time_s": dt,
         }
+        return self._finalize_record(record)
+
+    def _finalize_record(self, record: dict) -> dict:
+        """Record plumbing shared by every train loop (sync, fold,
+        overlap — algo/scheduler.py builds its own core dict and calls
+        this): span flush, compile-ledger merge, one-shot cost model,
+        run-level counters."""
         # flush this generation's span accumulator into the record and
         # export the run-level counters (obs/summarize.py consumes both)
         record["phases"] = self.obs.take_phases()
@@ -887,7 +966,7 @@ class ES:
         if not self._cost_model_emitted and self.obs.cost_model is not None:
             record["cost_model"] = self.obs.cost_model
             self._cost_model_emitted = True
-        self.obs.counters.inc("env_steps", steps)
+        self.obs.counters.inc("env_steps", record["env_steps"])
         if record["n_failed"]:
             self.obs.counters.inc("rollout_failures", record["n_failed"])
         return record

@@ -55,6 +55,24 @@ from ..utils.fault import rank_weights_with_failures
 from .es import ES
 
 
+def stale_log_ratios(dots, norms, d2: float, c: float, dim: int):
+    """Per-member log importance ratios of samples drawn under an older
+    (θ_old, σ_old) seen from the current (θ_new, σ_new) — THE IW-ES
+    formula (module docstring), shared by :class:`IW_ES` and the async
+    scheduler's late-result fold (algo/scheduler.py).
+
+    ``dots`` are the SIGNED per-member ε·d values (s_i already applied;
+    the mirrored expansion is the caller's job), ``norms`` the per-member
+    ‖ε‖², ``d2`` = ‖d‖² with d = (θ_old − θ_new)/σ_new, ``c`` =
+    σ_old/σ_new.  Returns log λ (unnormalized — λ only ever enters
+    self-normalized, so callers shift by the max before exponentiating).
+    """
+    dots = np.asarray(dots)
+    norms = np.asarray(norms)
+    eps_new_sq = d2 + 2.0 * c * dots + c * c * norms
+    return dim * np.log(c) + 0.5 * (norms - eps_new_sq)
+
+
 class IW_ES(ES):
     """ES with importance-weighted reuse of the previous generation."""
 
@@ -264,8 +282,7 @@ class IW_ES(ES):
             # members 2k/2k+1 share pair row k with signs ±1
             dots = np.repeat(dots, 2) * np.tile([1.0, -1.0], dots.shape[0])
             norms = np.repeat(norms, 2)
-        eps_new_sq = d2 + 2.0 * c * dots + c * c * norms
-        log_lam = self._spec.dim * np.log(c) + 0.5 * (norms - eps_new_sq)
+        log_lam = stale_log_ratios(dots, norms, d2, c, self._spec.dim)
         # log-sum-exp style stabilization: λ only ever enters self-normalized
         # (λ̃ and ESS are shift-invariant in log space)
         log_lam -= log_lam.max()

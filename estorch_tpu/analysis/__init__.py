@@ -19,6 +19,11 @@ Rules (docs/analysis.md has the full rationale per rule):
 * R06 signature-probe-default — inspect.signature fallback that guesses
 * R07 unfenced-device-timing  — perf_counter delta around jitted dispatch
                                 without a block_until_ready fence
+* R08 swallowed-fault         — pass-only except outside teardown/probes
+* R09 nonmonotonic-span-clock — wall-clock deltas timing spans/ages
+* R10 unsharded-capture       — host arrays closed over by sharded jit
+* R11 blocking-wait-in-scheduler — unbounded queue.get/thread.join/
+                                conn.recv in an event-loop hot path
 
 Nothing in this package imports jax or the analyzed modules — analysis
 is pure ``ast`` and safe to run where no accelerator exists.

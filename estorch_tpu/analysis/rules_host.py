@@ -1,5 +1,5 @@
 """Host-side robustness rules: R05 untimed-subprocess-wait,
-R06 signature-probe-default.
+R06 signature-probe-default, R11 blocking-wait-in-scheduler.
 
 R05 is the wedge class ``doctor.py`` exists to detect after the fact:
 a ``proc.wait()`` / ``proc.communicate()`` with no timeout turns a hung
@@ -12,6 +12,14 @@ R06 is the bug family from rollout's ``_ci_takes_params``: when
 *guessed* constant silently picks a calling convention; the wrong guess
 crashes at trace time far from the cause.  The fallback must PROBE
 (call the zero-arg form under ``except TypeError``) instead of guessing.
+
+R11 is R05 generalized to IN-PROCESS queues and threads — the hazard
+class the async scheduler (algo/scheduler.py) introduced: an event loop
+that blocks unbounded on ``queue.get()``, ``thread.join()``, or a pipe
+``recv()`` turns one silent producer (a straggler that never wakes, a
+worker that died mid-message) into a wedged scheduler, invisible to the
+heartbeat because the loop never reaches its next beat.  Every blocking
+point in an event-driven hot path must wake on a bounded slice.
 """
 
 from __future__ import annotations
@@ -116,6 +124,126 @@ def check_untimed_wait(ctx: ModuleContext):
                 f"call `.{method}(timeout=...)` and kill/escalate on "
                 "subprocess.TimeoutExpired",
                 symbol))
+    return out
+
+
+# ---------------------------------------------------------------------
+# R11 blocking-wait-in-scheduler
+# ---------------------------------------------------------------------
+
+# receiver-name heuristics, same approach as R05's _PROCISH_NAME: the
+# names people actually give queues / worker threads / pipe connections
+_QUEUEISH_NAME = re.compile(
+    r"(^|_)(queue|q|events?|inbox|outbox|results?|tasks?|mailbox)(s)?($|_)",
+    re.IGNORECASE)
+_THREADISH_NAME = re.compile(
+    r"(^|_)(thread|worker|pump|collector|consumer|producer)(s)?($|_)",
+    re.IGNORECASE)
+_CONNISH_NAME = re.compile(
+    r"(^|_)(conn|connection|pipe|sock|socket|channel)(s)?($|_)",
+    re.IGNORECASE)
+
+
+def _kw(call: ast.Call, name: str) -> ast.keyword | None:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw
+    return None
+
+
+def _untimed_get(call: ast.Call) -> bool:
+    """queue.get() blocking forever: no positional args (dict.get(key)
+    and protocol gets always pass one), no timeout, and not the
+    non-blocking form (block=False / get_nowait is a different name)."""
+    if call.args:
+        return False
+    kw = _kw(call, "timeout")
+    if kw is not None and not (isinstance(kw.value, ast.Constant)
+                               and kw.value.value is None):
+        return False
+    block = _kw(call, "block")
+    if block is not None and isinstance(block.value, ast.Constant) \
+            and block.value.value is False:
+        return False
+    return True
+
+
+def _untimed_join(call: ast.Call) -> bool:
+    """thread.join() with no bound: str.join(iterable) always has an
+    argument, Thread.join(timeout) may be positional."""
+    if call.args:
+        return False
+    kw = _kw(call, "timeout")
+    return kw is None or (isinstance(kw.value, ast.Constant)
+                          and kw.value.value is None)
+
+
+def _scope_establishes_readiness(ctx: ModuleContext, scope) -> bool:
+    """True when the scope bounds its pipe waits before recv(): a
+    ``poll(timeout)`` probe or a ``wait(..., timeout=...)`` select-style
+    call — the procpool idiom (conn.poll(slice) / mpc.wait(conns,
+    timeout=...)), after which recv() only ever reads buffered data."""
+    for node in scope_nodes(scope):
+        if not isinstance(node, ast.Call):
+            continue
+        if isinstance(node.func, ast.Attribute) and node.func.attr == "poll" \
+                and node.args:
+            return True
+        name = (node.func.attr if isinstance(node.func, ast.Attribute)
+                else node.func.id if isinstance(node.func, ast.Name)
+                else None)
+        if name == "wait" and _kw(node, "timeout") is not None:
+            return True
+    return False
+
+
+@rule("R11", "blocking-wait-in-scheduler", "error",
+      "unbounded in-process wait (queue.get/thread.join/conn.recv) can "
+      "wedge an event loop")
+def check_blocking_wait(ctx: ModuleContext):
+    r = get_rule("R11")
+    out = []
+    for symbol, scope in iter_scopes(ctx):
+        ready = None  # lazy: computed only when a recv() shows up
+        for node in scope_nodes(scope):
+            if not isinstance(node, ast.Call) or not isinstance(
+                    node.func, ast.Attribute):
+                continue
+            method = node.func.attr
+            tail = _receiver_tail(node.func)
+            if tail is None:
+                continue
+            if method == "get" and _QUEUEISH_NAME.search(tail) \
+                    and _untimed_get(node):
+                out.append(make_finding(
+                    ctx, r, node,
+                    f"`{tail}.get()` without timeout — a producer that "
+                    "never answers wedges this loop forever",
+                    "call `.get(timeout=...)` in a bounded slice and "
+                    "handle queue.Empty (re-check liveness, then retry)",
+                    symbol))
+            elif method == "join" and _THREADISH_NAME.search(tail) \
+                    and _untimed_join(node):
+                out.append(make_finding(
+                    ctx, r, node,
+                    f"`{tail}.join()` without timeout — a worker stuck "
+                    "in a straggler sleep or dead lock never joins",
+                    "call `.join(timeout=...)` and escalate (flag, "
+                    "abandon a daemon thread, raise) when it misses",
+                    symbol))
+            elif method == "recv" and _CONNISH_NAME.search(tail) \
+                    and not node.args:
+                if ready is None:
+                    ready = _scope_establishes_readiness(ctx, scope)
+                if not ready:
+                    out.append(make_finding(
+                        ctx, r, node,
+                        f"`{tail}.recv()` with no readiness guard — a "
+                        "silent peer wedges this end forever",
+                        "probe `.poll(timeout)` (or select via "
+                        "multiprocessing.connection.wait with a timeout) "
+                        "before recv, so the wait is bounded",
+                        symbol))
     return out
 
 

@@ -397,10 +397,6 @@ class HostEngine:
         meta-population — never blend Adam statistics through the shared
         master optimizer.
         """
-        import copy
-
-        import torch
-
         w = np.asarray(weights, dtype=np.float32)
         if offs is None:
             offs = self._pair_offsets(state)
@@ -414,6 +410,21 @@ class HostEngine:
             for i, o in enumerate(offs):
                 grad_ascent += w[i] * self._eps(int(o))
         grad_ascent /= self.population_size * sigma
+        return self.apply_grad(state, grad_ascent)
+
+    def apply_grad(self, state: HostState,
+                   grad_ascent: np.ndarray) -> tuple[HostState, float]:
+        """Torch-optimizer step from an ALREADY-SCALED ascent direction
+        (the 1/(n·σ) division is the caller's — apply_weights above, or
+        the async scheduler's mixed-staleness fold, algo/scheduler.py).
+        Weight decay, chaos update poisoning, σ annealing, and the
+        immutable-state contract all live here so the two callers can
+        never diverge."""
+        import copy
+
+        import torch
+
+        sigma = self._state_sigma(state)
         if self.weight_decay > 0.0:
             # same L2 pull as the device engine's _update_from_weights
             grad_ascent = grad_ascent - self.weight_decay * state.params_flat
@@ -440,7 +451,7 @@ class HostEngine:
             )
         self.optimizer.zero_grad()
         # torch optimizers minimize: descend on -ascent
-        g = torch.from_numpy(-grad_ascent)
+        g = torch.from_numpy(-np.ascontiguousarray(grad_ascent))
         i = 0
         for p in self.master.parameters():
             n = p.numel()

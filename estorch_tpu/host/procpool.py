@@ -50,6 +50,10 @@ from ..obs.spans import NULL_TELEMETRY
 # time rather than after the full generation deadline
 POLL_SLICE_S = 0.1
 
+# idle-loop poll slice inside the worker: only paid while the worker has
+# nothing to do, and what makes a dead parent's EOF observable
+WORKER_POLL_S = 1.0
+
 
 def _worker_main(
     conn,
@@ -90,7 +94,16 @@ def _worker_main(
     from .engine import HostEngine, member_sign_offset
 
     while True:
-        msg = conn.recv()
+        # bounded idle wait before the blocking recv (esguard R11): a
+        # parent that died without sending the stop sentinel leaves the
+        # pipe EOF-readable, which poll surfaces and recv turns into a
+        # clean exit instead of an unbounded sleep on a dead fd
+        if not conn.poll(WORKER_POLL_S):
+            continue
+        try:
+            msg = conn.recv()
+        except EOFError:
+            return  # parent end closed: nothing more will ever come
         if msg is None:
             return
         seq, generation, params_flat, sigma, offsets, indices = msg
@@ -101,6 +114,7 @@ def _worker_main(
         fitness = np.full(len(indices), np.nan, np.float32)
         bcs: list[np.ndarray] = []
         steps = 0
+        t0 = time.perf_counter()
         for j, i in enumerate(indices):
             sign, off = member_sign_offset(offsets, i, mirrored)
             theta = params_flat + sigma * sign * table[off : off + dim]
@@ -119,7 +133,8 @@ def _worker_main(
         for j, b in enumerate(bcs):
             if b.shape[0]:
                 bc[j] = b
-        conn.send((seq, np.asarray(indices, np.int64), fitness, bc, steps))
+        conn.send((seq, np.asarray(indices, np.int64), fitness, bc, steps,
+                   time.perf_counter() - t0))
 
 
 class ProcessPool:
@@ -155,6 +170,7 @@ class ProcessPool:
         self._procs: list[Any] = []
         self._conns: list[Any] = []
         self._retired: list[Any] = []  # replaced dead workers, joined at close
+        self._eof: set[int] = set()  # workers whose pipe EOF'd (poll skips)
         for w in range(self.n_proc):
             self._procs.append(None)
             self._conns.append(None)
@@ -174,6 +190,7 @@ class ProcessPool:
         child.close()
         self._procs[w] = p
         self._conns[w] = parent
+        self._eof.discard(w)
 
     @property
     def worker_pids(self) -> list[int]:
@@ -271,7 +288,7 @@ class ProcessPool:
         # are NOT retried: their results may still arrive, and duplicating
         # them would only double the load that made them late.
         covered: set[int] = set()
-        for indices, _f, _b, _s in parts:
+        for indices, _f, _b, _s, _t in parts:
             covered.update(int(i) for i in indices)
         missing = [i for i in range(self.population_size) if i not in covered
                    and not self._procs[i % self.n_proc].is_alive()]
@@ -296,12 +313,62 @@ class ProcessPool:
         bc_dim = max((p[2].shape[1] for p in parts), default=0)
         bc = np.zeros((self.population_size, bc_dim), np.float32)
         steps = 0
-        for indices, f, b, st in parts:
+        for indices, f, b, st, _t in parts:
             fitness[indices] = f
             if b.shape[1]:
                 bc[indices] = b
             steps += st
         return fitness, bc, steps
+
+    # ------------------------------------------------- async (scheduler)
+
+    def dispatch(self, worker: int, params_flat: np.ndarray, sigma: float,
+                 offsets: np.ndarray, generation: int,
+                 indices=None) -> int | None:
+        """Async API (algo/scheduler.py): send ONE slice message to
+        ``worker`` and return its sequence tag, or None when the pipe is
+        dead (the caller accounts the slice as lost).  ``indices=None``
+        means the worker's own round-robin slice."""
+        self._seq += 1
+        msg = (self._seq, int(generation),
+               np.asarray(params_flat, np.float32), float(sigma),
+               np.asarray(offsets),
+               None if indices is None else np.asarray(indices, np.int64))
+        return self._seq if self._send(worker, msg) else None
+
+    def poll(self, timeout_s: float) -> list[tuple]:
+        """Async API: one bounded wait, then drain every buffered reply —
+        (seq, indices, fitness, bc, steps, eval_s) tuples for EVERY
+        sequence tag, late straggler replies included.  Staleness policy
+        belongs to the scheduler; unlike the synchronous ``_collect``,
+        nothing is discarded here."""
+        live = {id(c): w for w, c in enumerate(self._conns)
+                if c is not None and not c.closed and w not in self._eof}
+        if not live:
+            time.sleep(min(timeout_s, POLL_SLICE_S))
+            return []
+        out: list[tuple] = []
+        ready = mpc.wait([self._conns[w] for w in live.values()],
+                         timeout=timeout_s)
+        for c in ready:
+            w = live[id(c)]
+            try:
+                out.append(c.recv())
+            except (EOFError, OSError):
+                # dead pipe: exclude from future polls until respawned,
+                # or an EOF-readable corpse would turn poll into a spin
+                self._eof.add(w)
+        return out
+
+    def worker_alive(self, w: int) -> bool:
+        return self._procs[w].is_alive()
+
+    def conn_has_data(self, w: int) -> bool:
+        """A buffered reply survives its writer — drainable by poll."""
+        try:
+            return w not in self._eof and self._conns[w].poll(0)
+        except (OSError, EOFError):
+            return False
 
     # --------------------------------------------------------------- close
 

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import contextlib
 import os
+import threading
 import time
 
 from .counters import Counters, NullCounters
@@ -59,8 +60,15 @@ class Telemetry:
         self.recorder = FlightRecorder(recorder_capacity)
         self.heartbeat = Heartbeat(heartbeat_path) if heartbeat_path else None
         self.generation = 0
+        # span nesting is PER THREAD (the overlap scheduler runs the
+        # engine's sample/eval/update spans from a background thread
+        # while the main thread records host_sync/record — one shared
+        # stack would interleave their pushes/pops into bogus names
+        # like "async/dispatch/eval"); the accumulator is shared and
+        # lock-guarded so both threads' spans land in the same record
         self._acc: dict[str, float] = {}
-        self._stack: list[str] = []
+        self._acc_lock = threading.Lock()
+        self._tls = threading.local()
         # performance-attribution facts (obs/profile/): the per-program
         # compile ledger and the run's analytic cost model — engines feed
         # the first, ES sets the second, `obs profile` joins them
@@ -88,10 +96,19 @@ class Telemetry:
             return _NULL_CM
         return self._phase_cm(name, fence)
 
+    @property
+    def _stack(self) -> list[str]:
+        """This thread's span-nesting stack (see __init__)."""
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
     @contextlib.contextmanager
     def _phase_cm(self, name: str, fence):
-        full = f"{self._stack[-1]}/{name}" if self._stack else name
-        self._stack.append(full)
+        stack = self._stack
+        full = f"{stack[-1]}/{name}" if stack else name
+        stack.append(full)
         if self.heartbeat is not None:
             # beat on ENTRY: a wedge inside this phase leaves its name —
             # not the previous phase's — as the last-known state
@@ -104,8 +121,9 @@ class Telemetry:
                 fence()
         finally:
             dt = time.perf_counter() - t0
-            self._stack.pop()
-            self._acc[full] = self._acc.get(full, 0.0) + dt
+            stack.pop()
+            with self._acc_lock:
+                self._acc[full] = self._acc.get(full, 0.0) + dt
             self.recorder.add("span", full, dur_s=dt,
                               generation=self.generation)
 
@@ -114,8 +132,9 @@ class Telemetry:
         generation record) and advance the generation counter."""
         if not self.enabled:
             return {}
-        out = {k: round(v, 6) for k, v in self._acc.items()}
-        self._acc.clear()
+        with self._acc_lock:
+            out = {k: round(v, 6) for k, v in self._acc.items()}
+            self._acc.clear()
         self.generation += 1
         self.counters.inc("generations")
         self.counters.sample_peak_rss()
@@ -131,7 +150,8 @@ class Telemetry:
         contract) leaves partial spans behind, which must not be merged
         into the next successful generation's record.  The flight
         recorder keeps the aborted spans for post-mortems."""
-        self._acc.clear()
+        with self._acc_lock:
+            self._acc.clear()
 
     def note(self, phase: str) -> None:
         """Heartbeat-only marker for long un-spanned stretches (backend

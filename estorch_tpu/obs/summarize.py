@@ -44,7 +44,14 @@ RECORD_SCHEMA: dict[str, tuple[tuple[type, ...], bool]] = {
     # rides the run's first record only
     "compile_events": ((list,), False),
     "cost_model": ((dict,), False),
+    # async scheduler accounting (algo/scheduler.py, docs/async.md):
+    # consumed/fresh/folded/stale_discarded per update + overlap facts
+    "async": ((dict,), False),
 }
+
+# integer accounting keys an ``async`` block must carry (the zero-drop
+# contract: consumed = fresh + folded, discards counted)
+ASYNC_REQUIRED_KEYS = ("consumed", "fresh", "folded", "stale_discarded")
 
 # a record shaped exactly like ES._base_record + span merge emits — the
 # selfcheck fixture.  If _base_record changes shape, update BOTH (the
@@ -109,6 +116,20 @@ def validate_record(rec: dict) -> list[str]:
                   or isinstance(dur, bool) or dur < 0):
                 problems.append(f"phase {name!r} duration {dur!r} is not a "
                                 "non-negative number")
+    a = rec.get("async")
+    if isinstance(a, dict):
+        for key in ASYNC_REQUIRED_KEYS:
+            v = a.get(key)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                problems.append(f"async.{key} {v!r} is not a "
+                                "non-negative int")
+        if (isinstance(a.get("consumed"), int)
+                and isinstance(a.get("fresh"), int)
+                and isinstance(a.get("folded"), int)
+                and a["consumed"] != a["fresh"] + a["folded"]):
+            problems.append(
+                f"async accounting broken: consumed {a['consumed']} != "
+                f"fresh {a['fresh']} + folded {a['folded']}")
     for i, e in enumerate(rec.get("compile_events") or []):
         if not isinstance(e, dict) or not isinstance(e.get("program"), str):
             problems.append(f"compile_events[{i}] lacks a program name")
@@ -323,6 +344,31 @@ def summarize(records: list[dict], heartbeat_path: str | None = None,
         if med > 0 and w > STALL_FACTOR * med
     ]
 
+    # ---- async scheduler section (records carrying an "async" block) --
+    async_recs = [r["async"] for r in records
+                  if isinstance(r.get("async"), dict)]
+    async_block = None
+    if async_recs:
+        consumed = sum(int(a.get("consumed", 0)) for a in async_recs)
+        folded = sum(int(a.get("folded", 0)) for a in async_recs)
+        discarded = sum(int(a.get("stale_discarded", 0))
+                        for a in async_recs)
+        oes = [a["overlap_efficiency"] for a in async_recs
+               if isinstance(a.get("overlap_efficiency"), (int, float))
+               and not isinstance(a.get("overlap_efficiency"), bool)]
+        async_block = {
+            "updates": len(async_recs),
+            "consumed": consumed,
+            "folded": folded,
+            "stale_discarded": discarded,
+            "stale_reuse_ratio": (round(folded / consumed, 4)
+                                  if consumed else None),
+            "overlap_efficiency": (round(_median(oes), 4) if oes
+                                   else None),
+            "max_staleness": max((int(a.get("max_staleness", 0))
+                                  for a in async_recs), default=0),
+        }
+
     diagnosis = []
     if stalls:
         worst = max(stalls, key=lambda s: s["x_median"])
@@ -395,6 +441,14 @@ def summarize(records: list[dict], heartbeat_path: str | None = None,
             f"{n_replayed} replayed generation record"
             f"{'s' if n_replayed != 1 else ''} deduped (re-run after a "
             "restart resumed from an earlier checkpoint)")
+    if async_block:
+        clause = (f"async: {async_block['folded']}/"
+                  f"{async_block['consumed']} results folded stale "
+                  f"(ratio {async_block['stale_reuse_ratio']})")
+        if async_block["stale_discarded"]:
+            clause += (f", {async_block['stale_discarded']} DISCARDED "
+                       "past the staleness horizon")
+        diagnosis.append(clause)
     if not diagnosis:
         diagnosis.append("steady: no stalls, no throughput decay")
 
@@ -419,6 +473,8 @@ def summarize(records: list[dict], heartbeat_path: str | None = None,
         out["serving"] = serving
     if restarts is not None:
         out["restarts"] = restarts
+    if async_block is not None:
+        out["async"] = async_block
     return out
 
 
@@ -468,6 +524,16 @@ def format_summary(s: dict) -> str:
     if s.get("counters"):
         lines.append("resilience       " + "  ".join(
             f"{k}={int(v)}" for k, v in s["counters"].items()))
+    a = s.get("async")
+    if a:
+        line = (f"async            {a['updates']} updates  "
+                f"{a['folded']}/{a['consumed']} folded stale")
+        if a.get("stale_reuse_ratio") is not None:
+            line += f" (ratio {a['stale_reuse_ratio']})"
+        if a.get("overlap_efficiency") is not None:
+            line += f"  overlap {a['overlap_efficiency']}"
+        line += f"  discarded={a['stale_discarded']}"
+        lines.append(line)
     lines.extend(_format_serving(s))
     if s.get("restarts") and s["restarts"]["count"]:
         lines.append(f"restarts         {s['restarts']['count']} "
@@ -515,6 +581,37 @@ def selfcheck() -> list[str]:
         problems.append(f"top-level shares sum to {total_share}, not 1")
     if format_summary(s) == "no records":
         problems.append("format_summary rendered nothing")
+
+    # async scheduler surfacing (algo/scheduler.py): records carrying an
+    # "async" block must validate, aggregate into the async section, and
+    # render — and broken accounting must FAIL validation
+    async_rec = dict(GOLDEN_RECORD, generation=6,
+                     **{"async": {"consumed": 16, "fresh": 10, "folded": 6,
+                                  "stale_discarded": 1, "max_staleness": 2,
+                                  "mean_lambda": 0.91,
+                                  "overlap_efficiency": 0.8}})
+    problems += [f"async golden: {p}"
+                 for p in validate_record(json.loads(json.dumps(async_rec)))]
+    broken_async = dict(GOLDEN_RECORD,
+                        **{"async": {"consumed": 16, "fresh": 10,
+                                     "folded": 3, "stale_discarded": 0}})
+    if not validate_record(broken_async):
+        problems.append("validator accepted consumed != fresh + folded")
+    sa = summarize(recs + [json.loads(json.dumps(async_rec))])
+    ab = sa.get("async")
+    if not ab or ab.get("folded") != 6 or ab.get("consumed") != 16:
+        problems.append("summary missed the async accounting block")
+    if ab and ab.get("stale_reuse_ratio") != round(6 / 16, 4):
+        problems.append("stale_reuse_ratio mis-derived")
+    if "async" not in sa.get("diagnosis", ""):
+        problems.append("diagnosis missed the async section")
+    if "DISCARDED" not in sa["diagnosis"]:
+        problems.append("diagnosis missed the stale-discard callout")
+    if "async" not in format_summary(sa):
+        problems.append("format_summary dropped the async block")
+    # a synchronous run must not grow an async section
+    if summarize(recs).get("async"):
+        problems.append("sync run grew an async section")
 
     # resilience surfacing: a chaos run's rejected-generation counters and
     # the supervisor's restart provenance must show up in the summary —

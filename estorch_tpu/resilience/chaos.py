@@ -15,7 +15,8 @@ JSON, so forked/spawned children inherit the same plan):
         {"kind": "kill_worker", "gen": 5, "worker": 0},
         {"kind": "nan_fitness", "gen": 9, "member": "all"},
         {"kind": "rollout_exc", "gen": 3, "member": [1, 4]},
-        {"kind": "straggler",   "gen": 4, "member": 2, "sleep_s": 2.0},
+        {"kind": "straggler",   "gen": 4, "member": 2, "sleep_s": 2.0,
+         "jitter_s": 0.5},
         {"kind": "ckpt_crash",  "gen": 8},
         {"kind": "nan_update",  "gen": 2},
         {"kind": "die",         "gen": 12},
@@ -29,7 +30,11 @@ Event kinds and their injection points:
 kind            fires where
 ==============  =====================================================
 rollout_exc     inside the member rollout (host thread + fork workers)
-straggler       same place, as a ``sleep_s`` stall
+straggler       same place, as a ``sleep_s`` stall; an optional
+                ``jitter_s`` adds a deterministic per-event spread in
+                [0, jitter_s) (seeded by the event id — the same plan
+                always stalls by the same amounts), so a plan can model
+                a slow-tail DISTRIBUTION instead of one fixed delay
 nan_fitness     on the gathered fitness vector (host/pooled engines)
 kill_worker     SIGKILL of a ProcessPool worker at the generation start
 nan_update      poisons the update direction (host engine) — exercises
@@ -127,9 +132,19 @@ class ChaosPlan:
         p_rollout_exc: float = 0.0,
         p_nan_burst: float = 0.0,
         population_size: int = 1,
+        straggler_every: int = 0,
+        straggler_sleep_s: float = 1.0,
+        straggler_jitter_s: float = 0.0,
     ) -> "ChaosPlan":
         """Seeded random plan — deterministic in ``seed``: the same seed
-        always schedules the same faults at the same points."""
+        always schedules the same faults at the same points.
+
+        ``straggler_every`` schedules one straggler stall every K
+        generations on a random member, sleeping ``straggler_sleep_s``
+        plus a deterministic jitter in [0, ``straggler_jitter_s``) —
+        the slow-tail workload the async scheduler's A/B (``bench.py
+        --async-ab``) and the mixed straggler+kill chaos plan exercise.
+        """
         import numpy as np
 
         rng = np.random.default_rng(seed)
@@ -140,6 +155,13 @@ class ChaosPlan:
                     {"kind": "kill_worker", "gen": g,
                      "worker": int(rng.integers(n_workers))}
                 )
+            if straggler_every and g % straggler_every == 0:
+                ev = {"kind": "straggler", "gen": g,
+                      "member": int(rng.integers(population_size)),
+                      "sleep_s": float(straggler_sleep_s)}
+                if straggler_jitter_s > 0.0:
+                    ev["jitter_s"] = float(straggler_jitter_s)
+                events.append(ev)
             if p_rollout_exc and rng.random() < p_rollout_exc:
                 events.append(
                     {"kind": "rollout_exc", "gen": g,
@@ -235,6 +257,21 @@ def _matches_member(ev: dict, member: int) -> bool:
     return int(m) == int(member)
 
 
+def straggler_sleep_s(ev: dict) -> float:
+    """A straggler event's total stall: ``sleep_s`` plus a deterministic
+    jitter drawn uniformly from [0, jitter_s) and seeded by the event id
+    — the same plan always produces the same slow-tail spread, in every
+    process that fires it (the async scheduler's A/B depends on the two
+    legs seeing identical stalls)."""
+    base = float(ev.get("sleep_s", 1.0))
+    jitter = float(ev.get("jitter_s", 0.0))
+    if jitter <= 0.0:
+        return base
+    import random
+
+    return base + random.Random(int(ev["id"])).uniform(0.0, jitter)
+
+
 # ------------------------------------------------------------------ hooks
 
 def member_fault(generation, member: int) -> None:
@@ -247,7 +284,7 @@ def member_fault(generation, member: int) -> None:
     gen = int(generation)
     for ev in plan.events_at(gen, "straggler"):
         if _matches_member(ev, member) and plan.fire(ev):
-            time.sleep(float(ev.get("sleep_s", 1.0)))
+            time.sleep(straggler_sleep_s(ev))
     for ev in plan.events_at(gen, "rollout_exc"):
         if _matches_member(ev, member) and plan.fire(ev):
             raise ChaosError(

@@ -42,6 +42,15 @@ echo "== chaos selfcheck =="
 # no device touch.
 python bench.py --chaos --selfcheck
 
+echo "== async-ab selfcheck =="
+# barrier-free-scheduler gate (estorch_tpu/algo/scheduler.py,
+# docs/async.md): the same tiny host run under an identical
+# deterministic straggler plan must run >=1.25x faster through the
+# event-driven fold scheduler than through the synchronous barrier
+# loop (medians + learned noise band), with every late result folded
+# or counted — zero silent drops.  Host path only, no device touch.
+python bench.py --async-ab --selfcheck
+
 echo "== shard-ab selfcheck =="
 # param-sharded gate (estorch_tpu/parallel/sharded.py, docs/sharding.md):
 # a same-seed sharded run must match the replicated fused path allclose

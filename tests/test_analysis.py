@@ -1009,6 +1009,114 @@ class TestR10:
             step = jax.jit(body, in_shardings=(None,))
         """, "R10")
 
+
+# ---------------------------------------------------------------------
+# R11 blocking-wait-in-scheduler
+# ---------------------------------------------------------------------
+
+class TestR11:
+    def test_untimed_queue_get_flagged(self):
+        found = findings("""
+            def pump(events):
+                while True:
+                    ev = events.get()
+                    handle(ev)
+        """, "R11")
+        assert len(found) == 1
+        assert "get" in found[0].message
+
+    def test_timed_queue_get_clean(self):
+        assert not findings("""
+            import queue
+
+            def pump(events):
+                while True:
+                    try:
+                        ev = events.get(timeout=0.05)
+                    except queue.Empty:
+                        continue
+                    handle(ev)
+        """, "R11")
+
+    def test_nonblocking_get_and_dict_get_clean(self):
+        assert not findings("""
+            def drain(q, cfg):
+                v = cfg.get("mode")
+                try:
+                    item = q.get(block=False)
+                except Exception:
+                    item = None
+                return v, item
+        """, "R11")
+
+    def test_untimed_thread_join_flagged(self):
+        found = findings("""
+            def close(self):
+                for t in self._threads:
+                    t.join()
+        """, "R11")
+        # receiver `t` isn't thread-ish by name; the attr-receiver form is
+        assert not found
+        found = findings("""
+            def close(self):
+                self.worker.join()
+        """, "R11")
+        assert len(found) == 1
+        assert "join" in found[0].message
+
+    def test_timed_join_and_str_join_clean(self):
+        assert not findings("""
+            def close(self, parts):
+                self.worker.join(timeout=5.0)
+                return ", ".join(parts)
+        """, "R11")
+
+    def test_unguarded_conn_recv_flagged(self):
+        found = findings("""
+            def serve(conn):
+                while True:
+                    msg = conn.recv()
+                    if msg is None:
+                        return
+        """, "R11")
+        assert len(found) == 1
+        assert "recv" in found[0].message
+
+    def test_poll_guarded_recv_clean(self):
+        assert not findings("""
+            def serve(conn):
+                while True:
+                    if not conn.poll(1.0):
+                        continue
+                    msg = conn.recv()
+                    if msg is None:
+                        return
+        """, "R11")
+
+    def test_wait_select_guarded_recv_clean(self):
+        assert not findings("""
+            import multiprocessing.connection as mpc
+
+            def collect(pending, deadline):
+                ready = mpc.wait(list(pending.values()), timeout=0.1)
+                for conn in ready:
+                    got = conn.recv()
+                    keep(got)
+        """, "R11")
+
+    def test_scheduler_and_procpool_self_clean(self):
+        """The rule's own motivating modules must pass it (self-apply)."""
+        import estorch_tpu.algo.scheduler as sched
+        import estorch_tpu.host.procpool as pp
+
+        for mod in (sched, pp):
+            with open(mod.__file__) as f:
+                src = f.read()
+            hits = [x for x in analyze_source(mod.__file__, src)
+                    if x.rule == "R11"]
+            assert not hits, [h.message for h in hits]
+
+
 # ---------------------------------------------------------------------
 # engine / CLI / config / baseline mechanics
 # ---------------------------------------------------------------------
@@ -1034,7 +1142,7 @@ class TestEngine:
     def test_every_rule_registered(self):
         ids = [r.id for r in all_rules()]
         assert ids == ["R01", "R02", "R03", "R04", "R05", "R06", "R07",
-                       "R08", "R09", "R10"]
+                       "R08", "R09", "R10", "R11"]
 
     def test_syntax_error_becomes_finding(self):
         found = analyze_source("bad.py", "def broken(:\n")
@@ -1168,7 +1276,7 @@ class TestConfig:
         assert cfg.baseline == "esguard_baseline.json"
         assert cfg.rule_ids([r.id for r in all_rules()]) == [
             "R01", "R02", "R03", "R04", "R05", "R06", "R07", "R08", "R09",
-            "R10"]
+            "R10", "R11"]
 
 
 class TestCLI:
