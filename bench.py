@@ -658,6 +658,13 @@ def stage_obs_ab(force_cpu=False, gens=3, repeats=3):
     """Telemetry overhead A/B: the SAME config with default-on spans vs
     telemetry disabled — the <2% observability acceptance gate.
 
+    The ON arm includes everything the hub records by default: spans,
+    counters, AND the streaming histograms (obs/hist.py — per-phase
+    duration distributions observed on every span exit), so this A/B is
+    also the histogram-on vs histogram-off overhead gate; a disabled
+    hub swallows observes through NullHistograms the same way it
+    swallows counter writes.
+
     This host's single-run rates swing far more than 2% (shared-core
     load; the round-4 contamination lesson), so one pair of stages
     cannot resolve a 2% effect: ``repeats`` INTERLEAVED on/off pairs are

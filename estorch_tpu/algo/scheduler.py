@@ -60,6 +60,7 @@ async section.
 from __future__ import annotations
 
 import dataclasses
+import math
 import queue
 import threading
 import time
@@ -77,6 +78,19 @@ from .iwes import stale_log_ratios
 POLL_SLICE_S = 0.05
 
 
+def _count_quantile(counts: dict[int, int], q: float) -> float:
+    """Exact nearest-rank quantile over a value → count dict (the
+    staleness distribution: small bounded integers)."""
+    total = sum(counts.values())
+    k = max(1, math.ceil(q * total))
+    cum = 0
+    for v in sorted(counts):
+        cum += counts[v]
+        if cum >= k:
+            return float(v)
+    return float(max(counts))
+
+
 @dataclasses.dataclass(frozen=True)
 class Source:
     """What one dispatch sampled under — the (θ, σ) the importance
@@ -87,6 +101,7 @@ class Source:
     params: np.ndarray  # (dim,) float32 center snapshot
     sigma: float
     offsets: np.ndarray  # per-pair (mirrored) or per-member table offsets
+    t_dispatch: float = 0.0  # perf_counter at snapshot (0 in replay)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -98,6 +113,7 @@ class Arrival:
     fitness: float
     steps: int
     eval_s: float  # worker busy seconds (straggler sleeps included)
+    t_arrival: float = 0.0  # perf_counter at event-queue entry (0 in replay)
 
 
 class AsyncEventLog:
@@ -203,8 +219,9 @@ class _ThreadSource:
                 fit, steps = res.total_reward, res.steps
             except Exception:  # noqa: BLE001 — NaN marks the member failed
                 fit, steps = float("nan"), 0
+            t1 = time.perf_counter()
             self.events.put(Arrival(source.dispatch, i, float(fit),
-                                    int(steps), time.perf_counter() - t0))
+                                    int(steps), t1 - t0, t1))
 
     def poll_lost(self) -> list[tuple[int, int]]:
         return []  # threads don't die silently; exceptions became NaN
@@ -310,12 +327,13 @@ class _ProcessSource:
                 k = max(len(indices), 1)
                 per = eval_s / k
                 base_steps, rem = divmod(int(steps), k)
+                t_arr = time.perf_counter()
                 for j, i in enumerate(indices):
                     # remainder spread keeps the slice's step total
                     # EXACT — env_steps is the headline metric
                     self.events.put(Arrival(
                         dispatch, int(i), float(fitness[j]),
-                        base_steps + (1 if j < rem else 0), per))
+                        base_steps + (1 if j < rem else 0), per, t_arr))
             if not got:
                 return
             timeout_s = 0.0  # first wait bounded; the rest just drain
@@ -392,6 +410,18 @@ class GenerationScheduler:
         self._consumed_total = 0
         self._folded_total = 0
         self._discarded_total = 0
+        # causal/tail accounting for the CURRENT update window: dispatch
+        # ids snapshotted and results discarded since the last record —
+        # the record's `async` block carries them so `obs trace` can draw
+        # the dispatch → fold/discard flow arrows
+        self._dispatched_since_update: list[int] = []
+        self._discards_since_update: dict[int, int] = {}
+        # exact staleness distribution: value → count.  Staleness is a
+        # SMALL INTEGER (bounded by max_stale), so the log-seconds hist
+        # ladder would distort it (0 → the underflow midpoint ~9e-6);
+        # this dict is bounded by max_stale+1 keys and quantiles walk it
+        # exactly
+        self._staleness_counts: dict[int, int] = {}
 
     # ------------------------------------------------------------ sources
 
@@ -407,9 +437,13 @@ class GenerationScheduler:
             params=np.array(st.params_flat, np.float32, copy=True),
             sigma=float(self.engine._state_sigma(st)),
             offsets=np.asarray(offs),
+            t_dispatch=time.perf_counter(),
         )
         self._sources[dispatch] = src
         self.log.dispatches.append([dispatch, version])
+        self._dispatched_since_update.append(dispatch)
+        self.obs.event("async_dispatch", trace=f"d{dispatch}",
+                       dispatch=int(dispatch), version=int(version))
         return src
 
     def _prune_sources(self, version: int,
@@ -516,6 +550,11 @@ class GenerationScheduler:
                             if lam_stale else None),
             "max_staleness": version - min(
                 self._sources[d].version for d in by_dispatch),
+            # (dispatch, member count) pairs this update consumed — the
+            # causal half of the record's async block (`obs trace` flow
+            # arrows link each dispatch to the update that folded it)
+            "consumed_by_dispatch": [[int(d), len(by_dispatch[d])]
+                                     for d in sorted(by_dispatch)],
         }
         return new_state, gnorm, fit, stats
 
@@ -575,6 +614,30 @@ class GenerationScheduler:
                 self._best_theta(batch_sorted[int(np.nanargmax(fit))]),
                 np.float32)
 
+        # dispatch-lifecycle distributions (docs/observability.md "Tails
+        # & traces"): judged per CONSUMED member at the accepted fold —
+        # a rejected batch's retry must not double-observe.  Wall-clock
+        # legs (arrival→fold queue wait, dispatch→fold latency) are
+        # live-only (t_start is None in replay, whose clocks are fake);
+        # staleness is pure math and recorded in both.
+        t_now = time.perf_counter() if t_start is not None else None
+        for a in batch:
+            src = self._sources[a.dispatch]
+            staleness = version - src.version
+            self._staleness_counts[staleness] = (
+                self._staleness_counts.get(staleness, 0) + 1)
+            # the hub histogram (exported by /metrics) uses a ladder
+            # sized for small integers, not the default seconds ladder
+            obs.hists.observe("async/staleness", staleness,
+                              lo=0.5, decades=4, per_decade=3)
+            if t_now is not None:
+                if a.t_arrival:
+                    obs.hists.observe("async/queue_wait_s",
+                                      t_now - a.t_arrival)
+                if src.t_dispatch:
+                    obs.hists.observe("async/fold_latency_s",
+                                      t_now - src.t_dispatch)
+
         steps = int(sum(a.steps for a in batch))
         sigma = float(self.engine._state_sigma(es.state))
         es.state = new_state
@@ -610,13 +673,34 @@ class GenerationScheduler:
                 "consumed": len(batch),
                 "fresh": int(stats["fresh"]),
                 "folded": int(stats["folded"]),
-                "stale_discarded": int(self._discarded_this_update),
+                "stale_discarded": int(
+                    sum(self._discards_since_update.values())),
                 "max_staleness": int(stats["max_staleness"]),
                 "mean_lambda": stats["mean_lambda"],
                 "overlap_efficiency": oe,
+                # causal identity: dispatches snapshotted this window,
+                # (dispatch, count) consumed by THIS update, (dispatch,
+                # count) discarded this window — `obs trace` renders
+                # them as flow arrows (docs/observability.md)
+                "dispatches": [int(d) for d in
+                               self._dispatched_since_update],
+                "consumed_dispatches": stats["consumed_by_dispatch"],
+                "discarded_dispatches": [
+                    [int(d), int(n)] for d, n in
+                    sorted(self._discards_since_update.items())],
             },
         }
-        self._discarded_this_update = 0
+        qw50 = obs.hists.quantile("async/queue_wait_s", 0.5)
+        qw99 = obs.hists.quantile("async/queue_wait_s", 0.99)
+        if qw50 is not None and qw99 is not None:
+            record["async"]["queue_wait_s"] = {"p50": round(qw50, 6),
+                                               "p99": round(qw99, 6)}
+        if self._staleness_counts:
+            record["async"]["staleness_q"] = {
+                "p50": _count_quantile(self._staleness_counts, 0.5),
+                "p99": _count_quantile(self._staleness_counts, 0.99)}
+        self._dispatched_since_update = []
+        self._discards_since_update = {}
         obs.counters.inc("async_updates")
         if stats["folded"]:
             obs.counters.inc("results_folded", int(stats["folded"]))
@@ -645,7 +729,6 @@ class GenerationScheduler:
         return round(float(min(max(ratio, 0.0), 1.0)), 4)
 
     _n_workers = 0
-    _discarded_this_update = 0
 
     # ---------------------------------------------------------- live loop
 
@@ -662,7 +745,7 @@ class GenerationScheduler:
                       else _ThreadSource)
         src_pool = source_cls(self.engine, events)
         self._n_workers = src_pool.n_workers
-        self._discarded_this_update = 0
+        self._discards_since_update = {}
 
         version = 0
         dispatched = 0
@@ -687,10 +770,15 @@ class GenerationScheduler:
         def discard(a: Arrival, staleness) -> None:
             obs.counters.inc("stale_discarded")
             obs.event("stale_discarded", dispatch=int(a.dispatch),
-                      member=int(a.member), staleness=staleness)
+                      member=int(a.member), staleness=staleness,
+                      trace=f"d{a.dispatch}")
             self.log.discarded.append([a.dispatch, a.member])
-            self._discarded_this_update += 1
             self._discarded_total += 1
+            self._discards_since_update[a.dispatch] = (
+                self._discards_since_update.get(a.dispatch, 0) + 1)
+            if a.t_arrival:
+                obs.hists.observe("async/discard_latency_s",
+                                  time.perf_counter() - a.t_arrival)
 
         empty_dispatches = 0
         try:
@@ -703,7 +791,12 @@ class GenerationScheduler:
                 # schedule with full batches
                 remaining = (n_steps - updates_done) * self.n - len(arrived)
                 if len(inflight) < min(self.n, remaining):
-                    with obs.phase("async"):
+                    # the dispatch's trace id threads through its span,
+                    # the async_dispatch event, and every later fold /
+                    # discard event — one grep through the flight
+                    # recorder follows a dispatch end to end
+                    with obs.trace_ctx(f"d{base + dispatched}"), \
+                            obs.phase("async"):
                         with obs.phase("dispatch"):
                             src = self._snapshot(base + dispatched, version)
                             members = src_pool.dispatch(src)
@@ -734,6 +827,10 @@ class GenerationScheduler:
                         a = None
                     while a is not None:
                         inflight.pop((a.dispatch, a.member), None)
+                        # per-member eval seconds as a distribution: the
+                        # straggler tail the mean-shaped overlap metrics
+                        # fold away
+                        obs.hists.observe("async/eval_s", a.eval_s)
                         arrived.append(a)
                         try:
                             a = events.get_nowait()
@@ -832,7 +929,9 @@ class GenerationScheduler:
         version = 0
         rejected_streak = 0
         self._n_workers = 0
-        self._discarded_this_update = 0
+        self._dispatched_since_update = []
+        self._discards_since_update = {}
+        self._staleness_counts = {}
         for entry in log.updates:
             # materialize every snapshot the schedule took at <= this
             # version, in recorded order (dispatch versions are
@@ -876,6 +975,7 @@ def train_overlap(es, n_steps: int, log_fn=None, verbose: bool = True,
     rolled-back state: its result is kept as the deterministic re-run.
     """
     import concurrent.futures as cf
+    import itertools
 
     import jax
 
@@ -887,8 +987,12 @@ def train_overlap(es, n_steps: int, log_fn=None, verbose: bool = True,
     ex = cf.ThreadPoolExecutor(max_workers=1,
                                thread_name_prefix="estorch-overlap")
 
+    dispatch_seq = itertools.count(int(es.state.generation))
+
     def submit(state):
-        with obs.phase("async"):
+        # speculative dispatches carry trace ids too, so a wedged
+        # program's last recorder span names WHICH dispatch wedged
+        with obs.trace_ctx(f"d{next(dispatch_seq)}"), obs.phase("async"):
             with obs.phase("dispatch"):
                 return ex.submit(es.engine.generation_step, state)
 

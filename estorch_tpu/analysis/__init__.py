@@ -24,6 +24,9 @@ Rules (docs/analysis.md has the full rationale per rule):
 * R10 unsharded-capture       — host arrays closed over by sharded jit
 * R11 blocking-wait-in-scheduler — unbounded queue.get/thread.join/
                                 conn.recv in an event-loop hot path
+* R12 gauge-shaped-latency    — perf_counter/monotonic duration recorded
+                                via a last-write-wins gauge (tail erased;
+                                observe into a histogram instead)
 
 Nothing in this package imports jax or the analyzed modules — analysis
 is pure ``ast`` and safe to run where no accelerator exists.

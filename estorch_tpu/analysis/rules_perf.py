@@ -1,5 +1,5 @@
 """Measurement-honesty rules: R07 unfenced-device-timing, R09
-nonmonotonic-span-clock.
+nonmonotonic-span-clock, R12 gauge-shaped-latency.
 
 JAX dispatch is asynchronous: a jitted call returns a future-like array
 immediately and the device executes in the background.  So
@@ -244,5 +244,93 @@ def check_nonmonotonic_span_clock(ctx: ModuleContext):
                     "bind both ends to time.perf_counter() (spans) or "
                     "time.monotonic() (ages/deadlines); keep time.time() "
                     "only for timestamps that cross a process boundary",
+                    symbol))
+    return out
+
+
+# ---------------------------------------------------------------------
+# R12: a perf_counter/monotonic DURATION recorded through a gauge
+# ---------------------------------------------------------------------
+#
+# A gauge is last-write-wins: ``hub.gauge("predict_ms", dt)`` keeps
+# whichever batch happened to finish last, which is almost never the
+# sample the tail lives in — a 5x slowdown on 1% of requests is
+# invisible the moment the next normal batch overwrites it.  Durations
+# belong in a streaming histogram (``hub.observe`` / ``hists.observe``,
+# obs/hist.py), whose bucket counts keep every sample's contribution to
+# p99.  The rule is conservative (the R02/R03 philosophy): it only
+# flags a ``.gauge(...)`` call whose VALUE expression provably carries a
+# monotonic-clock delta — the delta taken inline, or a name bound from
+# ``time.perf_counter()/time.monotonic() - <start>`` in the same scope.
+# Gauges of genuinely last-write facts (queue depth, ratios, sums
+# re-derivable elsewhere) stay silent.
+
+_MONO_CLOCK_CALLS = {"time.perf_counter", "time.monotonic"}
+
+
+def _is_mono_clock_call(ctx: ModuleContext, node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and ctx.resolve(node.func) in _MONO_CLOCK_CALLS)
+
+
+def _is_mono_delta(ctx: ModuleContext, node: ast.AST,
+                   mono_names: set[str]) -> bool:
+    """Is this expression a monotonic-clock delta (``clock() - x`` or
+    ``now - t0`` with both sides clock-bound)?"""
+    if not (isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub)):
+        return False
+    left_clock = (_is_mono_clock_call(ctx, node.left)
+                  or (isinstance(node.left, ast.Name)
+                      and node.left.id in mono_names))
+    return left_clock
+
+
+@rule("R12", "gauge-shaped-latency", "warning",
+      "a perf_counter/monotonic duration recorded via a last-write-wins "
+      "gauge destroys the tail — observe it into a histogram instead")
+def check_gauge_shaped_latency(ctx: ModuleContext):
+    r = get_rule("R12")
+    out = []
+    for symbol, scope in iter_scopes(ctx):
+        mono_names: set[str] = set()   # t0 = time.perf_counter()
+        delta_names: set[str] = set()  # dt = time.perf_counter() - t0
+        gauges: list[ast.Call] = []
+        for node in scope_nodes(scope):
+            if isinstance(node, ast.Assign):
+                if _is_mono_clock_call(ctx, node.value):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            mono_names.add(tgt.id)
+                elif _is_mono_delta(ctx, node.value, mono_names):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            delta_names.add(tgt.id)
+            elif (isinstance(node, ast.Call)
+                  and isinstance(node.func, ast.Attribute)
+                  and node.func.attr == "gauge"
+                  and len(node.args) >= 2):
+                gauges.append(node)
+        for call in gauges:
+            value = call.args[1]
+            duration = None
+            if _is_mono_delta(ctx, value, mono_names):
+                duration = "an inline clock delta"
+            else:
+                for sub in ast.walk(value):
+                    if isinstance(sub, ast.Name) and sub.id in delta_names:
+                        duration = f"`{sub.id}` (a clock delta)"
+                        break
+                    if _is_mono_delta(ctx, sub, mono_names):
+                        duration = "an inline clock delta"
+                        break
+            if duration is not None:
+                out.append(make_finding(
+                    ctx, r, call,
+                    f"gauge value is {duration}: last-write-wins keeps "
+                    "only the final sample, so the latency tail (the p99 "
+                    "a shed or recompile ruins) is erased",
+                    "record the duration with hists.observe(name, dt) "
+                    "(obs/hist.py streaming histogram); keep gauges for "
+                    "genuinely last-write facts like queue depth",
                     symbol))
     return out

@@ -441,19 +441,27 @@ def _export_probe() -> dict:
     import urllib.request
 
     try:
-        from .obs.export.prometheus import parse_exposition, samples_by_name
+        from .obs.export.prometheus import (parse_exposition,
+                                            samples_by_name,
+                                            validate_histogram_series)
         from .obs.export.sidecar import MetricsSidecar, publish_counters
+        from .obs.hist import Histogram
 
+        probe_hist = Histogram()
+        probe_hist.observe(0.002)
         with tempfile.TemporaryDirectory() as d:
             hb_ts = _time.time()
             with open(os.path.join(d, "heartbeat.json"), "w") as f:
                 _json.dump({"ts": hb_ts, "pid": os.getpid(),
                             "phase": "doctor_probe", "generation": 1,
-                            "counters": {"env_steps": 1}}, f)
+                            "counters": {"env_steps": 1},
+                            "hists": {"probe_s": probe_hist.to_dict()}}, f)
             # published totals + a NEWER live beat: the scrape must
-            # compose both (the cross-restart monotonicity contract)
+            # compose both (the cross-restart monotonicity contract) —
+            # for the flat counters AND the histogram buckets
             publish_counters(d, {"env_steps": 2}, through_ts=hb_ts - 1.0,
-                             extra={"restart_count": 1})
+                             extra={"restart_count": 1},
+                             hists={"probe_s": probe_hist.to_dict()})
             sidecar = MetricsSidecar(d, port=0)
             sidecar.start_background()
             try:
@@ -472,6 +480,11 @@ def _export_probe() -> dict:
                 f"{vals.get('estorch_env_steps')} (want 3)")
         if vals.get("estorch_up") != 1:
             problems.append("fresh heartbeat did not read as up")
+        problems.extend(validate_histogram_series(samples))
+        if vals.get("estorch_probe_s_count") != 2:
+            problems.append(
+                f"published+live HISTOGRAM composition broke: probe_s "
+                f"count={vals.get('estorch_probe_s_count')} (want 2)")
         return {
             "ok": not problems,
             "samples": len(samples),

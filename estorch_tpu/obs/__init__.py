@@ -32,6 +32,7 @@ from . import export  # noqa: F401  (prometheus/sidecar/trace/regress)
 from .counters import Counters, NullCounters
 from .export import (MetricsSidecar, export_trace, parse_exposition,
                      render_exposition, validate_trace)
+from .hist import Histogram, Histograms, NullHistograms
 from .manifest import collect_manifest, load_manifest, write_manifest
 from .recorder import (HEARTBEAT_ENV, STALE_AFTER_S, FlightRecorder,
                        Heartbeat, describe_heartbeat, read_heartbeat)
@@ -46,6 +47,9 @@ from .trace import annotate, timed_generations, trace
 __all__ = [
     "Counters",
     "NullCounters",
+    "Histogram",
+    "Histograms",
+    "NullHistograms",
     "FlightRecorder",
     "Heartbeat",
     "HEARTBEAT_ENV",

@@ -35,9 +35,18 @@ Subcommands (docs/observability.md):
       Statistical perf gate: robust medians + a noise band learned from
       repeats.  Exit 0 pass, 1 regression.  ``--phases`` gates per-phase
       medians (two run JSONLs) so the verdict names the phase that
-      moved; mismatched platforms (cpu-fallback artifact vs TPU
-      baseline) are an error, not a verdict.  ``regress --selfcheck``
-      is the run_lint.sh gate for the gate.
+      moved; ``--tail [--quantile Q]`` gates an upper quantile (default
+      p99) per phase/endpoint with its own learned MAD band — the gate
+      for regressions medians can't see; mismatched platforms
+      (cpu-fallback artifact vs TPU baseline) are an error, not a
+      verdict.  ``regress --selfcheck`` / ``regress --tail --selfcheck``
+      are the run_lint.sh gates for the gates.
+
+  hist --selfcheck
+      Streaming-histogram math gate (obs/hist.py): exact small-N
+      quantiles, known-distribution bucket error bound, merge
+      associativity, cross-restart composition + exposition round
+      trips.
 
   serve-metrics --run-dir DIR [--port N] [--port-file PATH]
       Prometheus /metrics sidecar over a run directory (heartbeat +
@@ -132,12 +141,28 @@ def build_parser() -> argparse.ArgumentParser:
     r.add_argument("--phases", action="store_true",
                    help="gate per-phase span medians (two run JSONLs) — "
                         "the verdict names the phase that moved")
+    r.add_argument("--tail", action="store_true",
+                   help="gate an upper quantile (default p99) per "
+                        "phase/endpoint with its own learned MAD band — "
+                        "flags tail regressions medians can't see, "
+                        "naming the quantile and the group")
+    r.add_argument("--quantile", type=float, default=None, metavar="Q",
+                   help="tail quantile in [0.5, 1) (default 0.99; "
+                        "requires --tail)")
     r.add_argument("--json", action="store_true", dest="as_json",
                    help="verdict as one JSON line (default: human line "
                         "+ JSON)")
     r.add_argument("--selfcheck", action="store_true",
                    help="prove the gate flags an injected 30%% slowdown "
                         "and passes an identical run, then exit")
+
+    h = sub.add_parser("hist",
+                       help="streaming-histogram tooling (obs/hist.py)")
+    h.add_argument("--selfcheck", action="store_true",
+                   help="prove the histogram math: known-distribution "
+                        "quantile error bound, exact small-N path, merge "
+                        "associativity, cross-restart composition round "
+                        "trip, exposition round trip")
 
     m = sub.add_parser("serve-metrics",
                        help="Prometheus /metrics sidecar over a run dir")
@@ -303,6 +328,18 @@ def _cmd_regress(args) -> int:
     from .export import regress as _regress
 
     if args.selfcheck:
+        if args.tail:
+            problems = _regress.tail_selfcheck()
+            if problems:
+                for pr in problems:
+                    print(f"regress --tail selfcheck: {pr}",
+                          file=sys.stderr)
+                return 1
+            print("obs regress --tail selfcheck: OK (a median-clean "
+                  "~2%-of-requests-5x-slower pair passes the median gate "
+                  "but is flagged at p99, naming the quantile and the "
+                  "endpoint/phase)")
+            return 0
         problems = _regress.selfcheck()
         if problems:
             for pr in problems:
@@ -311,6 +348,10 @@ def _cmd_regress(args) -> int:
         print("obs regress selfcheck: OK (flags a 30% injected slowdown, "
               "passes an identical run)")
         return 0
+    if args.quantile is not None and not args.tail:
+        print("regress: --quantile only applies to the --tail gate",
+              file=sys.stderr)
+        return 3
     if not args.current or not args.baseline:
         print("regress needs <current> --baseline PATH (or --selfcheck)",
               file=sys.stderr)
@@ -318,6 +359,36 @@ def _cmd_regress(args) -> int:
     kw = {}
     if args.min_band_pct is not None:
         kw["min_band_pct"] = args.min_band_pct
+    if args.tail:
+        if args.phases or args.label is not None:
+            print("regress: --tail is its own gate — it cannot combine "
+                  "with --phases or --label", file=sys.stderr)
+            return 3
+        if args.quantile is not None:
+            kw["quantile"] = args.quantile
+        try:
+            verdict = _regress.compare_tail_files(args.current,
+                                                  args.baseline, **kw)
+        except (OSError, ValueError) as e:
+            print(f"regress: {e}", file=sys.stderr)
+            return 1
+        if not args.as_json:
+            qn = verdict["quantile"]
+            if verdict["regressed_groups"]:
+                for name in verdict["regressed_groups"]:
+                    row = verdict["groups"][name]
+                    print(f"regress: TAIL REGRESSION — {qn} of {name!r} "
+                          f"{row['current_q_s']}s vs baseline "
+                          f"{row['baseline_q_s']}s (slowdown "
+                          f"{row['slowdown_pct']}%, band "
+                          f"{row['band_pct']}%, median "
+                          f"{row['median_verdict']})")
+            else:
+                print(f"regress: pass — {qn} of "
+                      f"{len(verdict['groups'])} group(s) within their "
+                      "learned tail bands")
+        print(json.dumps(verdict, default=float))
+        return 0 if verdict["verdict"] == "pass" else 1
     if args.phases:
         if args.label is not None:
             # phase records carry no labels — silently ignoring the
@@ -364,6 +435,25 @@ def _cmd_regress(args) -> int:
     return 0 if verdict["verdict"] == "pass" else 1
 
 
+def _cmd_hist(args) -> int:
+    from . import hist as _hist
+    from .export.prometheus import parse_exposition, render_exposition
+
+    if not args.selfcheck:
+        print("hist currently has only --selfcheck", file=sys.stderr)
+        return 3
+    problems = _hist.selfcheck(render=render_exposition,
+                               parse=parse_exposition)
+    if problems:
+        for pr in problems:
+            print(f"hist selfcheck: {pr}", file=sys.stderr)
+        return 1
+    print("obs hist selfcheck: OK (exact small-N quantiles, "
+          "known-distribution error bound, merge associativity, "
+          "cross-restart composition + exposition round trips)")
+    return 0
+
+
 def _cmd_serve_metrics(args) -> int:
     from .export import sidecar as _sidecar
 
@@ -386,6 +476,8 @@ def main(argv: list[str] | None = None) -> int:
         return _cmd_profile(args)
     if args.cmd == "regress":
         return _cmd_regress(args)
+    if args.cmd == "hist":
+        return _cmd_hist(args)
     if args.cmd == "serve-metrics":
         return _cmd_serve_metrics(args)
     build_parser().print_help()

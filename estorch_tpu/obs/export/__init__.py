@@ -23,12 +23,14 @@ are additionally importable without the package (bench.py and the
 sidecar's file-run mode load them by path).
 """
 
-from .prometheus import (GAUGE_NAMES, is_gauge, metric_name,
-                         parse_exposition, render_exposition,
-                         samples_by_name)
-from .regress import compare, compare_files, load_measurement
-from .sidecar import (COUNTERS_FILENAME, MetricsSidecar, compose_totals,
-                      publish_counters, read_published_counters)
+from .prometheus import (GAUGE_NAMES, histogram_series, is_gauge,
+                         metric_name, parse_exposition, render_exposition,
+                         samples_by_name, validate_histogram_series)
+from .regress import (compare, compare_files, compare_tail,
+                      compare_tail_files, load_measurement)
+from .sidecar import (COUNTERS_FILENAME, MetricsSidecar, compose_hists,
+                      compose_totals, publish_counters,
+                      read_published_counters)
 from .traceevent import export_trace, validate_trace, write_trace
 
 __all__ = [
@@ -38,12 +40,17 @@ __all__ = [
     "parse_exposition",
     "render_exposition",
     "samples_by_name",
+    "histogram_series",
+    "validate_histogram_series",
     "compare",
     "compare_files",
+    "compare_tail",
+    "compare_tail_files",
     "load_measurement",
     "COUNTERS_FILENAME",
     "MetricsSidecar",
     "compose_totals",
+    "compose_hists",
     "publish_counters",
     "read_published_counters",
     "export_trace",

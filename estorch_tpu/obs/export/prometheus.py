@@ -107,7 +107,8 @@ def render_exposition(counters: dict | None,
                       *,
                       stale_after_s: float = DEFAULT_STALE_AFTER_S,
                       extra_gauges: dict | None = None,
-                      up: bool | None = None) -> str:
+                      up: bool | None = None,
+                      histograms: dict | None = None) -> str:
     """One scrape body from a counter snapshot + optional heartbeat facts.
 
     ``heartbeat`` is the :func:`~estorch_tpu.obs.recorder.read_heartbeat`
@@ -116,6 +117,11 @@ def render_exposition(counters: dict | None,
     so it is up regardless of whether a heartbeat file is configured).
     ``extra_gauges``: point-in-time facts that live outside the registry
     (queue depth, uptime) — name -> value, rendered as gauges.
+    ``histograms``: name → export shape (``Histogram.to_export()``:
+    cumulative ``(le, count)`` bucket pairs ending at +Inf, plus sum and
+    count) — rendered as true Prometheus ``histogram`` series
+    (``_bucket{le=...}``/``_sum``/``_count``), the type whose tails a
+    scraper can actually quantile.
     """
     lines: list[str] = []
 
@@ -149,6 +155,23 @@ def render_exposition(counters: dict | None,
         emit(metric_name(name), "gauge",
              f"estorch_tpu point-in-time gauge {name!r}",
              [(None, float(extras[name]))])
+
+    for name in sorted(histograms or {}):
+        series = histograms[name]
+        buckets = series.get("buckets") or []
+        if not buckets:
+            continue
+        base = metric_name(name)
+        lines.append(f"# HELP {base} estorch_tpu obs streaming "
+                     f"histogram {name!r}")
+        lines.append(f"# TYPE {base} histogram")
+        for le, cum in buckets:
+            lines.append(_sample(f"{base}_bucket", {"le": _fmt(le)},
+                                 float(cum)))
+        lines.append(_sample(f"{base}_sum", None,
+                             float(series.get("sum", 0.0))))
+        lines.append(_sample(f"{base}_count", None,
+                             float(series.get("count", 0))))
 
     fresh = False
     if heartbeat is not None:
@@ -230,3 +253,58 @@ def samples_by_name(samples: list[tuple[str, dict, float]]) -> dict:
     bare name too; last one wins) — the form the tests and monotonicity
     checks want."""
     return {name: value for name, _labels, value in samples}
+
+
+def histogram_series(samples: list[tuple[str, dict, float]]) -> dict:
+    """Histogram view of parsed samples: ``base name -> {"buckets":
+    [(le, cumulative)], "sum", "count"}`` for every base that exposes
+    ``_bucket{le=...}`` samples (the inverse of the ``histograms=``
+    encoding, so composition checks can read back what they scraped)."""
+    out: dict[str, dict] = {}
+    for name, labels, value in samples:
+        if name.endswith("_bucket") and "le" in labels:
+            base = name[: -len("_bucket")]
+            le_raw = labels["le"]
+            le = math.inf if le_raw == "+Inf" else float(le_raw)
+            out.setdefault(base, {"buckets": [], "sum": None,
+                                  "count": None})["buckets"].append(
+                (le, value))
+    for name, labels, value in samples:
+        for suffix, key in (("_sum", "sum"), ("_count", "count")):
+            if name.endswith(suffix):
+                base = name[: -len(suffix)]
+                if base in out and not labels:
+                    out[base][key] = value
+    return out
+
+
+def validate_histogram_series(samples: list[tuple[str, dict, float]]
+                              ) -> list[str]:
+    """Structural problems in the histogram series of a parsed scrape
+    ([] when clean): ``le`` edges strictly increasing, cumulative counts
+    non-decreasing, a ``+Inf`` bucket present and equal to ``_count``,
+    ``_sum``/``_count`` samples present.  The validating half of the
+    histogram encoding — used by the doctor's export probe and
+    ``obs hist --selfcheck`` so "the tail exports" is checked by code
+    that did not write it."""
+    problems: list[str] = []
+    for base, series in histogram_series(samples).items():
+        buckets = series["buckets"]
+        les = [le for le, _ in buckets]
+        if les != sorted(les) or len(set(les)) != len(les):
+            problems.append(f"{base}: le edges not strictly increasing: "
+                            f"{les}")
+        cums = [c for _, c in buckets]
+        if any(b < a for a, b in zip(cums, cums[1:])):
+            problems.append(f"{base}: cumulative bucket counts decrease: "
+                            f"{cums}")
+        if not les or not math.isinf(les[-1]):
+            problems.append(f"{base}: no +Inf bucket")
+        elif series["count"] is None:
+            problems.append(f"{base}: missing _count sample")
+        elif cums[-1] != series["count"]:
+            problems.append(f"{base}: +Inf bucket {cums[-1]} != _count "
+                            f"{series['count']}")
+        if series["sum"] is None:
+            problems.append(f"{base}: missing _sum sample")
+    return problems

@@ -348,6 +348,291 @@ def compare_phase_files(current_path: str, baseline_path: str,
 
 
 # ---------------------------------------------------------------------
+# tail gate: p99-vs-p99 with its own learned MAD band
+# ---------------------------------------------------------------------
+#
+# Medians can't see the 1% of requests a shed or a recompile ruins: a
+# 5× slowdown on 1% of samples moves p50 by ~nothing and p99 by ~5×.
+# ``obs regress --tail`` gates a chosen upper quantile per GROUP (phase
+# of a run JSONL, endpoint of a latency JSONL) against the baseline's
+# same quantile, with a noise band learned from the quantile estimator
+# itself: each side is split into k deterministic interleaved
+# subsamples, the quantile computed per subsample, and the band is the
+# scaled MAD of those estimates — a tail quantile is far noisier than a
+# median, and gating it against the MEDIAN's band would cry wolf.
+# Verdicts NAME the quantile and the group ("p99 of 'eval'").
+
+TAIL_QUANTILE = 0.99
+TAIL_FOLDS = 5
+
+
+def _quantile(xs: list[float], q: float) -> float:
+    """Nearest-rank quantile (the loadgen/hist convention)."""
+    s = sorted(xs)
+    if not s:
+        return float("nan")
+    k = max(1, math.ceil(q * len(s)))
+    return s[k - 1]
+
+
+def _tail_band_pct(xs: list[float], q: float,
+                   folds: int = TAIL_FOLDS) -> float:
+    """Relative noise of the ``q``-quantile ESTIMATOR on this sample:
+    scaled MAD of the quantile across ``folds`` deterministic
+    interleaved subsamples, as a percentage of their median.  0 when
+    there are too few samples to subsample (the floor then rules)."""
+    if len(xs) < folds * 4:
+        return 0.0
+    qs = [_quantile(xs[i::folds], q) for i in range(folds)]
+    med = _median(qs)
+    if not med or not math.isfinite(med):
+        return 0.0
+    mad = _median([abs(x - med) for x in qs])
+    return 100.0 * 1.4826 * mad / abs(med)
+
+
+def extract_tail_groups(rows: list[dict]) -> dict[str, list[float]]:
+    """Per-group duration samples for the tail gate.
+
+    Two row shapes, combinable: latency rows (``{"latency_s": x,
+    "endpoint": "/predict"}`` — the loadgen ``--latencies-out`` format)
+    group by endpoint; run-JSONL generation records contribute their
+    top-level phase seconds (replay-deduped, like the phase gate) plus a
+    ``wall_time_s`` group."""
+    groups: dict[str, list[float]] = {}
+    for row in rows:
+        if not isinstance(row, dict):
+            continue
+        v = row.get("latency_s")
+        if (isinstance(v, (int, float)) and not isinstance(v, bool)
+                and math.isfinite(v)):
+            name = str(row.get("endpoint") or "latency")
+            groups.setdefault(name, []).append(float(v))
+    for name, samples in extract_phase_samples(rows).items():
+        groups.setdefault(name, []).extend(samples)
+    # wall_time_s follows the same replay-dedup rule as the phase
+    # samples above: a supervisor-replayed generation appears twice in
+    # the JSONL and must not be double-weighted in the quantile
+    gen_last: dict[int, float] = {}
+    order: list[int] = []
+    anon: list[float] = []
+    for r in rows:
+        if not isinstance(r, dict):
+            continue
+        w = r.get("wall_time_s")
+        if (not isinstance(w, (int, float)) or isinstance(w, bool)
+                or not math.isfinite(w)):
+            continue
+        g = r.get("generation")
+        if isinstance(g, int):
+            if g not in gen_last:
+                order.append(g)
+            gen_last[g] = float(w)
+        else:
+            anon.append(float(w))
+    walls = [gen_last[g] for g in order] + anon
+    if walls:
+        groups.setdefault("wall_time_s", []).extend(walls)
+    return groups
+
+
+def compare_tail(current: list[dict], baseline: list[dict],
+                 quantile: float = TAIL_QUANTILE,
+                 min_band_pct: float = DEFAULT_MIN_BAND_PCT) -> dict:
+    """Tail verdict over two measurements' rows: each shared group's
+    ``quantile`` gated by that group's own learned quantile-estimator
+    MAD band (durations: ABOVE the band = regress).  Each group also
+    reports its p50 verdict under the median machinery, so "median
+    passed, p99 regressed" is one artifact."""
+    if not 0.5 <= quantile < 1.0:
+        raise ValueError(f"tail quantile must be in [0.5, 1), got "
+                         f"{quantile}")
+    cur_groups = extract_tail_groups(current)
+    base_groups = extract_tail_groups(baseline)
+    shared = sorted(set(cur_groups) & set(base_groups))
+    if not shared:
+        raise ValueError(
+            "no shared tail groups between the two measurements (expected "
+            "{'latency_s','endpoint'} rows or run-JSONL records with "
+            "'phases'/'wall_time_s')")
+    qname = f"p{quantile * 100:g}"
+    groups: dict[str, dict] = {}
+    regressed: list[str] = []
+    for name in shared:
+        cur, base = cur_groups[name], base_groups[name]
+        cur_q, base_q = _quantile(cur, quantile), _quantile(base, quantile)
+        band = max(float(min_band_pct),
+                   _tail_band_pct(cur, quantile),
+                   _tail_band_pct(base, quantile))
+        slowdown = ((cur_q - base_q) / base_q * 100.0) if base_q else 0.0
+        verdict = "regress" if slowdown > band else "pass"
+        if verdict == "regress":
+            regressed.append(name)
+        cur_med, base_med = _median(cur), _median(base)
+        med_band = max(float(min_band_pct),
+                       _noise_band_pct(cur), _noise_band_pct(base))
+        med_slow = ((cur_med - base_med) / base_med * 100.0) if base_med \
+            else 0.0
+        groups[name] = {
+            "verdict": verdict,
+            "quantile": qname,
+            "current_q_s": round(cur_q, 6),
+            "baseline_q_s": round(base_q, 6),
+            "slowdown_pct": round(slowdown, 2),
+            "band_pct": round(band, 2),
+            "improved": slowdown < -band,
+            "median_verdict": ("regress" if med_slow > med_band
+                               else "pass"),
+            "current_median_s": round(cur_med, 6),
+            "baseline_median_s": round(base_med, 6),
+            "median_slowdown_pct": round(med_slow, 2),
+            "n_current": len(cur),
+            "n_baseline": len(base),
+        }
+    return {
+        "schema": REGRESS_SCHEMA,
+        "verdict": "regress" if regressed else "pass",
+        "metric": "tail_seconds",
+        "quantile": qname,
+        "groups": groups,
+        "regressed_groups": regressed,
+    }
+
+
+def compare_tail_files(current_path: str, baseline_path: str,
+                       quantile: float = TAIL_QUANTILE,
+                       min_band_pct: float = DEFAULT_MIN_BAND_PCT) -> dict:
+    cur_rows = load_rows(current_path)
+    base_rows = load_rows(baseline_path)
+    # same platform guard as the aggregate gate: a cpu-fallback artifact
+    # "tail-regressing" against a TPU baseline is a platform mismatch,
+    # not a verdict
+    ensure_same_platform(measurement_platform(cur_rows),
+                         measurement_platform(base_rows),
+                         cur_what=f"current {current_path}",
+                         base_what=f"baseline {baseline_path}")
+    try:
+        return compare_tail(cur_rows, base_rows,
+                            quantile=quantile, min_band_pct=min_band_pct)
+    except ValueError as e:
+        raise ValueError(f"{current_path} vs {baseline_path}: {e}") from e
+
+
+def tail_selfcheck() -> list[str]:
+    """The run_lint.sh gate for the tail gate ([] = healthy): a
+    median-clean / p99-regressed pair — 2% of requests slowed 5×, the
+    chaos-shed signature — must PASS every group's median verdict but be
+    FLAGGED by the tail verdict, naming the quantile and the group; an
+    identical-distribution rerun must pass; the latency-row file round
+    trip must agree with the in-memory path."""
+    import os
+    import random
+    import tempfile
+
+    problems: list[str] = []
+
+    def lat_rows(seed: int, n: int = 2000, slow_every: int = 0
+                 ) -> list[dict]:
+        rng = random.Random(seed)
+        rows = []
+        for i in range(n):
+            v = 0.010 * (1.0 + rng.uniform(-0.02, 0.02))
+            if slow_every and i % slow_every == 0:
+                v *= 5.0  # the 5x chaos slowdown on ~2% of requests
+            rows.append({"endpoint": "/predict", "latency_s": v})
+        return rows
+
+    base = lat_rows(0)
+    clean = compare_tail(lat_rows(1), base)
+    if clean["verdict"] != "pass":
+        problems.append(f"same-distribution rerun flagged: {clean}")
+    tainted = compare_tail(lat_rows(2, slow_every=50), base)
+    g = tainted["groups"].get("/predict", {})
+    if tainted["verdict"] != "regress" or "/predict" not in \
+            tainted["regressed_groups"]:
+        problems.append(f"5x-on-2% tail regression not flagged: {tainted}")
+    if tainted.get("quantile") != "p99" or g.get("quantile") != "p99":
+        problems.append(f"verdict does not NAME the quantile: {tainted}")
+    if g.get("median_verdict") != "pass":
+        problems.append(
+            f"median verdict should stay clean on a tail-only regression "
+            f"(the whole point): {g}")
+
+    # run-JSONL form: 1-in-50 generations' eval phase slowed 5x — the
+    # median phase gate passes, the tail gate names 'eval'
+    def gen_rows(seed: int, slow_every: int = 0) -> list[dict]:
+        rng = random.Random(seed)
+        rows = []
+        for gdx in range(100):
+            ev = 0.100 * (1.0 + rng.uniform(-0.02, 0.02))
+            if slow_every and gdx % slow_every == 0:
+                ev *= 5.0
+            up = 0.020 * (1.0 + rng.uniform(-0.02, 0.02))
+            rows.append({"generation": gdx, "wall_time_s": ev + up,
+                         "env_steps_per_sec": 1000.0,
+                         "phases": {"eval": ev, "update": up}})
+        return rows
+
+    base_g = gen_rows(3)
+    cur_g = gen_rows(4, slow_every=50)
+    med = compare_phases(cur_g, base_g)
+    if med["verdict"] != "pass":
+        problems.append(f"median phase gate flagged a tail-only "
+                        f"regression: {med}")
+    tail = compare_tail(cur_g, base_g)
+    if "eval" not in tail["regressed_groups"]:
+        problems.append(f"tail gate missed the eval-phase p99: {tail}")
+    if "update" in tail["regressed_groups"]:
+        problems.append(f"tail gate flagged the untouched update phase: "
+                        f"{tail}")
+
+    # supervisor-replayed generations must be deduped in EVERY group,
+    # wall_time_s included (double-weighted duplicates skew the p99)
+    replayed = base_g + [dict(base_g[0])]
+    gg = extract_tail_groups(replayed)
+    if len(gg["wall_time_s"]) != 100 or len(gg["eval"]) != 100:
+        problems.append(
+            f"replayed generation double-weighted in tail groups: "
+            f"wall={len(gg['wall_time_s'])} eval={len(gg['eval'])}")
+
+    # file round trip (the CLI path)
+    with tempfile.TemporaryDirectory() as d:
+        cur_path = os.path.join(d, "cur.jsonl")
+        base_path = os.path.join(d, "base.jsonl")
+        for path, rows in ((cur_path, lat_rows(2, slow_every=50)),
+                           (base_path, base)):
+            with open(path, "w") as f:
+                for row in rows:
+                    f.write(json.dumps(row) + "\n")
+        v = compare_tail_files(cur_path, base_path)
+        if (v["verdict"] != "regress"
+                or v["regressed_groups"] != ["/predict"]):
+            problems.append(f"file round trip disagreed: {v}")
+        # cross-platform artifacts are an ERROR, never a tail verdict
+        # (the same guard the aggregate gate applies)
+        cpu_path = os.path.join(d, "cpu.jsonl")
+        with open(cpu_path, "w") as f:
+            f.write(json.dumps({"platform": "cpu"}) + "\n")
+            for row in lat_rows(8):
+                f.write(json.dumps(row) + "\n")
+        tpu_path = os.path.join(d, "tpu.jsonl")
+        with open(tpu_path, "w") as f:
+            f.write(json.dumps({"platform": "tpu"}) + "\n")
+            for row in base:
+                f.write(json.dumps(row) + "\n")
+        try:
+            v = compare_tail_files(cpu_path, tpu_path)
+            problems.append(f"cpu-vs-tpu tail comparison produced a "
+                            f"verdict instead of a platform-mismatch "
+                            f"error: {v}")
+        except ValueError as e:
+            if "platform mismatch" not in str(e):
+                problems.append(f"cpu-vs-tpu tail error lacks the "
+                                f"platform-mismatch diagnosis: {e}")
+    return problems
+
+
+# ---------------------------------------------------------------------
 # selfcheck: the run_lint.sh gate for the gate
 # ---------------------------------------------------------------------
 

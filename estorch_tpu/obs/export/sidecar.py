@@ -41,6 +41,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 if __package__:
+    from ..hist import export_snapshots, merge_snapshots
     from ..recorder import STALE_AFTER_S, read_heartbeat
     from .prometheus import render_exposition
 else:  # file-run (wedged-jax host): load siblings without any package init
@@ -56,22 +57,29 @@ else:  # file-run (wedged-jax host): load siblings without any package init
 
     _recorder = _load("_estorch_obs_recorder", os.pardir, "recorder.py")
     _prom = _load("_estorch_obs_prometheus", "prometheus.py")
+    _hist = _load("_estorch_obs_hist", os.pardir, "hist.py")
     STALE_AFTER_S = _recorder.STALE_AFTER_S
     read_heartbeat = _recorder.read_heartbeat
     render_exposition = _prom.render_exposition
+    merge_snapshots = _hist.merge_snapshots
+    export_snapshots = _hist.export_snapshots
 
 COUNTERS_FILENAME = "counters.json"
 COUNTERS_SCHEMA = 1
 
 
 def publish_counters(run_dir: str, counters: dict, through_ts: float,
-                     extra: dict | None = None) -> str:
+                     extra: dict | None = None,
+                     hists: dict | None = None) -> str:
     """Atomically publish cross-restart counter totals into ``run_dir``.
 
     ``through_ts``: the heartbeat timestamp these totals already include
     — the sidecar only adds a live heartbeat's counters on top when the
     beat is newer than this.  Same tmp+rename contract as the heartbeat,
-    so a scrape can never read a half-written snapshot.
+    so a scrape can never read a half-written snapshot.  ``hists``:
+    cross-restart histogram totals (``Histogram.to_dict`` snapshots per
+    name, bucket-wise summed by the supervisor) riding the same file so
+    a dead child's latency DISTRIBUTION survives it, not just its sums.
     """
     path = os.path.join(os.path.abspath(run_dir), COUNTERS_FILENAME)
     payload = {
@@ -81,6 +89,9 @@ def publish_counters(run_dir: str, counters: dict, through_ts: float,
                      if isinstance(v, (int, float))
                      and not isinstance(v, bool)},
     }
+    if hists:
+        payload["hists"] = {k: v for k, v in hists.items()
+                            if isinstance(v, dict)}
     if extra:
         payload.update(extra)
     tmp = path + ".tmp"
@@ -122,6 +133,25 @@ def compose_totals(published: dict | None, heartbeat: dict | None) -> dict:
     return totals
 
 
+def compose_hists(published: dict | None, heartbeat: dict | None) -> dict:
+    """Published histogram totals + the live child's snapshots, under
+    the same newer-than-``through_ts`` rule as :func:`compose_totals` —
+    bucket ladders add exactly, so scraped tail quantiles stay truthful
+    across restarts without double counting a buried child's beat."""
+    total: dict = {}
+    through_ts = 0.0
+    if published is not None:
+        through_ts = float(published.get("through_ts", 0.0))
+        if isinstance(published.get("hists"), dict):
+            total = published["hists"]
+    live = None
+    if (heartbeat is not None
+            and float(heartbeat.get("ts", 0.0)) > through_ts
+            and isinstance(heartbeat.get("hists"), dict)):
+        live = heartbeat["hists"]
+    return merge_snapshots(total, live)
+
+
 class MetricsSidecar:
     """Loopback HTTP server exposing one run directory as /metrics."""
 
@@ -144,6 +174,7 @@ class MetricsSidecar:
         hb = read_heartbeat(self.heartbeat_path)
         published = read_published_counters(self.run_dir)
         totals = compose_totals(published, hb)
+        hists = compose_hists(published, hb)
         extra = {}
         if published is not None and "restart_count" in published:
             extra["supervisor_restarts"] = published["restart_count"]
@@ -154,7 +185,8 @@ class MetricsSidecar:
             extra["run_completed"] = 1.0 if published["completed"] else 0.0
         return render_exposition(totals, hb,
                                  stale_after_s=self.stale_after_s,
-                                 extra_gauges=extra)
+                                 extra_gauges=extra,
+                                 histograms=export_snapshots(hists) or None)
 
     def health(self) -> tuple[int, dict]:
         hb = read_heartbeat(self.heartbeat_path)

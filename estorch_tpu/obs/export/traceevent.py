@@ -30,6 +30,14 @@ at the carrying generation's start — compile seconds and XLA cost facts
 in the args, so "why is this generation wide" and "what did that
 program cost to build" are answered on the same timeline.
 
+Async runs get a causal ``async`` lane (docs/observability.md "Tails &
+traces"): each record's ``async`` block names the dispatches it
+snapshotted and the ``[dispatch, members]`` pairs it folded or
+discarded, rendered as Perfetto FLOW ARROWS — a flow starts at the
+dispatch instant, steps through each update that consumed part of it,
+and finishes at the last fold/discard, so a stale dispatch links
+visually to the exact update that folded it.
+
 Optional extra lanes: ``--events ring.jsonl`` (a flight-recorder
 ``dump_jsonl``) and the run dir's heartbeat render as instant events on
 a separate wall-clock lane (rebased to 0; the synthesized lanes and the
@@ -45,7 +53,8 @@ from __future__ import annotations
 
 import json
 
-TRACE_PHASES = {"X", "B", "E", "i", "I", "C", "M"}
+TRACE_PHASES = {"X", "B", "E", "i", "I", "C", "M", "s", "t", "f"}
+_FLOW_PHASES = {"s", "t", "f"}  # flow events: start / step / finish
 _WALL_PID = 0  # the wall-clock lane (flight recorder + heartbeat markers)
 
 
@@ -81,6 +90,17 @@ def _segment_bounds(records: list[dict], manifest: dict | None
     return sorted(set(bounds)), restarts
 
 
+def _async_pairs(block: dict, key: str) -> list[tuple[int, int]]:
+    """Well-formed ``(dispatch, count)`` pairs of one async-block list
+    (malformed entries skipped — post-mortem inputs degrade, not crash)."""
+    out = []
+    for pair in block.get(key) or []:
+        if (isinstance(pair, (list, tuple)) and len(pair) == 2
+                and isinstance(pair[0], int) and isinstance(pair[1], int)):
+            out.append((pair[0], pair[1]))
+    return out
+
+
 def export_trace(records: list[dict],
                  manifest: dict | None = None,
                  events: list[dict] | None = None,
@@ -88,6 +108,18 @@ def export_trace(records: list[dict],
     """Build the trace-event dict (see module docstring)."""
     bounds, restarts = _segment_bounds(records, manifest)
     trace_events: list[dict] = []
+    # async causality pre-scan: the LAST record touching a dispatch
+    # (fold or discard) carries the flow FINISH; earlier touches are
+    # flow steps — one arrow chain per dispatch id
+    has_async = any(isinstance(r.get("async"), dict) for r in records)
+    last_touch: dict[int, int] = {}
+    for i, rec in enumerate(records):
+        a = rec.get("async")
+        if isinstance(a, dict):
+            for d, _n in (_async_pairs(a, "consumed_dispatches")
+                          + _async_pairs(a, "discarded_dispatches")):
+                last_touch[d] = i
+    flow_started: set[int] = set()
 
     def seg_pid(seg: int) -> int:
         if seg < len(restarts):
@@ -111,8 +143,10 @@ def export_trace(records: list[dict],
         trace_events.append({"ph": "M", "name": "process_name",
                              "pid": pid, "tid": 0,
                              "args": {"name": name}})
-        for tid, tname in ((1, "generations"), (2, "phases"),
-                           (3, "compiles")):
+        lanes = [(1, "generations"), (2, "phases"), (3, "compiles")]
+        if has_async:
+            lanes.append((4, "async (dispatch→fold flows)"))
+        for tid, tname in lanes:
             trace_events.append({"ph": "M", "name": "thread_name",
                                  "pid": pid, "tid": tid,
                                  "args": {"name": tname}})
@@ -187,6 +221,51 @@ def export_trace(records: list[dict],
                     "ts": _us(cursor), "pid": pid, "tid": 3,
                     "args": {k: v for k, v in e.items() if k != "program"},
                 })
+        # ---- async causal lane: flow arrows dispatch → fold/discard ----
+        a = rec.get("async")
+        if isinstance(a, dict):
+            t_end = cursor + wall
+
+            def flow(ph: str, d: int, ts: float) -> dict:
+                # one arrow chain per dispatch: Chrome binds flow events
+                # by identical (cat, id, name), so the name is the bare
+                # dispatch id for every s/t/f of that chain
+                ev = {"ph": ph, "id": d, "name": f"d{d}",
+                      "cat": "async-flow", "ts": _us(ts),
+                      "pid": pid, "tid": 4}
+                if ph == "f":
+                    ev["bp"] = "e"
+                return ev
+
+            for d in a.get("dispatches") or []:
+                if not isinstance(d, int) or isinstance(d, bool):
+                    continue
+                trace_events.append({
+                    "ph": "i", "s": "t", "name": f"dispatch d{d}",
+                    "cat": "async", "ts": _us(cursor), "pid": pid,
+                    "tid": 4, "args": {"dispatch": d},
+                })
+                trace_events.append(flow("s", d, cursor))
+                flow_started.add(d)
+            for verb, key in (("fold", "consumed_dispatches"),
+                              ("discard", "discarded_dispatches")):
+                for d, n in _async_pairs(a, key):
+                    if d not in flow_started:
+                        # dispatched before this log window: a degenerate
+                        # (same-record) arrow still names the causality
+                        trace_events.append(flow("s", d, cursor))
+                        flow_started.add(d)
+                    trace_events.append(flow(
+                        "f" if last_touch.get(d) == i else "t", d, t_end))
+                    trace_events.append({
+                        "ph": "i", "s": "t",
+                        "name": f"{verb} d{d}→u{rec.get('generation', i)}",
+                        "cat": "async", "ts": _us(t_end), "pid": pid,
+                        "tid": 4,
+                        "args": {"dispatch": d, "members": n,
+                                 "update": rec.get("generation", i),
+                                 "what": verb},
+                    })
         cursor += wall
 
     # ---- wall-clock lane: flight-recorder events + heartbeat ----------
@@ -278,6 +357,13 @@ def validate_trace(trace) -> list[str]:
                 problems.append(f"{where} has bad dur {dur!r}")
         if ph == "i" and e.get("s") not in (None, "t", "p", "g"):
             problems.append(f"{where} has bad instant scope {e.get('s')!r}")
+        if ph in _FLOW_PHASES:
+            fid = e.get("id")
+            if not isinstance(fid, int) or isinstance(fid, bool):
+                problems.append(f"{where} flow event has bad id {fid!r}")
+            if ph == "f" and e.get("bp") not in (None, "e"):
+                problems.append(f"{where} flow finish has bad bp "
+                                f"{e.get('bp')!r}")
         if "args" in e and not isinstance(e["args"], dict):
             problems.append(f"{where} args is not an object")
     return problems

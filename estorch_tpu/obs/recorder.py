@@ -103,7 +103,8 @@ class Heartbeat:
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
 
     def beat(self, phase: str, generation: int,
-             counters: dict | None = None) -> None:
+             counters: dict | None = None,
+             hists: dict | None = None) -> None:
         payload = {
             "ts": time.time(),
             "pid": os.getpid(),
@@ -112,6 +113,11 @@ class Heartbeat:
         }
         if counters:
             payload["counters"] = counters
+        if hists:
+            # histogram snapshots (obs/hist.py to_dict shape) ride the
+            # beat so the supervisor can fold a dead child's latency
+            # DISTRIBUTIONS into counters.json, not just its sums
+            payload["hists"] = hists
         tmp = self.path + ".tmp"
         with self._lock:
             with open(tmp, "w") as f:

@@ -31,6 +31,7 @@ import threading
 import time
 
 from .counters import Counters, NullCounters
+from .hist import Histograms, NullHistograms
 from .profile.ledger import CompileLedger, ledger_counters
 from .recorder import HEARTBEAT_ENV, FlightRecorder, Heartbeat
 
@@ -57,6 +58,10 @@ class Telemetry:
         # unconditionally, and the shared NULL_TELEMETRY default must
         # never aggregate state across unrelated engines (see NullCounters)
         self.counters = Counters() if self.enabled else NullCounters()
+        # streaming histograms (obs/hist.py): the distribution-shaped
+        # facts — queue waits, per-phase durations, staleness — that
+        # counters/gauges erase; same inert-when-disabled contract
+        self.hists = Histograms() if self.enabled else NullHistograms()
         self.recorder = FlightRecorder(recorder_capacity)
         self.heartbeat = Heartbeat(heartbeat_path) if heartbeat_path else None
         self.generation = 0
@@ -113,7 +118,8 @@ class Telemetry:
             # beat on ENTRY: a wedge inside this phase leaves its name —
             # not the previous phase's — as the last-known state
             self.heartbeat.beat(full, self.generation,
-                                self.counters.snapshot())
+                                self.counters.snapshot(),
+                                hists=self.hists.snapshot(compact=True))
         t0 = time.perf_counter()
         try:
             yield
@@ -124,8 +130,38 @@ class Telemetry:
             stack.pop()
             with self._acc_lock:
                 self._acc[full] = self._acc.get(full, 0.0) + dt
-            self.recorder.add("span", full, dur_s=dt,
-                              generation=self.generation)
+            # per-phase duration DISTRIBUTION, not just the sum: the
+            # accumulator's per-generation total is what records carry,
+            # the histogram is what `obs regress --tail` gates on
+            self.hists.observe("phase/" + full, dt)
+            trace = getattr(self._tls, "trace", None)
+            if trace is not None:
+                self.recorder.add("span", full, dur_s=dt,
+                                  generation=self.generation, trace=trace)
+            else:
+                self.recorder.add("span", full, dur_s=dt,
+                                  generation=self.generation)
+
+    # ------------------------------------------------------------- traces
+
+    @contextlib.contextmanager
+    def trace_ctx(self, trace_id: str):
+        """Causal identity for spans/events: everything recorded inside
+        this context carries ``trace=trace_id`` into the flight recorder
+        (serve request ids, async dispatch ids — docs/observability.md
+        "Tails & traces").  Thread-local, like span nesting."""
+        prev = getattr(self._tls, "trace", None)
+        self._tls.trace = trace_id
+        try:
+            yield
+        finally:
+            self._tls.trace = prev
+
+    def observe(self, name: str, value: float, n: int = 1,
+                **ladder) -> None:
+        """Record ``n`` observations into the named streaming histogram
+        (obs/hist.py; ladder kwargs apply on first observe only)."""
+        self.hists.observe(name, value, n, **ladder)
 
     def take_phases(self) -> dict[str, float]:
         """Flush this generation's span accumulator (merged into the
@@ -140,7 +176,8 @@ class Telemetry:
         self.counters.sample_peak_rss()
         if self.heartbeat is not None:
             self.heartbeat.beat("between_generations", self.generation,
-                                self.counters.snapshot())
+                                self.counters.snapshot(),
+                                hists=self.hists.snapshot(compact=True))
         return out
 
     def discard_phases(self) -> None:
@@ -159,7 +196,8 @@ class Telemetry:
         phase behind, without polluting the span accumulator."""
         if self.enabled and self.heartbeat is not None:
             self.heartbeat.beat(phase, self.generation,
-                                self.counters.snapshot())
+                                self.counters.snapshot(),
+                                hists=self.hists.snapshot(compact=True))
 
     # ------------------------------------------------- compile ledger
 
@@ -209,8 +247,13 @@ class Telemetry:
     # -------------------------------------------------------------- events
 
     def event(self, name: str, **extra) -> None:
-        """Record a non-span event (compile, retry, error) in the ring."""
+        """Record a non-span event (compile, retry, error) in the ring.
+        The current :meth:`trace_ctx` id rides along unless the caller
+        passed its own ``trace=``."""
         if self.enabled:
+            trace = getattr(self._tls, "trace", None)
+            if trace is not None and "trace" not in extra:
+                extra["trace"] = trace
             self.recorder.add("event", name, generation=self.generation,
                               **extra)
 

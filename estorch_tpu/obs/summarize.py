@@ -182,6 +182,14 @@ def _median(xs: list[float]) -> float:
 
 STALL_FACTOR = 5.0  # a generation this many × the median wall time stalls
 
+# TAIL-HEAVY async queue-wait callout: p99/p50 beyond this ratio AND
+# p99 above this floor.  The floor matters because the histogram ladder
+# clamps sub-10µs waits to its underflow midpoint — a fast healthy fold
+# loop can show a huge RATIO whose absolute p99 is half a millisecond,
+# which is not a diagnosis worth shouting about
+TAIL_RATIO_THRESHOLD = 10.0
+TAIL_P99_FLOOR_S = 0.05
+
 # counters surfaced in the summary/diagnosis when nonzero — the
 # resilience layer's evidence that a run survived faults rather than
 # never seeing any (docs/resilience.md)
@@ -368,6 +376,20 @@ def summarize(records: list[dict], heartbeat_path: str | None = None,
             "max_staleness": max((int(a.get("max_staleness", 0))
                                   for a in async_recs), default=0),
         }
+        # queue-wait / staleness quantiles: the LAST record's block is
+        # the run-cumulative histogram state (algo/scheduler.py), so it
+        # IS the run's distribution summary
+        for key in ("queue_wait_s", "staleness_q"):
+            qs = async_recs[-1].get(key)
+            if (isinstance(qs, dict)
+                    and isinstance(qs.get("p50"), (int, float))
+                    and isinstance(qs.get("p99"), (int, float))):
+                async_block[key] = {"p50": float(qs["p50"]),
+                                    "p99": float(qs["p99"])}
+        qw = async_block.get("queue_wait_s")
+        if qw and qw["p50"] > 0:
+            async_block["queue_wait_tail_ratio"] = round(
+                qw["p99"] / qw["p50"], 2)
 
     diagnosis = []
     if stalls:
@@ -449,6 +471,15 @@ def summarize(records: list[dict], heartbeat_path: str | None = None,
             clause += (f", {async_block['stale_discarded']} DISCARDED "
                        "past the staleness horizon")
         diagnosis.append(clause)
+        ratio = async_block.get("queue_wait_tail_ratio")
+        if ratio is not None and ratio > TAIL_RATIO_THRESHOLD:
+            qw = async_block["queue_wait_s"]
+            if qw["p99"] >= TAIL_P99_FLOOR_S:
+                diagnosis.append(
+                    f"TAIL-HEAVY async queue wait: p99 {qw['p99']}s is "
+                    f"{ratio}x p50 {qw['p50']}s — a few results wait far "
+                    "longer than typical (stragglers or a starved fold "
+                    "loop); check async/eval_s and stale discards")
     if not diagnosis:
         diagnosis.append("steady: no stalls, no throughput decay")
 
@@ -534,6 +565,18 @@ def format_summary(s: dict) -> str:
             line += f"  overlap {a['overlap_efficiency']}"
         line += f"  discarded={a['stale_discarded']}"
         lines.append(line)
+        qw, st = a.get("queue_wait_s"), a.get("staleness_q")
+        if qw or st:
+            tail = "async tails      "
+            if qw:
+                tail += (f"queue-wait p50={qw['p50']}s "
+                         f"p99={qw['p99']}s")
+                if a.get("queue_wait_tail_ratio") is not None:
+                    tail += f" (p99/p50 {a['queue_wait_tail_ratio']}x)"
+            if st:
+                tail += (f"  staleness p50={st['p50']} "
+                         f"p99={st['p99']}")
+            lines.append(tail)
     lines.extend(_format_serving(s))
     if s.get("restarts") and s["restarts"]["count"]:
         lines.append(f"restarts         {s['restarts']['count']} "
@@ -589,7 +632,13 @@ def selfcheck() -> list[str]:
                      **{"async": {"consumed": 16, "fresh": 10, "folded": 6,
                                   "stale_discarded": 1, "max_staleness": 2,
                                   "mean_lambda": 0.91,
-                                  "overlap_efficiency": 0.8}})
+                                  "overlap_efficiency": 0.8,
+                                  "dispatches": [6, 7],
+                                  "consumed_dispatches": [[5, 10], [6, 6]],
+                                  "discarded_dispatches": [[4, 1]],
+                                  "queue_wait_s": {"p50": 0.004,
+                                                   "p99": 0.09},
+                                  "staleness_q": {"p50": 0.0, "p99": 2.0}}})
     problems += [f"async golden: {p}"
                  for p in validate_record(json.loads(json.dumps(async_rec)))]
     broken_async = dict(GOLDEN_RECORD,
@@ -609,6 +658,38 @@ def selfcheck() -> list[str]:
         problems.append("diagnosis missed the stale-discard callout")
     if "async" not in format_summary(sa):
         problems.append("format_summary dropped the async block")
+    # tail health: queue-wait/staleness quantiles surface, and a
+    # p99/p50 ratio > 10 is called out as TAIL-HEAVY in the diagnosis
+    if ab and ab.get("queue_wait_s", {}).get("p99") != 0.09:
+        problems.append("async queue-wait quantiles not surfaced")
+    if ab and ab.get("staleness_q", {}).get("p99") != 2.0:
+        problems.append("async staleness quantiles not surfaced")
+    if ab and ab.get("queue_wait_tail_ratio") != round(0.09 / 0.004, 2):
+        problems.append("queue-wait p99/p50 ratio mis-derived")
+    if "TAIL-HEAVY" not in sa.get("diagnosis", ""):
+        problems.append("diagnosis missed the tail-heavy queue-wait "
+                        "callout (p99/p50 > 10)")
+    if "queue-wait" not in format_summary(sa):
+        problems.append("format_summary dropped the async tails line")
+    # a healthy tail (ratio <= 10) must NOT be called out
+    calm = dict(async_rec)
+    calm["async"] = dict(async_rec["async"],
+                         **{"queue_wait_s": {"p50": 0.004, "p99": 0.02}})
+    sc = summarize(recs + [json.loads(json.dumps(calm))])
+    if "TAIL-HEAVY" in sc.get("diagnosis", ""):
+        problems.append("tail-heavy callout fired on a 5x (healthy) "
+                        "p99/p50 ratio")
+    # ...nor must a huge RATIO whose absolute p99 is sub-millisecond
+    # (the histogram ladder clamps tiny p50s — ratio alone is not a
+    # diagnosis)
+    fast = dict(async_rec)
+    fast["async"] = dict(async_rec["async"],
+                         **{"queue_wait_s": {"p50": 9.1e-06,
+                                             "p99": 0.0005}})
+    sf = summarize(recs + [json.loads(json.dumps(fast))])
+    if "TAIL-HEAVY" in sf.get("diagnosis", ""):
+        problems.append("tail-heavy callout fired on a sub-millisecond "
+                        "p99 (ladder-floor ratio artifact)")
     # a synchronous run must not grow an async section
     if summarize(recs).get("async"):
         problems.append("sync run grew an async section")

@@ -280,6 +280,7 @@ class Supervisor:
         self.verbose = bool(verbose)
         self.restarts: list[dict] = []
         self._counters_total: dict[str, float] = {}
+        self._hists_total: dict[str, dict] = {}
         self._counters_through_ts = 0.0
         self._publish_error: str | None = None
         self._child = None
@@ -452,6 +453,13 @@ class Supervisor:
                     self._counters_total[name] = (
                         self._counters_total.get(name, 0) + val
                     )
+            if isinstance(hb.get("hists"), dict):
+                # latency DISTRIBUTIONS survive the child the same way
+                # its sums do: bucket-wise fold (obs/hist.py)
+                from ..obs.hist import merge_snapshots
+
+                self._hists_total = merge_snapshots(
+                    self._hists_total, hb["hists"])
             self._counters_through_ts = float(hb.get("ts", 0.0))
         # publish even when this child never beat (wedged import killed
         # by the startup grace): the restart_count the sidecar scrapes
@@ -467,7 +475,8 @@ class Supervisor:
             extra["completed"] = completed
         try:
             publish_counters(self.ckpt_root, self._counters_total,
-                             through_ts, extra=extra)
+                             through_ts, extra=extra,
+                             hists=self._hists_total or None)
             self._publish_error = None
         except OSError as e:
             # best-effort observability: a full disk must not become a

@@ -60,15 +60,25 @@ class BatchError(RuntimeError):
 
 
 class _Pending:
-    """One in-flight request: the caller blocks on ``event``."""
+    """One in-flight request: the caller blocks on ``event``.
 
-    __slots__ = ("obs", "event", "result", "error")
+    Carries its own lifecycle clock marks (submit → taken off the queue
+    → dispatched) and an optional caller-assigned trace id, so the
+    per-request histograms (``serve/queue_wait_s``,
+    ``serve/coalesce_wait_s``, ``serve/request_s``) and the flight
+    recorder can tell WHICH request a tail sample belongs to."""
 
-    def __init__(self, obs: np.ndarray):
+    __slots__ = ("obs", "event", "result", "error", "trace", "t_submit",
+                 "t_taken")
+
+    def __init__(self, obs: np.ndarray, trace: str | None = None):
         self.obs = obs
         self.event = threading.Event()
         self.result = None
         self.error: BaseException | None = None
+        self.trace = trace
+        self.t_submit = time.perf_counter()
+        self.t_taken = 0.0
 
 
 def bucket_sizes(max_batch: int) -> tuple[int, ...]:
@@ -225,9 +235,12 @@ class DynamicBatcher:
 
     # ---------------------------------------------------------- intake
 
-    def submit(self, obs) -> _Pending:
+    def submit(self, obs, trace: str | None = None) -> _Pending:
         """Enqueue one observation; returns the pending slot to wait on.
-        Sheds (:class:`BatcherSaturated`) when the queue is full."""
+        Sheds (:class:`BatcherSaturated`) when the queue is full.
+        ``trace``: caller-assigned request id threaded through the
+        recorder's shed/batch events (the server mints one per HTTP
+        request)."""
         if self._closing:
             raise BatcherClosed("batcher is draining — no new requests")
         arr = np.asarray(obs, np.float32)
@@ -236,7 +249,7 @@ class DynamicBatcher:
                 f"observation shape {arr.shape} != bundle obs_shape "
                 f"{self.obs_shape}"
             )
-        item = _Pending(arr)
+        item = _Pending(arr, trace=trace)
         self.obs.counters.inc("requests_total")
         with self._close_lock:
             if self._closing:
@@ -245,16 +258,18 @@ class DynamicBatcher:
                 self._q.put_nowait(item)
             except queue.Full:
                 self.obs.counters.inc("shed_total")
-                self.obs.event("request_shed", queue_depth=self._q.qsize())
+                self.obs.event("request_shed", queue_depth=self._q.qsize(),
+                               **({"trace": trace} if trace else {}))
                 raise BatcherSaturated(
                     f"request queue full ({self._q.maxsize}) — shedding "
                     "for backpressure"
                 ) from None
         return item
 
-    def predict(self, obs, timeout: float | None = 30.0) -> np.ndarray:
+    def predict(self, obs, timeout: float | None = 30.0,
+                trace: str | None = None) -> np.ndarray:
         """submit + wait; raises the batch's error or TimeoutError."""
-        item = self.submit(obs)
+        item = self.submit(obs, trace=trace)
         if not item.event.wait(timeout):
             raise TimeoutError(f"no batch result within {timeout}s")
         if item.error is not None:
@@ -283,8 +298,9 @@ class DynamicBatcher:
             if item is None:
                 self._drain_remaining()
                 return
+            item.t_taken = time.perf_counter()
             batch = [item]
-            deadline = time.perf_counter() + self.max_wait_s
+            deadline = item.t_taken + self.max_wait_s
             stop = False
             while len(batch) < self.max_batch:
                 remaining = deadline - time.perf_counter()
@@ -297,6 +313,7 @@ class DynamicBatcher:
                 if nxt is None:
                     stop = True
                     break
+                nxt.t_taken = time.perf_counter()
                 batch.append(nxt)
             self._dispatch(batch)
             if stop:
@@ -317,6 +334,7 @@ class DynamicBatcher:
                 break
             if item is None:
                 continue
+            item.t_taken = time.perf_counter()
             batch.append(item)
             if len(batch) >= self.max_batch:
                 self._dispatch(batch)
@@ -336,8 +354,17 @@ class DynamicBatcher:
             obs.counters.inc("recompiles")
             obs.event("bucket_compile", bucket=bucket)
         arr = np.zeros((bucket,) + self.obs_shape, np.float32)
+        t_dispatch = time.perf_counter()
         for i, item in enumerate(batch):
             arr[i] = item.obs
+            # per-request lifecycle distributions (docs/observability.md
+            # "Tails & traces"): time on the queue before a worker took
+            # it, then time spent waiting for neighbors to coalesce
+            if item.t_taken:
+                obs.hists.observe("serve/queue_wait_s",
+                                  item.t_taken - item.t_submit)
+                obs.hists.observe("serve/coalesce_wait_s",
+                                  t_dispatch - item.t_taken)
         obs.counters.gauge("queue_depth", self._q.qsize())
         obs.counters.gauge("batch_size_last", n)
         obs.counters.gauge("bucket_last", bucket)
@@ -369,9 +396,19 @@ class DynamicBatcher:
             obs.compile_event(f"bucket_{bucket}", dt, count_recompiles=0,
                               bucket=bucket, first_call=True)
         obs.counters.inc("predict_time_s_total", dt)
-        obs.counters.gauge("batch_predict_ms_last", round(dt * 1e3, 3))
+        # the compute cost every coalesced request shared, as a
+        # DISTRIBUTION (n-weighted: per request, not per batch) — a
+        # last-write gauge here would keep exactly the sample the tail
+        # is not in (esguard R12 gauge-shaped-latency)
+        obs.hists.observe("serve/compute_s", dt, n=n)
         obs.counters.inc("batches_total")
         obs.counters.inc("batched_requests_total", n)
+        traces = [item.trace for item in batch if item.trace]
+        if traces:
+            # causal record: which requests rode this dispatch (the
+            # ring is bounded, so high-RPS churn evicts, not grows)
+            obs.event("batch_dispatch", bucket=bucket, n=n,
+                      dur_ms=round(dt * 1e3, 3), traces=traces)
         if err is None:
             # own the results before crossing threads: np.asarray on a jax
             # output is a ZERO-COPY view of the XLA buffer, and waiter
@@ -380,11 +417,16 @@ class DynamicBatcher:
             # (1-ulp flaky rows under load) before this copy; the copy is
             # (bucket, action_dim) floats, noise next to the forward pass.
             out = np.array(out, np.float32, copy=True)
+        t_done = time.perf_counter()
         for i, item in enumerate(batch):
             if err is None:
                 item.result = out[i]
             else:
                 item.error = err
+            # full in-batcher request latency (submit → result ready):
+            # the quantity the server's tail SLO is about, and the one
+            # the quantile-honesty test reconciles against loadgen
+            obs.hists.observe("serve/request_s", t_done - item.t_submit)
             item.event.set()
 
     # ----------------------------------------------------------- drain
@@ -435,7 +477,7 @@ class DynamicBatcher:
         c = self.obs.counters
         batches = c.get("batches_total")
         served = c.get("batched_requests_total")
-        return {
+        out = {
             "queue_depth": self._q.qsize(),
             "max_batch": self.max_batch,
             "buckets": list(self.buckets),
@@ -447,3 +489,12 @@ class DynamicBatcher:
             "recompiles": int(c.get("recompiles")),
             "mean_batch": round(served / batches, 3) if batches else None,
         }
+        hists = self.obs.hists
+        lat = {}
+        for q, key in ((0.5, "p50"), (0.99, "p99")):
+            v = hists.quantile("serve/request_s", q)
+            if v is not None:
+                lat[key] = round(v * 1e3, 3)
+        if lat:
+            out["request_ms"] = lat
+        return out

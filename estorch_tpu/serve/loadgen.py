@@ -78,6 +78,7 @@ def run_load(
     obs: list | None = None,
     obs_list: list | None = None,
     collect_responses: bool = False,
+    collect_latencies: bool = False,
     timeout_s: float = 60.0,
 ) -> dict:
     """Drive ``/predict`` traffic; returns the measurement dict.
@@ -87,7 +88,10 @@ def run_load(
     ``responses[i]`` is request i's parsed body — the bit-exactness
     check's plumbing).  ``total`` stops after exactly that many requests
     (default: run for ``duration_s``).  ``mode="open"`` needs
-    ``target_rps``.
+    ``target_rps``.  ``collect_latencies`` returns the raw per-request
+    latency list (``latencies_s``, completion order) — the offline
+    samples the ``obs regress --tail`` gate and the quantile-honesty
+    test consume.
     """
     if mode not in ("closed", "open"):
         raise ValueError(f"mode must be closed|open, got {mode!r}")
@@ -268,7 +272,25 @@ def run_load(
         out["target_rps"] = float(target_rps)
     if responses is not None:
         out["responses"] = responses
+    if collect_latencies:
+        out["latencies_s"] = latencies
     return out
+
+
+def write_latency_rows(latencies_s: list, path: str,
+                       endpoint: str = "/predict") -> str:
+    """Per-request latency rows as JSONL (``{"endpoint", "latency_s"}``)
+    — the measurement file shape ``obs regress --tail`` groups by
+    endpoint.  Atomic (tmp + rename), like every other artifact."""
+    import os
+
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        for v in latencies_s:
+            f.write(json.dumps({"endpoint": endpoint,
+                                "latency_s": float(v)}) + "\n")
+    os.replace(tmp, path)
+    return path
 
 
 # ------------------------------------------------------------------ smoke
@@ -302,9 +324,12 @@ def _selfcheck() -> int:
     try:
         obs_list = [[float(i), 1.0] for i in range(16)]
         closed = run_load(addr, conns=4, total=16, duration_s=5.0,
-                          obs_list=obs_list, collect_responses=True)
+                          obs_list=obs_list, collect_responses=True,
+                          collect_latencies=True)
         if closed["requests"] != 16 or closed["errors"]:
             problems.append(f"closed loop lost requests: {closed}")
+        if len(closed.get("latencies_s", [])) != 16:
+            problems.append("per-request latencies not collected")
         got = [r and r["action"] for r in closed["responses"]]
         if got != obs_list:
             problems.append("responses not matched to request indices")
@@ -340,6 +365,10 @@ def main(argv=None) -> int:
     p.add_argument("--target-rps", type=float, default=None)
     p.add_argument("--obs", default=None,
                    help="JSON observation, e.g. '[0.1, 0.2, 0.3]'")
+    p.add_argument("--latencies-out", default=None, metavar="PATH",
+                   help="also write per-request latency rows as JSONL "
+                        "({'endpoint', 'latency_s'}) — the obs regress "
+                        "--tail measurement format")
     p.add_argument("--selfcheck", action="store_true",
                    help="validate the loadgen itself against an "
                         "in-process echo server (CI gate)")
@@ -352,7 +381,11 @@ def main(argv=None) -> int:
         args.address, mode=args.mode, conns=args.conns,
         duration_s=args.duration, target_rps=args.target_rps,
         obs=json.loads(args.obs) if args.obs else None,
+        collect_latencies=bool(args.latencies_out),
     )
+    if args.latencies_out:
+        write_latency_rows(res.pop("latencies_s"), args.latencies_out)
+        res["latencies_out"] = args.latencies_out
     print(json.dumps(res))
     return 0
 

@@ -28,6 +28,7 @@ write the final counter line, exit 0.
 
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import signal
@@ -85,6 +86,10 @@ class PolicyServer:
         # step must not make a healthy server report negative uptime)
         self._started_mono = time.monotonic()
         self.draining = False
+        # per-request trace ids (docs/observability.md "Tails & traces"):
+        # minted at HTTP entry, threaded through the batcher's recorder
+        # events, echoed back as the X-Trace-Id response header
+        self._req_seq = itertools.count(1)
         self._inflight = 0
         self._inflight_lock = threading.Lock()
         self._inflight_zero = threading.Event()
@@ -145,7 +150,7 @@ class PolicyServer:
 
     # ---------------------------------------------------------- serving
 
-    def predict(self, obs) -> np.ndarray:
+    def predict(self, obs, trace: str | None = None) -> np.ndarray:
         # one engine read per attempt; a request racing a hot reload can
         # catch the OLD batcher mid-close (BatcherClosed) on a perfectly
         # healthy server — retry against the freshly-swapped engine
@@ -154,7 +159,8 @@ class PolicyServer:
             eng = self._engine
             try:
                 return eng.batcher.predict(obs,
-                                           timeout=self.request_timeout_s)
+                                           timeout=self.request_timeout_s,
+                                           trace=trace)
             except BatcherClosed:
                 if self.draining or eng is self._engine:
                     raise
@@ -216,6 +222,11 @@ class PolicyServer:
                 "draining": 1.0 if self.draining else 0.0,
             },
             up=not self.draining,
+            # per-request lifecycle distributions (serve/batcher.py:
+            # queue-wait, coalesce-wait, compute, request; the handler's
+            # write) as true histogram types — the tail a scraper can
+            # actually alert on
+            histograms=self.obs.hists.export() or None,
         )
 
     def stats(self) -> dict:
@@ -345,36 +356,46 @@ def _make_handler(server: PolicyServer):
             # untracking before the reply would let a SIGTERM drain declare
             # victory (inflight==0) while this thread still holds an
             # unwritten answer — and the process exit would drop it
+            trace = f"r{next(server._req_seq)}"
+            headers = {"X-Trace-Id": trace}
             server.track_request()
             try:
                 try:
-                    out = server.predict(data["obs"])
+                    out = server.predict(data["obs"], trace=trace)
                 except BatcherSaturated:
                     self._reply(503,
-                                {"error": "saturated — retry with backoff"},
-                                {"Retry-After": "1"})
+                                {"error": "saturated — retry with backoff",
+                                 "trace": trace},
+                                {"Retry-After": "1", **headers})
                     return
                 except BatcherClosed:
-                    self._reply(503, {"error": "draining"})
+                    self._reply(503, {"error": "draining"}, headers)
                     return
                 except (ValueError, TypeError) as e:
                     # malformed obs AT SUBMIT (wrong shape → ValueError,
                     # nulls/non-numerics → TypeError from np.asarray) —
                     # genuinely the client's fault; batch-side faults
                     # arrive as BatchError below, never these types
-                    self._reply(400, {"error": str(e)})
+                    self._reply(400, {"error": str(e)}, headers)
                     return
                 except TimeoutError as e:
-                    self._reply(504, {"error": str(e)})
+                    self._reply(504, {"error": str(e)}, headers)
                     return
                 except Exception as e:  # noqa: BLE001 — a server fault
                     # (BatchError from the jitted forward, device runtime
                     # death) must answer 500, not drop the connection
                     server.obs.counters.inc("http_500_total")
-                    server.obs.event("predict_error", error=repr(e)[:200])
-                    self._reply(500, {"error": f"server fault: {e}"})
+                    server.obs.event("predict_error", error=repr(e)[:200],
+                                     trace=trace)
+                    self._reply(500, {"error": f"server fault: {e}"},
+                                headers)
                     return
-                self._reply(200, {"action": out.tolist()})
+                t_write = time.perf_counter()
+                self._reply(200, {"action": out.tolist()}, headers)
+                # the write leg of the lifecycle (serialize + socket):
+                # the only piece the batcher's request_s cannot see
+                server.obs.hists.observe("serve/write_s",
+                                         time.perf_counter() - t_write)
             finally:
                 server.untrack_request()
 

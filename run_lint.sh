@@ -34,6 +34,20 @@ echo "== obs regress selfcheck =="
 # regressions through.  Pure stdlib, milliseconds.
 python -m estorch_tpu.obs regress --selfcheck
 
+echo "== obs hist selfcheck =="
+# streaming-histogram gate (estorch_tpu/obs/hist.py): exact small-N
+# quantiles, a known-distribution sample inside the documented bucket
+# error bound, merge associativity, and the cross-restart composition +
+# Prometheus exposition round trips.  Stdlib, milliseconds.
+python -m estorch_tpu.obs hist --selfcheck
+
+echo "== obs regress tail selfcheck =="
+# tail-gate gate (estorch_tpu/obs/export/regress.py compare_tail): a
+# median-clean pair with ~2% of requests slowed 5x (the chaos-shed
+# signature) must PASS the median gate but be FLAGGED at p99, naming
+# the quantile and the endpoint/phase.  Pure stdlib, milliseconds.
+python -m estorch_tpu.obs regress --tail --selfcheck
+
 echo "== chaos selfcheck =="
 # recovery-path gate (estorch_tpu/resilience, docs/resilience.md): a tiny
 # host-backend run under a worker-kill chaos plan must keep FULL

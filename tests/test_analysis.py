@@ -1117,6 +1117,84 @@ class TestR11:
             assert not hits, [h.message for h in hits]
 
 
+class TestR12:
+    def test_gauge_of_clock_delta_name_flagged(self):
+        """The motivating true positive: serve/batcher.py recorded the
+        batch predict duration as a `batch_predict_ms_last` gauge —
+        last-write-wins, so the tail sample is gone by the next batch.
+        The fix observes into the serve/compute_s histogram."""
+        found = findings("""
+            import time
+
+            def dispatch(hub, batch):
+                t0 = time.perf_counter()
+                run(batch)
+                dt = time.perf_counter() - t0
+                hub.gauge("batch_predict_ms_last", round(dt * 1e3, 3))
+        """, "R12")
+        assert len(found) == 1
+        assert "tail" in found[0].message
+        assert "histogram" in found[0].hint
+
+    def test_gauge_of_inline_delta_flagged(self):
+        found = findings("""
+            import time
+
+            def dispatch(hub):
+                t0 = time.monotonic()
+                work()
+                hub.gauge("work_ms", (time.monotonic() - t0) * 1e3)
+        """, "R12")
+        assert len(found) == 1
+
+    def test_non_duration_gauges_clean(self):
+        """Queue depth, ratios, and re-derivable sums are genuinely
+        last-write facts — the rule must stay silent on them."""
+        assert not findings("""
+            import time
+
+            def stats(hub, q, folded, consumed):
+                hub.gauge("queue_depth", q.qsize())
+                hub.gauge("stale_reuse_ratio", folded / max(consumed, 1))
+        """, "R12")
+
+    def test_histogram_observe_of_delta_clean(self):
+        assert not findings("""
+            import time
+
+            def dispatch(hub, batch):
+                t0 = time.perf_counter()
+                run(batch)
+                dt = time.perf_counter() - t0
+                hub.observe("serve/compute_s", dt, n=len(batch))
+        """, "R12")
+
+    def test_wall_clock_delta_not_this_rules_business(self):
+        """A time.time() delta is R09's finding (wrong clock), not a
+        gauge-shaped-latency one — no double-reporting."""
+        assert not findings("""
+            import time
+
+            def stamp(hub):
+                t0 = time.time()
+                work()
+                hub.gauge("age_s", time.time() - t0)
+        """, "R12")
+
+    def test_batcher_and_spans_self_clean(self):
+        """The rule's motivating modules must pass it (self-apply: the
+        batch_predict_ms_last gauge became a histogram observe)."""
+        import estorch_tpu.obs.spans as spans
+        import estorch_tpu.serve.batcher as batcher
+
+        for mod in (batcher, spans):
+            with open(mod.__file__) as f:
+                src = f.read()
+            hits = [x for x in analyze_source(mod.__file__, src)
+                    if x.rule == "R12"]
+            assert not hits, [h.message for h in hits]
+
+
 # ---------------------------------------------------------------------
 # engine / CLI / config / baseline mechanics
 # ---------------------------------------------------------------------
@@ -1142,7 +1220,7 @@ class TestEngine:
     def test_every_rule_registered(self):
         ids = [r.id for r in all_rules()]
         assert ids == ["R01", "R02", "R03", "R04", "R05", "R06", "R07",
-                       "R08", "R09", "R10", "R11"]
+                       "R08", "R09", "R10", "R11", "R12"]
 
     def test_syntax_error_becomes_finding(self):
         found = analyze_source("bad.py", "def broken(:\n")
@@ -1276,7 +1354,7 @@ class TestConfig:
         assert cfg.baseline == "esguard_baseline.json"
         assert cfg.rule_ids([r.id for r in all_rules()]) == [
             "R01", "R02", "R03", "R04", "R05", "R06", "R07", "R08", "R09",
-            "R10", "R11"]
+            "R10", "R11", "R12"]
 
 
 class TestCLI:

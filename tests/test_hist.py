@@ -1,0 +1,541 @@
+"""Tail-latency truth (estorch_tpu/obs/hist.py + the layers over it).
+
+Anchors: the streaming histogram's exact-small-N/bucket quantile
+contract and its documented error bound, merge/composition exactness
+(the cross-restart story), the true-histogram Prometheus round trip,
+the ``obs regress --tail`` gate flagging a median-invisible p99
+regression NAMING the quantile and the endpoint/phase, and the causal
+trace layer: async records carry dispatch→fold identity that ``obs
+trace`` renders as Perfetto flow arrows — proven against a REAL
+straggler-chaos ``train_async`` run, not just synthetic records.
+"""
+
+import json
+import math
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+import torch
+
+from estorch_tpu import ES
+from estorch_tpu.obs.export.prometheus import (histogram_series,
+                                               parse_exposition,
+                                               render_exposition,
+                                               samples_by_name,
+                                               validate_histogram_series)
+from estorch_tpu.obs.export.regress import (compare_phases, compare_tail,
+                                            compare_tail_files,
+                                            tail_selfcheck)
+from estorch_tpu.obs.export.sidecar import (MetricsSidecar, compose_hists,
+                                            publish_counters)
+from estorch_tpu.obs.export.traceevent import export_trace, validate_trace
+from estorch_tpu.obs.hist import (Histogram, Histograms, NullHistograms,
+                                  merge_snapshots)
+from estorch_tpu.obs.hist import selfcheck as hist_selfcheck
+from estorch_tpu.obs.spans import Telemetry
+from estorch_tpu.resilience.chaos import CHAOS_ENV, ChaosPlan, reset_cache
+
+
+# =====================================================================
+# the histogram itself
+# =====================================================================
+
+class TestHistogram:
+    def test_exact_small_n_quantiles(self):
+        h = Histogram()
+        vals = [0.003, 0.001, 0.010, 0.002, 0.500]
+        for v in vals:
+            h.observe(v)
+        s = sorted(vals)
+        assert h.quantile(0.5) == s[math.ceil(0.5 * 5) - 1]
+        assert h.quantile(0.99) == s[-1]
+        assert h.count == 5
+        assert h.sum == pytest.approx(sum(vals))
+
+    def test_bucket_path_within_documented_bound(self):
+        import random
+
+        rng = random.Random(7)
+        vals = [rng.expovariate(1 / 0.02) for _ in range(4000)]
+        h = Histogram()
+        for v in vals:
+            h.observe(v)
+        s = sorted(vals)
+        bound = h.quantile_error_bound()
+        for q in (0.5, 0.95, 0.99):
+            exact = s[math.ceil(q * len(s)) - 1]
+            assert abs(h.quantile(q) - exact) / exact <= bound
+
+    def test_le_edge_lands_in_its_bucket(self):
+        h = Histogram(lo=1e-3, decades=3, per_decade=1)
+        # bounds: 1e-3, 1e-2, 1e-1, 1e0; v == bound(k) must land in
+        # bucket k (le semantics), not k+1
+        h.observe(1e-2)
+        assert h._counts[1] == 1
+        h.observe(1e-2 * 1.0001)
+        assert h._counts[2] == 1
+
+    def test_under_and_overflow(self):
+        # exact_cap=0 forces the bucket path so the ladder's edge
+        # behavior (not the exact list) is what's under test
+        h = Histogram(lo=1e-3, decades=2, per_decade=2, exact_cap=0)
+        h.observe(0.0)      # underflow
+        h.observe(-1.0)     # clamped into underflow, still counted
+        h.observe(5.0)      # past the top edge: +Inf bucket
+        assert h._counts[0] == 2
+        assert h._counts[-1] == 1
+        assert h.count == 3
+        # overflow quantile returns the top edge (documented underestimate)
+        assert h.quantile(1.0) == pytest.approx(h.bound(h.n))
+        # underflow quantile sits just below lo
+        assert h.quantile(0.5) < h.lo
+
+    def test_nonfinite_observations_dropped(self):
+        h = Histogram()
+        h.observe(float("nan"))
+        h.observe(float("inf"))
+        assert h.count == 0 and math.isnan(h.quantile(0.5))
+
+    def test_weighted_observe(self):
+        h = Histogram()
+        h.observe(0.004, n=16)
+        assert h.count == 16
+        assert h.sum == pytest.approx(0.004 * 16)
+        assert h.quantile(0.99) == 0.004
+
+    def test_merge_equals_all_at_once_and_raises_on_mismatch(self):
+        import random
+
+        rng = random.Random(1)
+        vals = [rng.uniform(1e-4, 1.0) for _ in range(900)]
+        whole = Histogram()
+        parts = [Histogram() for _ in range(3)]
+        for i, v in enumerate(vals):
+            whole.observe(v)
+            parts[i % 3].observe(v)
+        merged = parts[0].merge(parts[1]).merge(parts[2])
+        assert merged._counts == whole._counts
+        assert merged.count == whole.count
+        assert merged.quantile(0.99) == whole.quantile(0.99)
+        with pytest.raises(ValueError, match="ladder mismatch"):
+            Histogram(lo=1e-3).merge(Histogram(lo=1e-5))
+
+    def test_dict_round_trip_through_json(self):
+        h = Histogram()
+        for v in (0.001, 0.02, 0.3, 40.0):
+            h.observe(v)
+        back = Histogram.from_dict(json.loads(json.dumps(h.to_dict())))
+        assert back._counts == h._counts
+        assert back.count == h.count
+        assert back.quantile(0.95) == h.quantile(0.95)
+
+    def test_thread_safety_counts_exact(self):
+        h = Histogram()
+
+        def pump(seed):
+            for i in range(1000):
+                h.observe(1e-4 * (seed + 1) * (1 + i % 7))
+
+        threads = [threading.Thread(target=pump, args=(s,))
+                   for s in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert h.count == 4000
+        assert sum(h._counts) == 4000
+
+    def test_selfcheck_clean(self):
+        assert hist_selfcheck(render=render_exposition,
+                              parse=parse_exposition) == []
+
+
+class TestRegistryAndHub:
+    def test_registry_observe_and_quantile(self):
+        hs = Histograms()
+        for v in (0.001, 0.002, 0.003):
+            hs.observe("serve/request_s", v)
+        assert hs.quantile("serve/request_s", 0.5) == 0.002
+        assert hs.quantile("missing", 0.5) is None
+        assert list(hs.snapshot()) == ["serve/request_s"]
+        exp = hs.export()["serve/request_s"]
+        assert exp["count"] == 3 and math.isinf(exp["buckets"][-1][0])
+
+    def test_null_registry_and_disabled_hub_swallow(self):
+        null = NullHistograms()
+        null.observe("x", 1.0)
+        assert null.snapshot() == {}
+        tel = Telemetry(enabled=False)
+        tel.observe("serve/request_s", 0.5)
+        with tel.phase("eval"):
+            pass
+        assert tel.hists.snapshot() == {}
+
+    def test_enabled_hub_histograms_every_phase(self):
+        """The per-phase duration DISTRIBUTION rides the span machinery:
+        every phase() observes into phase/<name> for free."""
+        tel = Telemetry(enabled=True)
+        for _ in range(3):
+            with tel.phase("eval"):
+                pass
+            with tel.phase("update"):
+                with tel.phase("obsnorm_merge"):
+                    pass
+        names = tel.hists.names()
+        assert "phase/eval" in names
+        assert "phase/update/obsnorm_merge" in names
+        assert tel.hists.get("phase/eval").count == 3
+
+    def test_trace_ctx_threads_ids_into_recorder(self):
+        tel = Telemetry(enabled=True)
+        with tel.trace_ctx("r42"):
+            with tel.phase("eval"):
+                pass
+            tel.event("request_shed")
+        evs = tel.recorder.events()
+        assert any(e.get("trace") == "r42" and e["kind"] == "span"
+                   for e in evs)
+        assert any(e.get("trace") == "r42" and e["name"] == "request_shed"
+                   for e in evs)
+        # the id must not leak past the context
+        with tel.phase("update"):
+            pass
+        assert "trace" not in tel.recorder.events()[-1]
+
+
+# =====================================================================
+# Prometheus histogram round trip + cross-restart composition
+# =====================================================================
+
+class TestExposition:
+    def _hist(self, vals):
+        h = Histogram()
+        for v in vals:
+            h.observe(v)
+        return h
+
+    def test_render_parse_validate_round_trip(self):
+        h = self._hist([0.001, 0.004, 0.004, 2.0])
+        body = render_exposition({"requests_total": 4}, None, up=True,
+                                 histograms={"serve/request_s":
+                                             h.to_export()})
+        samples = parse_exposition(body)  # raises on malformed lines
+        assert validate_histogram_series(samples) == []
+        series = histogram_series(samples)["estorch_serve_request_s"]
+        assert series["count"] == 4 and series["buckets"][-1][1] == 4
+        assert series["sum"] == pytest.approx(2.009)
+        # cumulative counts survive the zero-delta edge elision
+        cums = [c for _, c in series["buckets"]]
+        assert cums == sorted(cums)
+        assert "# TYPE estorch_serve_request_s histogram" in body
+
+    def test_validator_rejects_broken_series(self):
+        h = self._hist([0.001])
+        exp = h.to_export()
+        exp["count"] = 5  # +Inf bucket no longer equals _count
+        body = render_exposition({}, None, up=True,
+                                 histograms={"lat": exp})
+        problems = validate_histogram_series(parse_exposition(body))
+        assert problems and "+Inf" in problems[0]
+
+    def test_sidecar_composes_published_and_live(self, tmp_path):
+        d = str(tmp_path)
+        h_pub = self._hist([0.001, 0.002])
+        h_live = self._hist([0.004])
+        hb_ts = time.time()
+        with open(os.path.join(d, "heartbeat.json"), "w") as f:
+            json.dump({"ts": hb_ts, "pid": 1, "phase": "serving",
+                       "generation": 0, "counters": {"env_steps": 1},
+                       "hists": {"serve/request_s": h_live.to_dict()}}, f)
+        publish_counters(d, {"env_steps": 2}, through_ts=hb_ts - 1.0,
+                         hists={"serve/request_s": h_pub.to_dict()})
+        sidecar = MetricsSidecar(d)
+        try:
+            body = sidecar.scrape()
+        finally:
+            sidecar.close()
+        samples = parse_exposition(body)
+        assert validate_histogram_series(samples) == []
+        vals = samples_by_name(samples)
+        # published (2 obs) + newer live beat (1 obs) = 3, monotone
+        assert vals["estorch_serve_request_s_count"] == 3
+        assert vals["estorch_env_steps"] == 3
+
+    def test_stale_beat_not_double_counted(self, tmp_path):
+        """A beat at/older than through_ts is the buried child's final
+        beat, already folded into the published totals."""
+        d = str(tmp_path)
+        h = self._hist([0.001])
+        hb_ts = time.time()
+        with open(os.path.join(d, "heartbeat.json"), "w") as f:
+            json.dump({"ts": hb_ts, "pid": 1, "phase": "eval",
+                       "generation": 3,
+                       "hists": {"lat": h.to_dict()}}, f)
+        publish_counters(d, {}, through_ts=hb_ts,
+                         hists={"lat": h.to_dict()})
+        composed = compose_hists(
+            {"through_ts": hb_ts, "hists": {"lat": h.to_dict()}},
+            {"ts": hb_ts, "hists": {"lat": h.to_dict()}})
+        assert composed["lat"]["count"] == 1
+
+    def test_merge_snapshots_degrades_on_ladder_mismatch(self):
+        big = self._hist([0.001, 0.002, 0.003]).to_dict()
+        odd = Histogram(lo=1e-2)
+        odd.observe(0.5)
+        out = merge_snapshots({"lat": big}, {"lat": odd.to_dict()})
+        assert out["lat"]["count"] == 3  # bigger side kept, no crash
+
+
+# =====================================================================
+# the tail gate (obs regress --tail)
+# =====================================================================
+
+class TestTailGate:
+    def _latency_rows(self, seed, n=1500, slow_every=0):
+        import random
+
+        rng = random.Random(seed)
+        rows = []
+        for i in range(n):
+            v = 0.008 * (1.0 + rng.uniform(-0.03, 0.03))
+            if slow_every and i % slow_every == 0:
+                v *= 5.0
+            rows.append({"endpoint": "/predict", "latency_s": v})
+        return rows
+
+    def test_median_clean_p99_regressed_flagged_with_names(self, tmp_path):
+        """THE acceptance demo: a 5x slowdown on ~1% of requests passes
+        every median verdict but is flagged at p99, naming the quantile
+        and the endpoint."""
+        base = tmp_path / "base.jsonl"
+        cur = tmp_path / "cur.jsonl"
+        # slow_every=80 → 1.25% of requests: nearest-rank p99 needs the
+        # tail fraction to EXCEED 1% before the rank lands in it
+        for path, rows in ((base, self._latency_rows(0)),
+                           (cur, self._latency_rows(1, slow_every=80))):
+            path.write_text("".join(json.dumps(r) + "\n" for r in rows))
+        v = compare_tail_files(str(cur), str(base))
+        assert v["verdict"] == "regress"
+        assert v["regressed_groups"] == ["/predict"]
+        assert v["quantile"] == "p99"
+        g = v["groups"]["/predict"]
+        assert g["median_verdict"] == "pass"
+        assert g["slowdown_pct"] > 100
+
+    def test_clean_rerun_passes(self):
+        v = compare_tail(self._latency_rows(2), self._latency_rows(3))
+        assert v["verdict"] == "pass"
+
+    def test_phase_tail_named_while_median_gate_passes(self):
+        import random
+
+        def run(seed, slow_every=0):
+            rng = random.Random(seed)
+            rows = []
+            for g in range(100):
+                ev = 0.1 * (1 + rng.uniform(-0.02, 0.02))
+                if slow_every and g % slow_every == 0:
+                    ev *= 5
+                rows.append({"generation": g, "wall_time_s": ev + 0.02,
+                             "env_steps_per_sec": 1e3,
+                             "phases": {"eval": ev, "update": 0.02}})
+            return rows
+
+        base, cur = run(4), run(5, slow_every=50)
+        assert compare_phases(cur, base)["verdict"] == "pass"
+        tail = compare_tail(cur, base)
+        assert "eval" in tail["regressed_groups"]
+        assert "update" not in tail["regressed_groups"]
+
+    def test_no_shared_groups_is_an_error(self):
+        with pytest.raises(ValueError, match="no shared tail groups"):
+            compare_tail([{"latency_s": 0.1, "endpoint": "/a"}],
+                         [{"latency_s": 0.1, "endpoint": "/b"}])
+
+    def test_selfcheck_clean(self):
+        assert tail_selfcheck() == []
+
+    def test_cli_exit_codes(self, tmp_path, capsys):
+        from estorch_tpu.obs.__main__ import main
+
+        base = tmp_path / "base.jsonl"
+        cur = tmp_path / "cur.jsonl"
+        for path, rows in ((base, self._latency_rows(6)),
+                           (cur, self._latency_rows(7, slow_every=80))):
+            path.write_text("".join(json.dumps(r) + "\n" for r in rows))
+        assert main(["regress", str(cur), "--baseline", str(base),
+                     "--tail"]) == 1
+        out = capsys.readouterr().out
+        assert "p99" in out and "/predict" in out
+        assert main(["regress", str(base), "--baseline", str(base),
+                     "--tail"]) == 0
+        # --tail cannot combine with --phases / --label
+        assert main(["regress", str(cur), "--baseline", str(base),
+                     "--tail", "--phases"]) == 3
+
+
+# =====================================================================
+# causal flow arrows (obs trace) — synthetic and REAL async runs
+# =====================================================================
+
+def _flow_events(trace, ph):
+    return [e for e in trace["traceEvents"] if e["ph"] == ph]
+
+
+class TestFlowArrows:
+    def _record(self, g, async_block):
+        return {"generation": g, "reward_max": 0.0, "reward_mean": 0.0,
+                "best_reward": 0.0, "env_steps": 100,
+                "env_steps_per_sec": 1e3, "wall_time_s": 0.1,
+                "phases": {"eval": 0.08, "update": 0.02},
+                "async": async_block}
+
+    def test_dispatch_fold_discard_arrows(self):
+        records = [
+            self._record(0, {"consumed": 8, "fresh": 8, "folded": 0,
+                             "stale_discarded": 0,
+                             "dispatches": [0, 1],
+                             "consumed_dispatches": [[0, 8]],
+                             "discarded_dispatches": []}),
+            self._record(1, {"consumed": 8, "fresh": 5, "folded": 3,
+                             "stale_discarded": 2,
+                             "dispatches": [2],
+                             "consumed_dispatches": [[1, 3], [2, 5]],
+                             "discarded_dispatches": [[0, 2]]}),
+        ]
+        trace = export_trace(records)
+        assert validate_trace(trace) == []
+        starts = _flow_events(trace, "s")
+        finishes = _flow_events(trace, "f")
+        assert {e["id"] for e in starts} == {0, 1, 2}
+        # dispatch 0 is touched twice (fold in u0, discard in u1): the
+        # LAST touch is the finish, the earlier one a step
+        steps = _flow_events(trace, "t")
+        assert any(e["id"] == 0 for e in steps)
+        assert {e["id"] for e in finishes} == {0, 1, 2}
+        names = [e["name"] for e in trace["traceEvents"]]
+        assert any(n.startswith("fold d2") for n in names)
+        assert any(n.startswith("discard d0") for n in names)
+
+    def test_sync_records_grow_no_flow_lane(self):
+        rec = self._record(0, None)
+        del rec["async"]
+        trace = export_trace([rec])
+        assert validate_trace(trace) == []
+        assert not _flow_events(trace, "s")
+        assert all("async" not in e.get("args", {}).get("name", "")
+                   for e in trace["traceEvents"] if e["ph"] == "M")
+
+
+class _TinyPolicy(torch.nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.net = torch.nn.Sequential(
+            torch.nn.Linear(4, 8), torch.nn.Tanh(), torch.nn.Linear(8, 2))
+
+    def forward(self, x):
+        return self.net(x)
+
+
+class _QuadAgent:
+    def rollout(self, policy):
+        with torch.no_grad():
+            v = torch.nn.utils.parameters_to_vector(policy.parameters())
+            r = -float((v ** 2).sum())
+        self.last_episode_steps = 1
+        return r
+
+
+class TestAsyncStragglerE2E:
+    def test_straggler_run_traces_and_tails(self):
+        """THE async acceptance demo: a straggler-chaos train_async run
+        yields records whose causal identity renders as >=1 validated
+        flow arrow linking a dispatch to the update that folded it, and
+        the hub's lifecycle histograms carry the tail facts."""
+        os.environ[CHAOS_ENV] = ChaosPlan(events=[
+            {"kind": "straggler", "gen": 1, "member": 2, "sleep_s": 0.2},
+        ]).to_json()
+        reset_cache()
+        try:
+            es = ES(_TinyPolicy, _QuadAgent, torch.optim.Adam,
+                    population_size=8, sigma=0.05, seed=0,
+                    optimizer_kwargs={"lr": 0.05}, table_size=1 << 12,
+                    telemetry=True)
+            records = []
+            es.train_async(4, n_proc=2, verbose=False,
+                           log_fn=records.append)
+        finally:
+            os.environ.pop(CHAOS_ENV, None)
+            reset_cache()
+        assert len(records) == 4
+        blocks = [r["async"] for r in records]
+        # every update names the dispatches it consumed, and the union
+        # of consumed+discarded covers what was dispatched
+        assert all(b.get("consumed_dispatches") for b in blocks)
+        dispatched = {d for b in blocks for d in b.get("dispatches", [])}
+        consumed = {d for b in blocks
+                    for d, _n in b.get("consumed_dispatches", [])}
+        assert consumed & dispatched
+        # the straggler forces at least one stale fold or discard
+        assert (sum(b["folded"] for b in blocks) > 0
+                or sum(b["stale_discarded"] for b in blocks) > 0)
+        # queue-wait/staleness quantiles surfaced for obs summarize
+        last = blocks[-1]
+        assert last.get("queue_wait_s", {}).get("p99", 0) >= \
+            last.get("queue_wait_s", {}).get("p50", 0)
+        # hub lifecycle histograms populated
+        names = es.obs.hists.names()
+        for name in ("async/eval_s", "async/queue_wait_s",
+                     "async/staleness", "async/fold_latency_s"):
+            assert name in names, names
+        # the straggler's 0.2s sleep lands in the eval_s tail
+        assert es.obs.hists.get("async/eval_s").quantile(1.0) >= 0.2
+        # trace export: validated, with >=1 complete dispatch→fold arrow
+        # (via JSON, the CLI-equivalent path)
+        records = json.loads(json.dumps(records, default=float))
+        trace = export_trace(records)
+        assert validate_trace(trace) == []
+        assert _flow_events(trace, "s") and _flow_events(trace, "f")
+        # the dispatch's trace id threads through the flight recorder:
+        # dispatch event and its fold-side span family share "d<N>"
+        traces = {e.get("trace") for e in es.obs.recorder.events()
+                  if e.get("trace")}
+        assert any(t.startswith("d") for t in traces)
+
+
+# =====================================================================
+# serve lifecycle histograms (batcher-level; HTTP honesty lives in
+# tests/test_serve.py where a real bundle/server exists)
+# =====================================================================
+
+class TestServeLifecycleHists:
+    def test_batcher_populates_lifecycle_histograms(self):
+        from estorch_tpu.serve.batcher import DynamicBatcher
+
+        tel = Telemetry(enabled=True)
+        batcher = DynamicBatcher(
+            lambda arr: arr * 2.0, (2,), max_batch=4, max_wait_ms=1.0,
+            telemetry=tel, verify=True)
+        try:
+            for i in range(20):
+                batcher.predict(np.full(2, i, np.float32),
+                                trace=f"r{i}")
+        finally:
+            batcher.close()
+        names = tel.hists.names()
+        for name in ("serve/queue_wait_s", "serve/coalesce_wait_s",
+                     "serve/compute_s", "serve/request_s"):
+            assert name in names, names
+        # request_s >= its parts, and counts line up with requests
+        # (compute_s is n-weighted per coalesced request)
+        assert tel.hists.get("serve/request_s").count == 20
+        assert tel.hists.get("serve/compute_s").count == 20
+        assert batcher.stats()["request_ms"]["p99"] >= \
+            batcher.stats()["request_ms"]["p50"]
+        # trace ids rode the recorder's batch_dispatch events
+        evs = [e for e in tel.recorder.events()
+               if e["name"] == "batch_dispatch"]
+        assert evs and all(e.get("traces") for e in evs)

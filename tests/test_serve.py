@@ -601,6 +601,76 @@ class TestServerEndpoints:
 
 
 # =====================================================================
+# tail-latency truth: quantile honesty + trace ids (docs/observability.md
+# "Tails & traces")
+# =====================================================================
+
+class TestQuantileHonesty:
+    def test_loadgen_offline_vs_server_histogram_quantiles(
+            self, small_bundle):
+        """Quantile honesty: the loadgen's OFFLINE p50/p95/p99 (exact
+        nearest-rank over every client-measured latency) and the
+        server's histogram-derived quantiles for the same run must agree
+        within the bucket ladder's documented error bound, plus a small
+        absolute allowance for what the client clock sees and the
+        batcher's cannot (HTTP parse + event-wakeup, loopback-scale)."""
+        from estorch_tpu.serve import PolicyServer
+        from estorch_tpu.serve.loadgen import _percentile, run_load
+
+        srv = PolicyServer(small_bundle, port=0, max_batch=8,
+                           max_wait_ms=2.0,
+                           telemetry=Telemetry(enabled=True))
+        srv.start_background()
+        try:
+            res = run_load(f"{srv.host}:{srv.port}", conns=8, total=400,
+                           duration_s=60.0, obs=[0.0, 0.0, 0.0],
+                           collect_latencies=True)
+            assert res["requests"] == 400 and not res["errors"]
+            offline = sorted(res["latencies_s"])
+            hist = srv.obs.hists.get("serve/request_s")
+            assert hist is not None and hist.count == 400
+            bound = hist.quantile_error_bound()
+            for q in (0.50, 0.95, 0.99):
+                off = _percentile(offline, q)
+                srv_q = hist.quantile(q)
+                # client latency >= server-side request_s (wakeup +
+                # HTTP legs ride only the client clock), so the server
+                # quantile may sit below; it must never exceed the
+                # offline one by more than the ladder bound + slack
+                assert srv_q <= off * (1 + bound) + 0.002, (
+                    f"p{q * 100:g}: hist {srv_q} vs offline {off}")
+                assert srv_q >= off * (1 - bound) - 0.010, (
+                    f"p{q * 100:g}: hist {srv_q} vs offline {off}")
+            # lifecycle legs all populated on a real HTTP run
+            names = srv.obs.hists.names()
+            for name in ("serve/queue_wait_s", "serve/coalesce_wait_s",
+                         "serve/compute_s", "serve/request_s",
+                         "serve/write_s"):
+                assert name in names, names
+            # /stats surfaces histogram-derived request quantiles
+            assert srv.stats()["request_ms"]["p50"] > 0
+        finally:
+            srv.shutdown(drain=True)
+
+    def test_predict_response_carries_trace_id(self, live_server):
+        import urllib.request
+
+        body = json.dumps({"obs": [0.0, 0.0, 0.0]}).encode()
+        req = urllib.request.Request(
+            f"http://{live_server.host}:{live_server.port}/predict",
+            data=body, headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert r.status == 200
+            trace = r.headers.get("X-Trace-Id")
+        assert trace and trace.startswith("r")
+        # the same id is recorded in the batcher's dispatch event — the
+        # causal link from an HTTP answer back to its coalesced batch
+        evs = [e for e in live_server.obs.recorder.events()
+               if e["name"] == "batch_dispatch"]
+        assert any(trace in e.get("traces", []) for e in evs)
+
+
+# =====================================================================
 # supervised serving (resilience integration)
 # =====================================================================
 
