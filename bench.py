@@ -464,6 +464,23 @@ def measure_one(cfg, force_cpu=False):
         "peak_rss_gb": peak_rss,
         "cfg": cfg,
     }
+    hist_out = cfg.get("history_out")
+    if hist_out:
+        # per-generation records for the baseline-capture path: exactly
+        # the keys the regress phase/tail gates consume, written
+        # atomically like every other artifact.  history_skip drops the
+        # leading warm-up/compile records — a committed TAIL baseline
+        # whose p99 is a compile spike would wave real steady-state
+        # regressions through (p99 of ~35 samples is the max sample)
+        skip = max(0, int(cfg.get("history_skip", 1)))
+        keep = ("generation", "env_steps", "env_steps_per_sec",
+                "wall_time_s", "phases", "reward_mean")
+        tmp = hist_out + ".tmp"
+        with open(tmp, "w") as f:
+            for rec in es.history[skip:]:
+                f.write(json.dumps({k: rec[k] for k in keep if k in rec},
+                                   default=float) + "\n")
+        os.replace(tmp, hist_out)
     if shard:
         # peak-memory extras: XLA's per-device argument/output/temp bytes
         # for the compiled (sharded, donated) generation program — with
@@ -1466,6 +1483,119 @@ def stage_regress(baseline: str | None, repeats: int = 3,
     return 0 if verdict["verdict"] == "pass" else 1
 
 
+def stage_capture_baseline(out_path: str | None = None, repeats: int = 3,
+                           gens: int = 12, skip: int = 2,
+                           force_cpu: bool = False) -> int:
+    """``bench.py --capture-baseline``: produce a committed-baseline
+    artifact carrying what ALL the gates need (ROADMAP item 5) — the
+    aggregate headline (median of fresh-process repeats), per-generation
+    ``phase_rows`` embedded so ``obs regress --phases`` and ``--tail``
+    can finally compare against committed history instead of ad-hoc
+    reruns, and the typed device-probe verdict.  Writes the BENCH_r*
+    schema (atomic tmp+rename) and prints the artifact path + headline
+    as JSON lines."""
+    regress = _load_obs_regress()
+    probe = _probe_platform()
+    fell_back = force_cpu or probe.get("status") != "ok"
+    rates: list[float] = []
+    phase_rows: list[dict] = []
+    dtype = platform = None
+    workdir = _bench_workdir()
+    for rep in range(int(repeats)):
+        hist_path = os.path.join(workdir, f"capture_hist_{rep}.jsonl")
+        # skip covers the warm-up generation PLUS the first timed
+        # generation(s): measured captures show the first timed gen
+        # still pays compile/cache-load (~7s dispatch vs ~0.5ms steady),
+        # and a tail baseline must defend steady state, not the warm-up
+        cfg = {**SMALL, "gens": int(gens), "history_out": hist_path,
+               "history_skip": int(skip)}
+        r = run_stage(cfg, timeout_s=1800 if fell_back else 900,
+                      force_cpu=fell_back)
+        row = {"label": "capture/repeat", "rep": rep}
+        if r and r.get("rate"):
+            rates.append(r["rate"])
+            dtype = r.get("dtype") or dtype
+            platform = r.get("platform") or platform
+            row["rate"] = round(r["rate"], 1)
+            try:
+                with open(hist_path) as f:
+                    for ln in f:
+                        rec = json.loads(ln)
+                        rec["repeat"] = rep
+                        phase_rows.append(rec)
+                os.remove(hist_path)
+            except (OSError, ValueError) as e:
+                row["history_error"] = str(e)
+        else:
+            row["rate"] = None
+        print(json.dumps(row), flush=True)
+    if not rates or not phase_rows:
+        print(json.dumps({"label": "capture", "error":
+                          "no successful repeat with phase rows"}),
+              flush=True)
+        return 2
+    rates.sort()
+    n = len(rates)
+    headline = rates[n // 2] if n % 2 else 0.5 * (rates[n // 2 - 1]
+                                                  + rates[n // 2])
+    # per-group p99s ride the extras so a human reading the committed
+    # JSON sees the tail the --tail gate will defend
+    groups = regress.extract_tail_groups(phase_rows)
+    tail_headline = {
+        name: {"p99_s": round(regress._quantile(samples, 0.99), 6),
+               "n": len(samples)}
+        for name, samples in sorted(groups.items())
+    }
+    phases_headline: dict = {}
+    for name, samples in regress.extract_phase_samples(phase_rows).items():
+        ss = sorted(samples)
+        m = len(ss)
+        phases_headline[name] = round(
+            ss[m // 2] if m % 2 else 0.5 * (ss[m // 2 - 1] + ss[m // 2]), 6)
+    artifact = {
+        "n": len(rates),
+        "cmd": "python bench.py --capture-baseline",
+        "rc": 0,
+        "platform": platform,
+        "parsed": {
+            "metric": "env_steps_per_sec_per_chip",
+            "value": round(headline, 1),
+            "unit": (f"env-steps/s/chip (Pendulum MLP64x64 pop4096 h200 "
+                     f"standard/{dtype}, {platform})"),
+        },
+        "extras": {
+            "device_probe": {**probe, "cpu_fallback": fell_back},
+            "repeat_rates": [round(x, 1) for x in rates],
+            "phases_headline": phases_headline,
+            "tail_headline": tail_headline,
+        },
+        # the embedded history the --phases/--tail gates consume
+        # (obs/export/regress.py expand_embedded_rows)
+        "phase_rows": phase_rows,
+    }
+    if out_path is None:
+        here = os.path.dirname(os.path.abspath(__file__))
+        idx = 1
+        import glob
+
+        for p in glob.glob(os.path.join(here, "BENCH_r*.json")):
+            tail = os.path.basename(p)[len("BENCH_r"):-len(".json")]
+            if tail.isdigit():
+                idx = max(idx, int(tail) + 1)
+        out_path = os.path.join(here, f"BENCH_r{idx:02d}.json")
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(artifact, f, indent=2)
+        f.write("\n")
+    os.replace(tmp, out_path)
+    print(json.dumps({"label": "capture", "out": out_path,
+                      "value": artifact["parsed"]["value"],
+                      "n_phase_rows": len(phase_rows),
+                      "phases": sorted(phases_headline)}), flush=True)
+    _cleanup_bench_workdir()
+    return 0
+
+
 class EvidenceLockBusy(Exception):
     """The evidence flock is held by another measurement/study process."""
 
@@ -1665,6 +1795,13 @@ no arguments        full headline benchmark (device probe decides the
   --serve [--selfcheck]   dynamic-batching serving A/B
   --shard-ab [--selfcheck]  replicated vs param-sharded same-seed A/B
                     (numerical match + per-device peak bytes + MFU row)
+  --capture-baseline [--out PATH] [--repeats N] [--gens N] [--skip N] [--cpu]
+                    produce a committed-baseline BENCH_r*.json carrying
+                    the headline median PLUS embedded STEADY-STATE
+                    per-generation phase_rows (--skip drops the leading
+                    warm-up/compile generations per repeat, default 2),
+                    so `obs regress --phases/--tail` gate against
+                    committed history
   --regress [BASELINE] [--repeats N] [--cpu]   gate vs newest BENCH_r*.json
 (--stage-one/--stage-chaos-one/--stage-async-one/--stage-serve-one/
  --stage-shard-ab-one are internal child modes)
@@ -1714,6 +1851,20 @@ if __name__ == "__main__":
     elif "--stage-serve-one" in sys.argv:
         cfg = json.loads(sys.argv[sys.argv.index("--stage-serve-one") + 1])
         print(json.dumps(measure_serve_one(cfg)))
+    elif "--capture-baseline" in sys.argv:
+        _lock_or_warn()
+        _sweep_stale_bench_dirs()
+        kw = {}
+        if "--out" in sys.argv:
+            kw["out_path"] = sys.argv[sys.argv.index("--out") + 1]
+        if "--repeats" in sys.argv:
+            kw["repeats"] = int(sys.argv[sys.argv.index("--repeats") + 1])
+        if "--gens" in sys.argv:
+            kw["gens"] = int(sys.argv[sys.argv.index("--gens") + 1])
+        if "--skip" in sys.argv:
+            kw["skip"] = int(sys.argv[sys.argv.index("--skip") + 1])
+        sys.exit(stage_capture_baseline(force_cpu="--cpu" in sys.argv,
+                                        **kw))
     elif "--regress" in sys.argv:
         _lock_or_warn()
         idx = sys.argv.index("--regress")

@@ -1,5 +1,6 @@
 """Host-side robustness rules: R05 untimed-subprocess-wait,
-R06 signature-probe-default, R11 blocking-wait-in-scheduler.
+R06 signature-probe-default, R11 blocking-wait-in-scheduler,
+R13 untimed-network-call.
 
 R05 is the wedge class ``doctor.py`` exists to detect after the fact:
 a ``proc.wait()`` / ``proc.communicate()`` with no timeout turns a hung
@@ -20,6 +21,14 @@ that blocks unbounded on ``queue.get()``, ``thread.join()``, or a pipe
 worker that died mid-message) into a wedged scheduler, invisible to the
 heartbeat because the loop never reaches its next beat.  Every blocking
 point in an event-driven hot path must wake on a bounded slice.
+
+R13 is the R05 discipline lifted to SOCKETS — the hazard class the
+fleet collector (obs/agg/) made systemic: a ``urllib.request.urlopen``
+or ``http.client.HTTPConnection`` without ``timeout=`` inherits the
+global socket default (None: block forever), so one replica that
+accepts the TCP connection and then goes silent wedges the scraper,
+the client, or the doctor probe that called it.  CPython's own default
+timeouts are None throughout; the bound must be at the call site.
 """
 
 from __future__ import annotations
@@ -244,6 +253,63 @@ def check_blocking_wait(ctx: ModuleContext):
                         "multiprocessing.connection.wait with a timeout) "
                         "before recv, so the wait is bounded",
                         symbol))
+    return out
+
+
+# ---------------------------------------------------------------------
+# R13 untimed-network-call
+# ---------------------------------------------------------------------
+
+# resolved dotted name -> positional index where `timeout` lands
+# (urlopen(url, data, timeout); HTTPConnection(host, port, timeout);
+# HTTPSConnection(host, port, key_file, cert_file, timeout) — the
+# deprecated TLS params sit BEFORE timeout; create_connection(address,
+# timeout, ...))
+_NET_CALLS = {
+    "urllib.request.urlopen": 2,
+    "http.client.HTTPConnection": 2,
+    "http.client.HTTPSConnection": 4,
+    "socket.create_connection": 1,
+}
+
+
+def _net_has_timeout(call: ast.Call, pos_index: int) -> bool:
+    kw = _kw(call, "timeout")
+    if kw is not None:
+        return not (isinstance(kw.value, ast.Constant)
+                    and kw.value.value is None)
+    if len(call.args) <= pos_index:
+        return False
+    # a positional literal None is spelling the unbounded default,
+    # exactly like timeout=None
+    arg = call.args[pos_index]
+    return not (isinstance(arg, ast.Constant) and arg.value is None)
+
+
+@rule("R13", "untimed-network-call", "error",
+      "network connect/read without a timeout can wedge the host on one "
+      "silent peer")
+def check_untimed_network(ctx: ModuleContext):
+    r = get_rule("R13")
+    out = []
+    for symbol, scope in iter_scopes(ctx):
+        for node in scope_nodes(scope):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = ctx.resolve(node.func)
+            if resolved not in _NET_CALLS:
+                continue
+            if _net_has_timeout(node, _NET_CALLS[resolved]):
+                continue
+            out.append(make_finding(
+                ctx, r, node,
+                f"`{resolved}` without timeout — the global socket "
+                "default is None (block forever), so one peer that "
+                "accepts and goes silent wedges this host",
+                "pass timeout=... at the call site and handle the "
+                "TimeoutError/OSError (count it, retry, or mark the "
+                "peer down)",
+                symbol))
     return out
 
 

@@ -700,6 +700,108 @@ def check_serve(bundle: str | None = None) -> dict:
     return out
 
 
+def check_collector() -> dict:
+    """Can this host run the fleet-aggregation plane?  (obs/agg/,
+    docs/observability.md "Fleet aggregation")
+
+    Loopback end-to-end probe: spin a synthetic target (the metrics
+    sidecar over a temp run dir with a fresh heartbeat), point a
+    collector with an absence rule at it PLUS a dead port, run one
+    collection tick, and assert the full chain — sample stored in the
+    time-series store, rules evaluated (the dead target's absence rule
+    fires, the live one's does not), and the collector's ``/alerts`` and
+    ``/metrics`` parse over loopback.  Stdlib only, never touches jax,
+    and never crashes the report: a refused port or any other failure
+    comes back as ``{"ok": False, "error"/"problems": ...}``."""
+    import json as _json
+    import os
+    import socket
+    import tempfile
+    import time as _time
+    import urllib.request
+
+    try:
+        from .obs.agg.collector import Collector, Target
+        from .obs.agg.rules import RulesEngine
+        from .obs.agg.store import SeriesStore
+        from .obs.export.prometheus import parse_exposition
+        from .obs.export.sidecar import MetricsSidecar
+
+        problems = []
+        with tempfile.TemporaryDirectory() as d:
+            run_dir = os.path.join(d, "run")
+            os.makedirs(run_dir)
+            with open(os.path.join(run_dir, "heartbeat.json"), "w") as f:
+                _json.dump({"ts": _time.time(), "pid": os.getpid(),
+                            "phase": "doctor_probe", "generation": 1,
+                            "counters": {"env_steps": 3}}, f)
+            sidecar = MetricsSidecar(run_dir, port=0)
+            sidecar.start_background()
+            # bound-but-not-listening: connects get RST for the whole
+            # probe (closing it would race the port back to the
+            # allocator, which could hand it to the collector itself)
+            dead_sock = socket.socket()
+            dead_sock.bind(("127.0.0.1", 0))
+            dead_port = dead_sock.getsockname()[1]
+            col = None
+            try:
+                store = SeriesStore(os.path.join(d, "store"))
+                rules = RulesEngine([
+                    {"name": "replica-down", "kind": "absence",
+                     "metric": "estorch_up", "for_s": 0, "window_s": 30},
+                ])
+                col = Collector(
+                    [Target("probe-run",
+                            url=f"http://{sidecar.host}:{sidecar.port}"
+                                "/metrics", timeout_s=5.0),
+                     Target("probe-dead",
+                            url=f"http://127.0.0.1:{dead_port}/metrics",
+                            timeout_s=0.5)],
+                    store, rules, port=0)
+                col.start_background()
+                now = _time.time()
+                tick = col.tick(now)
+                if not tick["targets"]["probe-run"]["ok"]:
+                    problems.append(
+                        f"live target scrape failed: {tick}")
+                stored = store.latest("estorch_env_steps",
+                                      {"target": "probe-run"},
+                                      window_s=60, now=now)
+                if not stored:
+                    problems.append("scraped sample not found in store")
+                fired = {(t["rule"], t["target"])
+                         for t in tick["transitions"]
+                         if t["event"] == "firing"}
+                if ("replica-down", "probe-dead") not in fired:
+                    problems.append(
+                        f"absence rule did not fire for the dead "
+                        f"target: {fired}")
+                if ("replica-down", "probe-run") in fired:
+                    problems.append("absence rule fired for the live "
+                                    "target")
+                base = f"http://{col.host}:{col.port}"
+                with urllib.request.urlopen(base + "/alerts",
+                                            timeout=10) as resp:
+                    alerts = _json.loads(resp.read().decode())
+                if not any(a["rule"] == "replica-down"
+                           and a["target"] == "probe-dead"
+                           for a in alerts["active"]):
+                    problems.append(f"/alerts missing the active "
+                                    f"absence alert: {alerts}")
+                with urllib.request.urlopen(base + "/metrics",
+                                            timeout=10) as resp:
+                    parse_exposition(resp.read().decode())
+            finally:
+                if col is not None:
+                    col.close()
+                dead_sock.close()
+                sidecar.close()
+        return {"ok": not problems,
+                **({"problems": problems} if problems else {})}
+    except Exception as e:  # diagnostic tool: never crash the report
+        return {"ok": False, "error": repr(e)}
+
+
 def report(timeout_s: float = 45.0, run_dir: str | None = None,
            resilience_probe: bool = False,
            serve_bundle: str | None = None) -> dict:
@@ -732,6 +834,7 @@ def report(timeout_s: float = 45.0, run_dir: str | None = None,
         "optional": check_optional_deps(),
         "host": check_host(),
         "obs": check_obs(run_dir),
+        "collector": check_collector(),
         "resilience": check_resilience(probe=resilience_probe),
         "serve": check_serve(bundle=serve_bundle),
     }

@@ -1,4 +1,5 @@
-"""obs CLI: summarize / trace / profile / regress / serve-metrics.
+"""obs CLI: summarize / trace / profile / regress / hist / serve-metrics
+/ collect / dash.
 
 Subcommands (docs/observability.md):
 
@@ -53,8 +54,23 @@ Subcommands (docs/observability.md):
       supervisor-published counter totals).  On a wedged-jax host run it
       as a file instead: ``python estorch_tpu/obs/export/sidecar.py``.
 
+  collect --targets targets.json --store DIR [--rules rules.json]
+      Fleet metrics collector (obs/agg/, docs/observability.md "Fleet
+      aggregation"): scrape every configured Prometheus endpoint and
+      heartbeat run-dir each tick, land samples in the local time-series
+      store, evaluate the declarative SLO/alert rules, and serve the
+      collector's own /metrics and /alerts.  ``collect --selfcheck`` is
+      the run_lint.sh gate.  Wedged-host file form:
+      ``python estorch_tpu/obs/agg/collector.py``.
+
+  dash --store DIR [--once | --watch SECS] [--window S] [--json]
+      Terminal fleet console over a collector store: per-target up/down,
+      stored-history request/dispatch quantiles, queue depth, recompile
+      increase, active alerts.  File form:
+      ``python estorch_tpu/obs/agg/dash.py``.
+
 Exit codes: 0 ok; 1 selfcheck problems / unreadable input / regression;
-2 bad run dir; 3 bad usage.
+2 bad run dir / bad targets or rules file; 3 bad usage.
 """
 
 from __future__ import annotations
@@ -171,6 +187,16 @@ def build_parser() -> argparse.ArgumentParser:
     m.add_argument("--port", type=int, default=9321)
     m.add_argument("--port-file", default=None, metavar="PATH")
     m.add_argument("--stale-after-s", type=float, default=None)
+
+    # collect / dash own their full argparse surfaces (obs/agg/) — the
+    # remainder is handed through so the module and file forms accept
+    # identical flags
+    sub.add_parser("collect", add_help=False,
+                   help="fleet metrics collector over targets.json "
+                        "(obs/agg/collector.py owns the flags)")
+    sub.add_parser("dash", add_help=False,
+                   help="terminal fleet console over a collector store "
+                        "(obs/agg/dash.py owns the flags)")
     return p
 
 
@@ -467,6 +493,18 @@ def _cmd_serve_metrics(args) -> int:
 
 
 def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    # collect/dash delegate whole (obs/agg owns their argparse surface,
+    # so the module form and the wedged-host file form accept identical
+    # flags) — parsing them here would force every flag to exist twice
+    if argv[:1] == ["collect"]:
+        from .agg import collector as _collector
+
+        return _collector.main(argv[1:])
+    if argv[:1] == ["dash"]:
+        from .agg import dash as _dash
+
+        return _dash.main(argv[1:])
     args = build_parser().parse_args(argv)
     if args.cmd == "summarize":
         return _cmd_summarize(args)

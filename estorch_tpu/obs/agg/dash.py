@@ -1,0 +1,217 @@
+"""``obs dash`` — the fleet on one terminal screen.
+
+Renders the collector's STORED history (never a live endpoint — the
+whole point of the store is that the dash works while a replica is dead)
+as a per-target table:
+
+    target     up   gen    req p50/p99 ms   disp p99 ms   queue   recomp   alerts
+    serve-a    UP    -        1.2 / 4.8          -            0        2    -
+    serve-b    DOWN  -          -  /  -          -            -        -    replica-down
+    run-1      UP    41         -  /  -         3.1           -        0    -
+
+Columns come from the stored metric names every surface already exports:
+``estorch_up`` (liveness), ``estorch_heartbeat_generation`` (training
+progress), ``estorch_serve_request_s`` (request-latency histogram →
+p50/p99), ``estorch_async_fold_latency_s`` (dispatch-to-fold p99 for
+training runs), ``estorch_queue_depth``, ``estorch_recompiles``
+(windowed increase, reset-aware), plus the active alerts from the
+ledger.  Missing metrics render as ``-`` — a training run has no
+request latencies and a serve replica has no generations, and the dash
+must say so rather than fabricate.
+
+``--once`` prints one frame (scriptable, CI-friendly); ``--watch N``
+redraws every N seconds until interrupted.
+
+Stdlib-only, file-runnable (``python estorch_tpu/obs/agg/dash.py``) —
+the wedged-host discipline shared with the sidecar and collector.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+if __package__:
+    from .rules import (LEDGER_FILENAME, LEDGER_MAX_TRANSITIONS,
+                        read_ledger)
+    from .store import SeriesStore
+else:  # file-run: load siblings without any package init
+    import importlib.util
+
+    def _load(name: str, fname: str):
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            fname)
+        spec = importlib.util.spec_from_file_location(name, path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    _store = _load("_estorch_obs_agg_store", "store.py")
+    _rules = _load("_estorch_obs_agg_rules", "rules.py")
+    SeriesStore = _store.SeriesStore
+    read_ledger = _rules.read_ledger
+    LEDGER_FILENAME = _rules.LEDGER_FILENAME
+    LEDGER_MAX_TRANSITIONS = _rules.LEDGER_MAX_TRANSITIONS
+
+REQUEST_HIST = "estorch_serve_request_s"
+DISPATCH_HIST = "estorch_async_fold_latency_s"
+
+
+def _fmt_ms(v: float | None) -> str:
+    return f"{v * 1e3:.1f}" if v is not None else "-"
+
+
+def _fmt_num(v: float | None) -> str:
+    if v is None:
+        return "-"
+    return f"{int(v)}" if float(v) == int(v) else f"{v:g}"
+
+
+def fleet_snapshot(store_root: str, *, window_s: float = 60.0,
+                   now: float | None = None,
+                   store: "SeriesStore | None" = None) -> dict:
+    """The dash's data model: per-target rows + active alerts, all from
+    the store + ledger (machine-readable half of :func:`render`).
+
+    Pass ``store`` to reuse one :class:`SeriesStore` across frames —
+    watch mode does, so sealed segments stay memoized instead of being
+    re-parsed on every redraw."""
+    now = time.time() if now is None else float(now)
+    store = SeriesStore(store_root) if store is None else store
+    targets = store.label_values("estorch_up", "target", window_s, now)
+    # active = fired and not since resolved, reconstructed from the
+    # ledger so the dash needs no live collector to agree with /alerts;
+    # the tail matches the ledger's own compaction bound — a shorter
+    # read could drop an old still-firing transition and show resolved
+    active: dict[tuple[str, str], dict] = {}
+    for t in read_ledger(os.path.join(store_root, LEDGER_FILENAME),
+                         tail=LEDGER_MAX_TRANSITIONS):
+        key = (str(t.get("rule")), str(t.get("target")))
+        if t.get("event") == "firing":
+            active[key] = t
+        elif t.get("event") == "resolved":
+            active.pop(key, None)
+    rows = []
+    for name in targets:
+        labels = {"target": name}
+
+        def latest(metric: str) -> float | None:
+            got = store.latest(metric, labels, window_s, now)
+            if not got:
+                return None
+            return max(got.values(), key=lambda x: x[0])[2]
+
+        up = latest("estorch_up")
+        rows.append({
+            "target": name,
+            "up": bool(up == 1.0),
+            "generation": latest("estorch_heartbeat_generation"),
+            "req_p50_s": store.quantile(REQUEST_HIST, 0.50, labels,
+                                        window_s, now),
+            "req_p99_s": store.quantile(REQUEST_HIST, 0.99, labels,
+                                        window_s, now),
+            "dispatch_p99_s": store.quantile(DISPATCH_HIST, 0.99, labels,
+                                             window_s, now),
+            "queue_depth": latest("estorch_queue_depth"),
+            "recompiles": store.increase("estorch_recompiles", labels,
+                                         window_s, now),
+            "alerts": sorted(rule for (rule, tgt) in active
+                             if tgt == name),
+        })
+    return {"ts": now, "window_s": float(window_s), "targets": rows,
+            "active_alerts": [
+                {"rule": rule, "target": tgt,
+                 "detail": ev.get("detail", "")}
+                for (rule, tgt), ev in sorted(active.items())]}
+
+
+def render(store_root: str, *, window_s: float = 60.0,
+           now: float | None = None,
+           store: "SeriesStore | None" = None) -> str:
+    """One human frame of the fleet (see module docstring)."""
+    snap = fleet_snapshot(store_root, window_s=window_s, now=now,
+                          store=store)
+    header = ("target", "up", "gen", "req p50/p99 ms", "disp p99 ms",
+              "queue", "recomp", "alerts")
+    table = [header]
+    for row in snap["targets"]:
+        table.append((
+            row["target"],
+            "UP" if row["up"] else "DOWN",
+            _fmt_num(row["generation"]),
+            f"{_fmt_ms(row['req_p50_s'])} / {_fmt_ms(row['req_p99_s'])}",
+            _fmt_ms(row["dispatch_p99_s"]),
+            _fmt_num(row["queue_depth"]),
+            _fmt_num(row["recompiles"]),
+            ",".join(row["alerts"]) or "-",
+        ))
+    widths = [max(len(str(r[i])) for r in table)
+              for i in range(len(header))]
+    lines = [f"fleet @ {time.strftime('%H:%M:%S', time.localtime(snap['ts']))}"
+             f" (window {snap['window_s']:g}s, {len(snap['targets'])} "
+             f"target(s), {len(snap['active_alerts'])} active alert(s))"]
+    for j, r in enumerate(table):
+        lines.append("  ".join(str(c).ljust(w)
+                               for c, w in zip(r, widths)).rstrip())
+        if j == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    for a in snap["active_alerts"]:
+        lines.append(f"ALERT {a['rule']} [{a['target']}]: {a['detail']}")
+    if not snap["targets"]:
+        lines.append("(no targets in window — is the collector running "
+                     "against this store?)")
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m estorch_tpu.obs dash",
+        description="terminal fleet console over a collector store "
+                    "(docs/observability.md, 'Fleet aggregation')")
+    p.add_argument("--store", required=True, metavar="DIR",
+                   help="the collector's --store directory")
+    p.add_argument("--window", type=float, default=60.0,
+                   help="history window in seconds (default 60)")
+    p.add_argument("--once", action="store_true",
+                   help="print one frame and exit (default)")
+    p.add_argument("--watch", type=float, default=None, metavar="SECS",
+                   help="redraw every SECS seconds until interrupted")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable snapshot instead of the table")
+    args = p.parse_args(argv)
+    if not os.path.isdir(args.store):
+        print(f"dash: no such store dir {args.store!r}", file=sys.stderr)
+        return 2
+
+    # ONE store across frames: watch mode redraws every few seconds and
+    # the store's sealed-segment memo cache only pays off if it survives
+    # the frame loop
+    store = SeriesStore(args.store)
+
+    def frame() -> str:
+        if args.as_json:
+            return json.dumps(fleet_snapshot(args.store,
+                                             window_s=args.window,
+                                             store=store),
+                              default=float)
+        return render(args.store, window_s=args.window, store=store)
+
+    if args.watch is None or args.once:
+        print(frame())
+        return 0
+    try:
+        while True:
+            # ANSI home+clear keeps the frame in place without pulling in
+            # curses; harmless when redirected to a file
+            sys.stdout.write("\x1b[H\x1b[2J" + frame() + "\n")
+            sys.stdout.flush()
+            time.sleep(max(0.2, args.watch))
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -261,24 +261,49 @@ def compare_files(current_path: str, baseline_path: str,
 # phase-localized gate: per-phase medians with per-phase noise bands
 # ---------------------------------------------------------------------
 
+def expand_embedded_rows(rows: list[dict]) -> list[dict]:
+    """BENCH_r06+ artifacts carry their per-generation phase records and
+    per-request latency rows EMBEDDED (``phase_rows`` / ``tail_rows``
+    lists), so one committed JSON file is both the aggregate baseline
+    and the phase/tail baseline.  This flattens them for the phase and
+    tail extractors; the aggregate extractor deliberately does NOT
+    expand (embedded per-generation rates are per-host, the headline
+    ``parsed.value`` is per-chip — mixing units would corrupt the
+    median)."""
+    out: list[dict] = []
+    for row in rows:
+        if not isinstance(row, dict):
+            continue
+        out.append(row)
+        for key in ("phase_rows", "tail_rows"):
+            sub = row.get(key)
+            if isinstance(sub, list):
+                out.extend(r for r in sub if isinstance(r, dict))
+    return out
+
+
 def extract_phase_samples(records: list[dict]) -> dict[str, list[float]]:
     """Per-generation seconds for every TOP-LEVEL phase across a run's
     records (``record["phases"]``; nested ``parent/child`` spans are the
     parent's internal breakdown and are not separately gated).
     Supervisor-replayed generations are deduped keeping the last, the
     same rule the aggregate extractor applies."""
-    gen_last: dict[int, dict] = {}
-    order: list[int] = []
+    gen_last: dict[tuple, dict] = {}
+    order: list[tuple] = []
     anon: list[dict] = []
-    for row in records:
-        if not isinstance(row, dict) or not isinstance(
-                row.get("phases"), dict):
+    for row in expand_embedded_rows(records):
+        if not isinstance(row.get("phases"), dict):
             continue
         g = row.get("generation")
         if isinstance(g, int):
-            if g not in gen_last:
-                order.append(g)
-            gen_last[g] = row["phases"]
+            # replay dedup is per measurement run: embedded baseline rows
+            # carry a 'repeat' stamp (bench --capture-baseline), and
+            # collapsing generation g across repeats would silently drop
+            # all but the last repeat's samples
+            key = (row.get("repeat"), g)
+            if key not in gen_last:
+                order.append(key)
+            gen_last[key] = row["phases"]
         else:
             anon.append(row["phases"])
     out: dict[str, list[float]] = {}
@@ -300,12 +325,22 @@ def compare_phases(current: list[dict], baseline: list[dict],
     the mirror of the rate gate's below."""
     cur_phases = extract_phase_samples(current)
     base_phases = extract_phase_samples(baseline)
+    # mixed-schema degrade: a side with NO phase rows at all (a pre-r06
+    # BENCH artifact, or a telemetry-off run) gets a one-line diagnosis
+    # naming the side — not a traceback, and never a bogus verdict
+    if not base_phases or not cur_phases:
+        side = "baseline" if not base_phases else "current"
+        raise ValueError(
+            f"{side} measurement carries no per-phase rows — a pre-r06 "
+            "BENCH artifact (no embedded 'phase_rows') or a "
+            "telemetry-disabled run; pick a baseline captured with "
+            "`bench.py --capture-baseline` (BENCH_r06+) or a run JSONL "
+            "with 'phases' records")
     shared = sorted(set(cur_phases) & set(base_phases))
     if not shared:
         raise ValueError(
-            "no shared top-level phases between the two runs (records "
-            "missing 'phases' spans — telemetry disabled, or pre-PR-2 "
-            "runs)")
+            "no shared top-level phases between the two runs (phase "
+            "names disjoint — different engines or renamed spans?)")
     phases: dict[str, dict] = {}
     regressed: list[str] = []
     for name in shared:
@@ -400,34 +435,36 @@ def extract_tail_groups(rows: list[dict]) -> dict[str, list[float]]:
     top-level phase seconds (replay-deduped, like the phase gate) plus a
     ``wall_time_s`` group."""
     groups: dict[str, list[float]] = {}
-    for row in rows:
-        if not isinstance(row, dict):
-            continue
+    # extract_phase_samples expands embedded rows ITSELF — it must see
+    # the original rows, or the still-embedded copies inside the outer
+    # row would be walked twice and double-count generation-less records
+    for name, samples in extract_phase_samples(rows).items():
+        groups.setdefault(name, []).extend(samples)
+    expanded = expand_embedded_rows(rows)
+    for row in expanded:
         v = row.get("latency_s")
         if (isinstance(v, (int, float)) and not isinstance(v, bool)
                 and math.isfinite(v)):
             name = str(row.get("endpoint") or "latency")
             groups.setdefault(name, []).append(float(v))
-    for name, samples in extract_phase_samples(rows).items():
-        groups.setdefault(name, []).extend(samples)
     # wall_time_s follows the same replay-dedup rule as the phase
     # samples above: a supervisor-replayed generation appears twice in
-    # the JSONL and must not be double-weighted in the quantile
-    gen_last: dict[int, float] = {}
-    order: list[int] = []
+    # the JSONL and must not be double-weighted in the quantile (but a
+    # different 'repeat' is a different measurement run, not a replay)
+    gen_last: dict[tuple, float] = {}
+    order: list[tuple] = []
     anon: list[float] = []
-    for r in rows:
-        if not isinstance(r, dict):
-            continue
+    for r in expanded:
         w = r.get("wall_time_s")
         if (not isinstance(w, (int, float)) or isinstance(w, bool)
                 or not math.isfinite(w)):
             continue
         g = r.get("generation")
         if isinstance(g, int):
-            if g not in gen_last:
-                order.append(g)
-            gen_last[g] = float(w)
+            key = (r.get("repeat"), g)
+            if key not in gen_last:
+                order.append(key)
+            gen_last[key] = float(w)
         else:
             anon.append(float(w))
     walls = [gen_last[g] for g in order] + anon
@@ -449,12 +486,21 @@ def compare_tail(current: list[dict], baseline: list[dict],
                          f"{quantile}")
     cur_groups = extract_tail_groups(current)
     base_groups = extract_tail_groups(baseline)
+    # mixed-schema degrade (same contract as compare_phases): an empty
+    # side is diagnosed on one line naming the side and the fix
+    if not base_groups or not cur_groups:
+        side = "baseline" if not base_groups else "current"
+        raise ValueError(
+            f"{side} measurement carries no tail rows — a pre-r06 BENCH "
+            "artifact (no embedded 'phase_rows'/'tail_rows') or a "
+            "measurement without {'latency_s','endpoint'} / "
+            "'phases'/'wall_time_s' records; re-capture with `bench.py "
+            "--capture-baseline` or `loadgen --latencies-out`")
     shared = sorted(set(cur_groups) & set(base_groups))
     if not shared:
         raise ValueError(
-            "no shared tail groups between the two measurements (expected "
-            "{'latency_s','endpoint'} rows or run-JSONL records with "
-            "'phases'/'wall_time_s')")
+            "no shared tail groups between the two measurements (group "
+            "names disjoint — different endpoints or renamed phases?)")
     qname = f"p{quantile * 100:g}"
     groups: dict[str, dict] = {}
     regressed: list[str] = []

@@ -286,9 +286,24 @@ def main(argv: list[str] | None = None) -> int:
         return 2
     sidecar = MetricsSidecar(args.run_dir, host=args.host, port=args.port,
                              stale_after_s=args.stale_after_s)
+    # ready-to-paste targets.json entry for the fleet collector
+    # (obs/agg/) — same stanza (and same wildcard-bind substitution) as
+    # the serve server's /stats: 0.0.0.0 is not routable FROM the
+    # collector's host, so pasting it would scrape the wrong machine
+    stanza_host = sidecar.host
+    if stanza_host in ("0.0.0.0", "::", ""):
+        import socket as _socket
+
+        stanza_host = _socket.getfqdn() or _socket.gethostname()
     print(json.dumps({"ready": True,
                       "url": f"http://{sidecar.host}:{sidecar.port}",
-                      "run_dir": sidecar.run_dir, "pid": os.getpid()}),
+                      "run_dir": sidecar.run_dir, "pid": os.getpid(),
+                      "collector_target": {
+                          "name": os.path.basename(sidecar.run_dir)
+                                  or "run",
+                          "url": f"http://{stanza_host}:{sidecar.port}"
+                                 "/metrics",
+                      }}),
           flush=True)
     if args.port_file:
         tmp = args.port_file + ".tmp"

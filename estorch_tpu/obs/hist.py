@@ -341,6 +341,43 @@ def merge_snapshots(total: dict | None, snaps: dict | None) -> dict:
     return out
 
 
+def snapshot_from_export(series: dict,
+                         lo: float = DEFAULT_LO,
+                         per_decade: int = DEFAULT_PER_DECADE,
+                         decades: int = DEFAULT_DECADES) -> dict | None:
+    """Scraped Prometheus histogram series (``histogram_series`` shape:
+    cumulative ``(le, count)`` pairs + sum + count) → a ``to_dict``
+    snapshot on the given ladder, or None when the ``le`` edges are not
+    this ladder's (a foreign histogram must not be resampled into a
+    fabricated distribution — the fleet collector stores it as scalars
+    only).  The inverse of :meth:`Histogram.to_export` modulo the elided
+    zero-delta edges, which is what lets a collector that only ever saw
+    the text exposition still merge windows with :func:`merge_snapshots`."""
+    h = Histogram(lo=lo, decades=decades, per_decade=per_decade)
+    buckets = series.get("buckets") or []
+    prev_cum = 0
+    for le, cum in buckets:
+        if math.isinf(le):
+            i = h.n + 1
+        else:
+            if le <= 0:
+                return None
+            e = math.log10(le / h.lo) * h.per_decade
+            i = round(e)
+            if not 0 <= i <= h.n or abs(h.bound(i) - le) > 1e-9 * le:
+                return None  # not this ladder's edge
+        delta = int(cum) - prev_cum
+        if delta < 0:
+            return None  # cumulative counts must not decrease
+        prev_cum = int(cum)
+        if delta:
+            h._counts[i] += delta
+    h._count = int(series.get("count") or prev_cum)
+    h._sum = float(series.get("sum") or 0.0)
+    h._exact = None  # the exposition never carries raw samples
+    return h.to_dict()
+
+
 def export_snapshots(snaps: dict | None) -> dict[str, dict]:
     """Snapshot dicts → Prometheus export shape; unparseable entries are
     skipped (a foreign/hand-edited file must not take the scrape down)."""

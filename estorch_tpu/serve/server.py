@@ -229,6 +229,22 @@ class PolicyServer:
             histograms=self.obs.hists.export() or None,
         )
 
+    def _collector_target(self) -> dict:
+        """Ready-to-paste targets.json entry.  A wildcard bind address
+        (0.0.0.0 / ::) is not routable FROM the collector's host — an
+        operator pasting it would scrape the collector's own loopback —
+        so substitute this machine's name, which is what a remote
+        collector must dial anyway."""
+        host = self.host
+        if host in ("0.0.0.0", "::", ""):
+            import socket as _socket
+
+            host = _socket.getfqdn() or _socket.gethostname()
+        return {
+            "name": f"serve-{host}-{self.port}",
+            "url": f"http://{host}:{self.port}/metrics",
+        }
+
     def stats(self) -> dict:
         eng = self._engine
         return {
@@ -238,6 +254,11 @@ class PolicyServer:
             "obs_shape": list(eng.bundle.obs_shape),
             "max_wait_ms": self.max_wait_ms,
             "counters": self.obs.counters.snapshot(),
+            # collector-discovery stanza (obs/agg/, docs/observability.md
+            # "Fleet aggregation"): a ready-to-paste targets.json entry,
+            # so enrolling this replica in the fleet collector is a copy,
+            # not a transcription
+            "collector_target": self._collector_target(),
             **eng.batcher.stats(),
         }
 

@@ -41,6 +41,21 @@ echo "== obs hist selfcheck =="
 # Prometheus exposition round trips.  Stdlib, milliseconds.
 python -m estorch_tpu.obs hist --selfcheck
 
+echo "== obs collect selfcheck =="
+# fleet-collector gate (estorch_tpu/obs/agg/): synthetic healthy /
+# garbage / dead-port targets under one collector — every tick must
+# tolerate the dead pair, absence + burn-rate rules must fire NAMING the
+# target, stored quantiles must sit inside the histogram ladder's
+# documented bound, and the collector's own /metrics + /alerts must
+# parse.  Stdlib, ~seconds.
+python -m estorch_tpu.obs collect --selfcheck
+
+echo "== collector file-run probe =="
+# the wedged-host contract, proven the same way the sidecar/loadgen
+# prove it: the collector runs AS A FILE (no package import, no jax)
+# and still passes the full selfcheck
+python estorch_tpu/obs/agg/collector.py --selfcheck
+
 echo "== obs regress tail selfcheck =="
 # tail-gate gate (estorch_tpu/obs/export/regress.py compare_tail): a
 # median-clean pair with ~2% of requests slowed 5x (the chaos-shed

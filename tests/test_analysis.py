@@ -1196,6 +1196,126 @@ class TestR12:
 
 
 # ---------------------------------------------------------------------
+# R13 untimed-network-call
+# ---------------------------------------------------------------------
+
+class TestR13:
+    def test_urlopen_without_timeout_flagged(self):
+        """The motivating hazard: the fleet collector scrapes N replicas
+        every tick — one peer that accepts the TCP connection and then
+        goes silent would wedge the whole loop through the global socket
+        default (None = block forever)."""
+        found = findings("""
+            import urllib.request
+
+            def scrape(url):
+                with urllib.request.urlopen(url) as r:
+                    return r.read()
+        """, "R13")
+        assert len(found) == 1
+        assert "urlopen" in found[0].message
+        assert "timeout" in found[0].hint
+
+    def test_http_client_ctor_without_timeout_flagged(self):
+        found = findings("""
+            import http.client
+
+            def connect(host):
+                return http.client.HTTPConnection(host, 80)
+        """, "R13")
+        assert len(found) == 1
+
+    def test_create_connection_without_timeout_flagged(self):
+        found = findings("""
+            import socket
+
+            def connect(addr):
+                return socket.create_connection(addr)
+        """, "R13")
+        assert len(found) == 1
+
+    def test_timeout_none_still_flagged(self):
+        """timeout=None is SPELLING the unbounded default, not bounding
+        it — same treatment R05 gives wait(timeout=None); the positional
+        form too."""
+        found = findings("""
+            import urllib.request
+
+            def scrape(url):
+                return urllib.request.urlopen(url, timeout=None).read()
+        """, "R13")
+        assert len(found) == 1
+        found = findings("""
+            import urllib.request
+
+            def scrape(url):
+                return urllib.request.urlopen(url, None, None).read()
+        """, "R13")
+        assert len(found) == 1
+
+    def test_https_ctor_positional_tls_params_not_a_timeout(self):
+        """HTTPSConnection's 3rd/4th positionals are key_file/cert_file
+        — only the FIFTH positional is timeout, and mistaking the TLS
+        params for it would be a false negative on an unbounded
+        connect."""
+        found = findings("""
+            import http.client
+
+            def connect(host, kf, cf):
+                return http.client.HTTPSConnection(host, 443, kf, cf)
+        """, "R13")
+        assert len(found) == 1
+        assert not findings("""
+            import http.client
+
+            def connect(host, kf, cf, t):
+                return http.client.HTTPSConnection(host, 443, kf, cf, t)
+        """, "R13")
+
+    def test_bounded_calls_clean(self):
+        """Keyword and positional timeouts both count — urlopen's
+        timeout is its third positional, create_connection's second."""
+        assert not findings("""
+            import http.client
+            import socket
+            import urllib.request
+
+            def ok(url, addr, host, t):
+                a = urllib.request.urlopen(url, timeout=10).read()
+                b = urllib.request.urlopen(url, None, t).read()
+                c = socket.create_connection(addr, 2.0)
+                d = http.client.HTTPConnection(host, 80, timeout=3)
+                return a, b, c, d
+        """, "R13")
+
+    def test_unrelated_open_clean(self):
+        """builtins.open / file reads are not network connects."""
+        assert not findings("""
+            def read(path):
+                with open(path) as f:
+                    return f.read()
+        """, "R13")
+
+    def test_network_modules_self_clean(self):
+        """Self-application across every socket-touching module the rule
+        was written for: the serve client, the loadgen, the sidecar, the
+        doctor's probes, and the fleet collector."""
+        import estorch_tpu.doctor as doctor
+        import estorch_tpu.obs.agg.collector as collector
+        import estorch_tpu.obs.agg.dash as dash
+        import estorch_tpu.obs.export.sidecar as sidecar
+        import estorch_tpu.serve.client as client
+        import estorch_tpu.serve.loadgen as loadgen
+
+        for mod in (client, loadgen, sidecar, doctor, collector, dash):
+            with open(mod.__file__) as f:
+                src = f.read()
+            hits = [x for x in analyze_source(mod.__file__, src)
+                    if x.rule == "R13"]
+            assert not hits, [h.message for h in hits]
+
+
+# ---------------------------------------------------------------------
 # engine / CLI / config / baseline mechanics
 # ---------------------------------------------------------------------
 
@@ -1220,7 +1340,7 @@ class TestEngine:
     def test_every_rule_registered(self):
         ids = [r.id for r in all_rules()]
         assert ids == ["R01", "R02", "R03", "R04", "R05", "R06", "R07",
-                       "R08", "R09", "R10", "R11", "R12"]
+                       "R08", "R09", "R10", "R11", "R12", "R13"]
 
     def test_syntax_error_becomes_finding(self):
         found = analyze_source("bad.py", "def broken(:\n")
@@ -1354,7 +1474,7 @@ class TestConfig:
         assert cfg.baseline == "esguard_baseline.json"
         assert cfg.rule_ids([r.id for r in all_rules()]) == [
             "R01", "R02", "R03", "R04", "R05", "R06", "R07", "R08", "R09",
-            "R10", "R11", "R12"]
+            "R10", "R11", "R12", "R13"]
 
 
 class TestCLI:

@@ -277,6 +277,43 @@ class TestObsCheck:
         assert "bind refused" in probe["error"]
 
 
+class TestCollectorCheck:
+    def test_collector_probe_end_to_end(self):
+        """check_collector: synthetic sidecar target + dead port under a
+        real collector for one tick — stored sample, rules evaluation
+        (dead fires, live doesn't), /alerts and /metrics parse."""
+        out = doctor.check_collector()
+        assert out["ok"] is True, out
+
+    def test_refused_port_never_crashes_the_report(self, monkeypatch):
+        """The ISSUE's explicit hazard: a host that cannot bind loopback
+        must get a finding, not a traceback."""
+        from estorch_tpu.obs.agg import collector as collector_mod
+
+        def boom(*a, **k):
+            raise OSError("port refused")
+
+        monkeypatch.setattr(collector_mod.Collector, "__init__", boom)
+        out = doctor.check_collector()
+        assert out["ok"] is False
+        assert "port refused" in out["error"]
+
+    def test_report_gains_collector_row(self, monkeypatch):
+        """report() carries the collector verdict (heavy probes stubbed
+        like the device/mesh row tests)."""
+        monkeypatch.setattr(doctor, "check_mesh",
+                            lambda **kw: {"status": "ok"})
+        monkeypatch.setattr(doctor, "check_device",
+                            lambda timeout_s=20.0, platform=None: {
+                                "status": "ok", "platform": "cpu",
+                                "n_devices": 8, "elapsed_s": 0.1,
+                                "timeout_s": timeout_s})
+        monkeypatch.setattr(doctor, "check_collector",
+                            lambda: {"ok": True})
+        rep = doctor.report(timeout_s=5.0)
+        assert rep["collector"] == {"ok": True}
+
+
 class TestResilienceCheck:
     def test_config_checks_without_probe(self, tmp_path, monkeypatch):
         monkeypatch.setenv("ESTORCH_CKPT_ROOT", str(tmp_path))

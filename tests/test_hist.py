@@ -290,6 +290,113 @@ class TestExposition:
 
 
 # =====================================================================
+# merge edge cases the fleet store leans on (obs/agg/store.py windows
+# are merge_snapshots folds over scraped snapshots)
+# =====================================================================
+
+class TestMergeEdgeCases:
+    def _build(self, values):
+        h = Histogram()
+        for v in values:
+            h.observe(v)
+        return h
+
+    def test_merging_an_empty_snapshot_is_identity(self):
+        """A freshly-restarted process's first scrape carries a zero
+        histogram; folding it in must change nothing — counts, sum, OR
+        quantiles."""
+        full = self._build([0.01, 0.02, 0.03])
+        before = full.to_dict()
+        out = merge_snapshots({"lat": before},
+                              {"lat": Histogram().to_dict()})
+        back = Histogram.from_dict(out["lat"])
+        assert back.count == 3 and back.sum == full.sum
+        assert back.quantile(0.99) == full.quantile(0.99)
+        # and the mirror: empty total absorbs the snapshot verbatim
+        out2 = merge_snapshots(None, {"lat": before})
+        assert Histogram.from_dict(out2["lat"]).count == 3
+        # empty-vs-empty composes to an empty histogram, not a crash
+        out3 = merge_snapshots({"lat": Histogram().to_dict()},
+                               {"lat": Histogram().to_dict()})
+        assert Histogram.from_dict(out3["lat"]).count == 0
+
+    def test_exact_mode_merged_with_ladder_mode_across_restart(self):
+        """Cross-restart composition where one incarnation died young
+        (count <= exact_cap: raw samples still attached) and the other
+        lived past the cap (ladder-only): the merge must drop to the
+        ladder path with EXACT combined counts, and its quantiles must
+        equal the all-at-once histogram's (which took the same
+        ladder path)."""
+        import random
+
+        rng = random.Random(7)
+        young = [rng.expovariate(1 / 0.01) for _ in range(50)]
+        old = [rng.expovariate(1 / 0.01) for _ in range(2000)]
+        h_young, h_old = self._build(young), self._build(old)
+        assert h_young._exact is not None  # raw list survives
+        assert h_old._exact is None  # past the cap
+        composed = merge_snapshots({"lat": h_young.to_dict()},
+                                   {"lat": h_old.to_dict()})
+        back = Histogram.from_dict(composed["lat"])
+        assert back._exact is None
+        assert back.count == 2050
+        both = self._build(young + old)
+        assert back._counts == both._counts
+        for q in (0.5, 0.95, 0.99):
+            assert back.quantile(q) == both.quantile(q)
+        # order must not matter (the store folds in scrape order, the
+        # supervisor in death order)
+        flipped = merge_snapshots({"lat": h_old.to_dict()},
+                                  {"lat": self._build(young).to_dict()})
+        assert Histogram.from_dict(flipped["lat"])._counts == back._counts
+
+    def test_quantile_stability_after_many_segment_recomposition(self):
+        """The store recomposes windows from MANY segments; 40 sequential
+        JSON-round-tripped folds must reproduce the all-at-once
+        histogram bit-for-bit (associativity is the contract) and stay
+        inside the documented error bound of the offline exact
+        quantiles."""
+        import math
+        import random
+
+        rng = random.Random(11)
+        values = [rng.expovariate(1 / 0.02) for _ in range(5000)]
+        total = None
+        for i in range(40):
+            chunk = values[i::40]
+            snap = {"lat": self._build(chunk).to_dict()}
+            total = merge_snapshots(
+                total, json.loads(json.dumps(snap, default=float)))
+        back = Histogram.from_dict(total["lat"])
+        whole = self._build(values)
+        assert back._counts == whole._counts and back.count == 5000
+        s = sorted(values)
+        bound = whole.quantile_error_bound()
+        for q in (0.5, 0.99):
+            assert back.quantile(q) == whole.quantile(q)
+            exact = s[max(1, math.ceil(q * len(s))) - 1]
+            assert abs(back.quantile(q) - exact) <= exact * bound
+
+    def test_snapshot_from_export_round_trip_and_foreign_ladder(self):
+        """The collector only ever sees the text exposition; rebuilding
+        the snapshot from cumulative (le, count) pairs must reproduce
+        the ladder counts exactly, and a foreign ladder must yield None
+        (degrade), never a resampled fake."""
+        from estorch_tpu.obs.hist import snapshot_from_export
+
+        h = self._build([0.001, 0.01, 0.01, 0.1, 5.0])
+        snap = snapshot_from_export(h.to_export())
+        back = Histogram.from_dict(snap)
+        assert back._counts == h._counts
+        assert back.count == h.count and back.sum == h.sum
+        assert back.quantile(0.99) == \
+            Histogram.from_dict(h.to_dict(compact=True)).quantile(0.99)
+        foreign = {"buckets": [(0.00123, 2), (float("inf"), 2)],
+                   "sum": 0.002, "count": 2}
+        assert snapshot_from_export(foreign) is None
+
+
+# =====================================================================
 # the tail gate (obs regress --tail)
 # =====================================================================
 

@@ -1,0 +1,766 @@
+"""Fleet-scope observability (estorch_tpu/obs/agg/, docs/observability.md
+"Fleet aggregation").
+
+Anchors: the time-series store's atomic segment/retention/reset
+contracts, the declarative rules engine's threshold/absence/multi-window
+burn-rate state machine, the collector's dead/slow/garbage-target
+containment, and THE acceptance demo — a 3-target fleet (two serve
+servers, one chaos-killed mid-run, plus a supervised-run sidecar) under
+loadgen while the collector scrapes throughout: the absence rule fires
+``estorch_up``→down for the killed replica and resolves on restart, an
+injected latency spike breaches the p99 burn-rate rule naming the
+target and the endpoint metric, stored-history quantiles match the
+server's own histogram within the documented ladder bound, and ``obs
+dash --once`` renders all three targets with active alerts, jax-free as
+a plain file.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from estorch_tpu.obs.agg.collector import (Collector, Target, load_targets,
+                                           samples_from_exposition,
+                                           scrape_run_dir, validate_targets)
+from estorch_tpu.obs.agg.rules import (RulesEngine, append_ledger,
+                                       load_rules, read_ledger,
+                                       validate_rules)
+from estorch_tpu.obs.agg.store import SeriesStore
+from estorch_tpu.obs.export.prometheus import (parse_exposition,
+                                               render_exposition)
+from estorch_tpu.obs.hist import Histogram
+from estorch_tpu.obs.recorder import Heartbeat
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+
+
+# =====================================================================
+# time-series store
+# =====================================================================
+
+class TestSeriesStore:
+    def _sample(self, name, target, value):
+        return {"name": name, "labels": {"target": target}, "value": value}
+
+    def test_append_commit_is_atomic_and_readable(self, tmp_path):
+        s = SeriesStore(str(tmp_path / "store"))
+        now = 1000.0
+        s.append([self._sample("estorch_up", "a", 1)], ts=now)
+        s.append([self._sample("estorch_up", "a", 0)], ts=now + 1)
+        # no .tmp staging files survive a commit
+        files = os.listdir(str(tmp_path / "store"))
+        assert files and not [f for f in files if f.endswith(".tmp")]
+        got = s.range("estorch_up", {"target": "a"}, window_s=60, now=now + 1)
+        assert [(ts, v) for ts, _l, v in got] == [(1000.0, 1.0),
+                                                 (1001.0, 0.0)]
+
+    def test_segment_roll_and_retention(self, tmp_path):
+        s = SeriesStore(str(tmp_path / "store"), max_segments=3,
+                        segment_max_samples=2)
+        for i in range(12):
+            s.append([self._sample("m", "a", i)], ts=1000.0 + i)
+        segs = s.segments()
+        assert len(segs) <= 3
+        # newest samples survive retention, oldest are pruned
+        got = [v for _ts, _l, v in s.range("m", None, window_s=1e6,
+                                           now=1012.0)]
+        assert got[-1] == 11.0 and 0.0 not in got
+
+    def test_label_subset_match_and_values(self, tmp_path):
+        s = SeriesStore(str(tmp_path / "store"))
+        s.append([self._sample("estorch_up", "a", 1),
+                  self._sample("estorch_up", "b", 0)], ts=1000.0)
+        assert len(s.range("estorch_up", None, 60, now=1000.0)) == 2
+        assert [v for _t, _l, v in
+                s.range("estorch_up", {"target": "b"}, 60, now=1000.0)] \
+            == [0.0]
+        assert s.label_values("estorch_up", "target", 60,
+                              now=1000.0) == ["a", "b"]
+
+    def test_counter_increase_detects_reset(self, tmp_path):
+        """A restarted process zeroes its counters; the windowed increase
+        must count the post-reset growth, not a bogus negative."""
+        s = SeriesStore(str(tmp_path / "store"))
+        for i, v in enumerate([100, 150, 170, 5, 25]):  # reset at 5
+            s.append([self._sample("estorch_requests_total", "a", v)],
+                     ts=1000.0 + i)
+        inc = s.increase("estorch_requests_total", {"target": "a"},
+                         window_s=60, now=1004.0)
+        assert inc == (50 + 20) + 5 + 20
+
+    def test_hist_window_merges_across_restart(self, tmp_path):
+        """Cumulative snapshots: latest rules the window, except across
+        a count DROP (restart) where the pre-restart snapshot folds in —
+        the sidecar composition contract lifted to stored history."""
+        s = SeriesStore(str(tmp_path / "store"))
+        h1 = Histogram()
+        for _ in range(300):
+            h1.observe(0.010)
+        s.append([{"name": "estorch_lat", "labels": {"target": "a"},
+                   "hist": h1.to_dict()}], ts=1000.0)
+        h2 = Histogram()  # the restarted process's fresh histogram
+        for _ in range(100):
+            h2.observe(0.100)
+        s.append([{"name": "estorch_lat", "labels": {"target": "a"},
+                   "hist": h2.to_dict()}], ts=1001.0)
+        merged = s.hist_window("estorch_lat", {"target": "a"},
+                               window_s=60, now=1001.0)
+        assert merged is not None and merged.count == 400
+        direct = Histogram()
+        for _ in range(300):
+            direct.observe(0.010)
+        for _ in range(100):
+            direct.observe(0.100)
+        assert merged.quantile(0.99) == direct.quantile(0.99)
+
+    def test_hist_window_is_a_window_not_lifetime(self, tmp_path):
+        """Snapshots are cumulative, so a window quantile must subtract
+        the pre-window anchor: a long-gone spike must NOT sit in every
+        short window forever (the burn-rate resolution contract)."""
+        s = SeriesStore(str(tmp_path / "store"))
+        h = Histogram()
+        for _ in range(300):
+            h.observe(0.500)  # the old spike
+        s.append([{"name": "estorch_lat", "labels": {"target": "a"},
+                   "hist": h.to_dict()}], ts=1000.0)
+        for _ in range(100):
+            h.observe(0.010)  # recovery traffic
+        s.append([{"name": "estorch_lat", "labels": {"target": "a"},
+                   "hist": h.to_dict()}], ts=1100.0)
+        # short window sees ONLY the post-anchor delta: fast traffic
+        short = s.hist_window("estorch_lat", {"target": "a"},
+                              window_s=50, now=1110.0)
+        assert short is not None and short.count == 100
+        assert short.quantile(0.99) < 0.05
+        # long window (no anchor) still carries the whole history
+        long_ = s.hist_window("estorch_lat", {"target": "a"},
+                              window_s=200, now=1110.0)
+        assert long_.count == 400 and long_.quantile(0.99) >= 0.4
+        # sum subtracts too (within float noise)
+        assert abs(short.sum - 100 * 0.010) < 1e-6
+
+    def test_reader_skips_garbage_lines(self, tmp_path):
+        s = SeriesStore(str(tmp_path / "store"))
+        s.append([self._sample("m", "a", 1)], ts=1000.0)
+        seg = s.segments()[0]
+        with open(seg, "a") as f:
+            f.write("{torn json\n")
+        assert [v for _t, _l, v in s.range("m", None, 60, now=1000.0)] \
+            == [1.0]
+
+
+# =====================================================================
+# rules engine
+# =====================================================================
+
+def _mk_store(tmp_path, *batches):
+    s = SeriesStore(str(tmp_path / "store"))
+    for ts, samples in batches:
+        s.append(samples, ts=ts)
+    return s
+
+
+class TestRules:
+    def test_validate_rejects_junk(self):
+        assert validate_rules({"schema": 1, "rules": [{"kind": "nope"}]})
+        assert validate_rules({"schema": 2, "rules": []})
+        assert validate_rules({"schema": 1, "rules": [
+            {"name": "x", "kind": "burn_rate", "metric": "m",
+             "slo_s": 0, "windows": []}]})
+        assert not validate_rules({"schema": 1, "rules": [
+            {"name": "ok", "kind": "threshold", "metric": "m", "op": ">",
+             "value": 1}]})
+
+    def test_threshold_for_s_delays_firing(self, tmp_path):
+        store = _mk_store(tmp_path)
+        eng = RulesEngine([{"name": "deep", "kind": "threshold",
+                            "metric": "estorch_queue_depth", "op": ">",
+                            "value": 10, "for_s": 5, "window_s": 60}])
+        up = {"name": "estorch_queue_depth", "labels": {"target": "a"},
+              "value": 50}
+        store.append([up], ts=1000.0)
+        assert eng.evaluate(store, ["a"], 1000.0) == []  # pending
+        store.append([up], ts=1004.0)
+        assert eng.evaluate(store, ["a"], 1004.0) == []  # still pending
+        store.append([up], ts=1006.0)
+        fired = eng.evaluate(store, ["a"], 1006.0)
+        assert [t["event"] for t in fired] == ["firing"]
+        assert "estorch_queue_depth" in fired[0]["detail"]
+        assert "'a'" in fired[0]["detail"]
+
+    def test_absence_fires_on_missing_and_zero_and_resolves(self, tmp_path):
+        store = _mk_store(tmp_path)
+        eng = RulesEngine([{"name": "down", "kind": "absence",
+                            "metric": "estorch_up", "for_s": 0,
+                            "window_s": 30}])
+        # no sample at all -> fires
+        t1 = eng.evaluate(store, ["a"], 1000.0)
+        assert [x["event"] for x in t1] == ["firing"]
+        # up=1 lands -> resolves
+        store.append([{"name": "estorch_up", "labels": {"target": "a"},
+                       "value": 1}], ts=1001.0)
+        t2 = eng.evaluate(store, ["a"], 1001.0)
+        assert [x["event"] for x in t2] == ["resolved"]
+        # up=0 (answers but reports down) -> fires again
+        store.append([{"name": "estorch_up", "labels": {"target": "a"},
+                       "value": 0}], ts=1002.0)
+        t3 = eng.evaluate(store, ["a"], 1002.0)
+        assert [x["event"] for x in t3] == ["firing"]
+        assert eng.active()[0]["target"] == "a"
+
+    def test_burn_rate_needs_every_window(self, tmp_path):
+        """Multi-window semantics: a long-window breach whose SHORT
+        window has recovered must NOT fire — that is the whole point of
+        the second window (no paging after recovery)."""
+        store = _mk_store(tmp_path)
+        slow, fast = Histogram(), Histogram()
+        for _ in range(300):
+            slow.observe(0.500)
+        store.append([{"name": "estorch_req", "labels": {"target": "a"},
+                       "hist": slow.to_dict()}], ts=1000.0)
+        eng = RulesEngine([{
+            "name": "p99-slo", "kind": "burn_rate", "metric":
+            "estorch_req", "quantile": 0.99, "slo_s": 0.05,
+            "windows": [{"window_s": 3600}, {"window_s": 30}]}])
+        fired = eng.evaluate(store, ["a"], 1000.0)
+        assert [t["event"] for t in fired] == ["firing"]
+        assert "p99" in fired[0]["detail"] \
+            and "estorch_req" in fired[0]["detail"]
+        # 2h later, the short window is empty: quantile None -> resolve
+        resolved = eng.evaluate(store, ["a"], 1000.0 + 7200)
+        assert [t["event"] for t in resolved] == ["resolved"]
+
+    def test_burn_rate_resolves_when_short_window_clears(self, tmp_path):
+        """The multi-window promise end to end: after recovery the
+        SHORT window's delta is clean, so the alert resolves even
+        though the cumulative (lifetime) histogram still contains the
+        spike."""
+        store = _mk_store(tmp_path)
+        h = Histogram()
+        for _ in range(300):
+            h.observe(0.500)
+        store.append([{"name": "estorch_req", "labels": {"target": "a"},
+                       "hist": h.to_dict()}], ts=1000.0)
+        eng = RulesEngine([{
+            "name": "p99-slo", "kind": "burn_rate",
+            "metric": "estorch_req", "quantile": 0.99, "slo_s": 0.05,
+            "windows": [{"window_s": 3600}, {"window_s": 30}]}])
+        assert [t["event"] for t in eng.evaluate(store, ["a"], 1000.0)] \
+            == ["firing"]
+        for _ in range(200):
+            h.observe(0.010)  # recovery
+        store.append([{"name": "estorch_req", "labels": {"target": "a"},
+                       "hist": h.to_dict()}], ts=1060.0)
+        # lifetime p99 is still the spike, but the 30s delta is clean
+        assert store.quantile("estorch_req", 0.99, {"target": "a"},
+                              3600, now=1070.0) > 0.4
+        assert [t["event"] for t in eng.evaluate(store, ["a"], 1070.0)] \
+            == ["resolved"]
+
+    def test_seed_from_ledger_resolves_phantom_alert(self, tmp_path):
+        """A collector restart must adopt ledger-active alerts: if the
+        condition cleared meanwhile, the fresh engine emits the missing
+        resolved (so the dash's ledger reconstruction agrees with
+        /alerts), and if it still holds it does NOT re-announce."""
+        store = _mk_store(tmp_path)
+        ledger = str(tmp_path / "alerts.jsonl")
+        append_ledger(ledger, [{"ts": 900.0, "event": "firing",
+                                "rule": "down", "target": "a",
+                                "detail": "estorch_up absent"}])
+        store.append([{"name": "estorch_up", "labels": {"target": "a"},
+                       "value": 1}], ts=1000.0)
+        eng = RulesEngine([{"name": "down", "kind": "absence",
+                            "metric": "estorch_up", "for_s": 0,
+                            "window_s": 30}], ledger_path=ledger)
+        assert eng.active() and eng.active()[0]["rule"] == "down"
+        out = eng.evaluate(store, ["a"], 1000.0)
+        assert [t["event"] for t in out] == ["resolved"]
+        # the ledger now closes the loop for the dash
+        events = [t["event"] for t in read_ledger(ledger)]
+        assert events == ["firing", "resolved"]
+        # still-holding case: seeded firing is kept, not re-announced
+        append_ledger(ledger, [{"ts": 1100.0, "event": "firing",
+                                "rule": "down", "target": "b",
+                                "detail": "estorch_up absent"}])
+        eng2 = RulesEngine([{"name": "down", "kind": "absence",
+                             "metric": "estorch_up", "for_s": 0,
+                             "window_s": 30}], ledger_path=ledger)
+        assert eng2.evaluate(store, ["b"], 1200.0) == []
+        assert eng2.active()[0]["target"] == "b"
+
+    def test_ledger_round_trip_and_tail(self, tmp_path):
+        path = str(tmp_path / "alerts.jsonl")
+        append_ledger(path, [{"ts": 1, "event": "firing", "rule": "r",
+                              "target": "a", "detail": "d"}])
+        append_ledger(path, [{"ts": 2, "event": "resolved", "rule": "r",
+                              "target": "a", "detail": "d"}])
+        got = read_ledger(path)
+        assert [t["event"] for t in got] == ["firing", "resolved"]
+        assert read_ledger(str(tmp_path / "missing.jsonl")) == []
+
+    def test_removed_target_resolves_instead_of_haunting(self, tmp_path):
+        """A firing alert for a target deleted from targets.json must be
+        closed (rule/target can never re-evaluate) — not shown on
+        /alerts and the dash forever and re-adopted by every restart."""
+        store = _mk_store(tmp_path)
+        eng = RulesEngine([{"name": "down", "kind": "absence",
+                            "metric": "estorch_up", "for_s": 0,
+                            "window_s": 30}])
+        assert [t["event"] for t in eng.evaluate(store, ["gone"], 1000.0)] \
+            == ["firing"]
+        out = eng.evaluate(store, ["other"], 1001.0)
+        events = {(t["event"], t["target"]) for t in out}
+        assert ("resolved", "gone") in events
+        assert all(a["target"] != "gone" for a in eng.active())
+
+    def test_ledger_compacts_to_a_bound(self, tmp_path):
+        """A flapping rule must not grow the ledger (and each atomic
+        rewrite's cost) without bound — append compacts to the newest
+        max_transitions, which every reader's tail already fits in."""
+        path = str(tmp_path / "alerts.jsonl")
+        for i in range(30):
+            append_ledger(path, [{"ts": i, "event": "firing", "rule": "r",
+                                  "target": "a", "detail": "d"}],
+                          max_transitions=10)
+        got = read_ledger(path, tail=100)
+        assert len(got) == 10
+        assert [t["ts"] for t in got] == list(range(20, 30))  # newest kept
+
+    def test_load_rules_one_line_errors(self, tmp_path):
+        bad = tmp_path / "rules.json"
+        bad.write_text(json.dumps({"schema": 1, "rules": [
+            {"name": "x", "kind": "wat"}]}))
+        with pytest.raises(ValueError) as ei:
+            load_rules(str(bad))
+        assert "\n" not in str(ei.value) and "wat" in str(ei.value)
+
+
+# =====================================================================
+# collector units
+# =====================================================================
+
+class TestCollectorUnits:
+    def test_samples_from_exposition_tags_and_collapses_hists(self):
+        h = Histogram()
+        for v in (0.01, 0.02, 0.5):
+            h.observe(v)
+        body = render_exposition({"requests_total": 3}, None, up=True,
+                                 histograms={"serve/request_s":
+                                             h.to_export()})
+        samples = samples_from_exposition(body, "serve-a")
+        by_name = {s["name"]: s for s in samples}
+        assert by_name["estorch_requests_total"]["value"] == 3.0
+        assert by_name["estorch_requests_total"]["labels"] == {
+            "target": "serve-a"}
+        snap = by_name["estorch_serve_request_s"]
+        assert "hist" in snap and snap["hist"]["count"] == 3
+        # bucket/sum component series collapsed into the one snapshot
+        assert "estorch_serve_request_s_bucket" not in by_name
+        assert "estorch_serve_request_s_sum" not in by_name
+        back = Histogram.from_dict(snap["hist"])
+        assert back.count == 3 and back.quantile(0.99) > 0
+
+    def test_garbage_body_raises(self):
+        with pytest.raises(ValueError):
+            samples_from_exposition("<html>nope</html>", "t")
+
+    def test_scrape_run_dir_composes_like_sidecar(self, tmp_path):
+        Heartbeat(str(tmp_path / "heartbeat.json")).beat(
+            "eval", 7, {"env_steps": 11})
+        samples = scrape_run_dir(str(tmp_path), "run-1")
+        by_name = {s["name"]: s["value"] for s in samples
+                   if "value" in s}
+        assert by_name["estorch_up"] == 1.0
+        assert by_name["estorch_env_steps"] == 11.0
+        assert by_name["estorch_heartbeat_generation"] == 7.0
+        with pytest.raises(ValueError):
+            scrape_run_dir(str(tmp_path / "empty"), "x")
+
+    def test_targets_file_validation(self, tmp_path):
+        assert validate_targets({"schema": 1, "targets": [
+            {"name": "a", "url": "http://x/metrics"},
+            {"name": "a", "run_dir": "r"}]})  # dup name
+        good = tmp_path / "targets.json"
+        good.write_text(json.dumps({"schema": 1, "interval_s": 0.5,
+                                    "targets": [
+                                        {"name": "a",
+                                         "url": "http://x/metrics"},
+                                        {"name": "b", "run_dir": "runs/r"},
+                                    ]}))
+        targets, interval = load_targets(str(good))
+        assert interval == 0.5
+        assert [t.kind for t in targets] == ["prometheus", "run_dir"]
+        # relative run_dir resolves against the targets file's directory
+        assert targets[1].run_dir == str(tmp_path / "runs" / "r")
+
+    def test_selfcheck_clean(self):
+        from estorch_tpu.obs.agg.collector import selfcheck
+
+        assert selfcheck() == []
+
+
+# =====================================================================
+# dash units
+# =====================================================================
+
+class TestDashUnits:
+    def test_snapshot_and_render(self, tmp_path):
+        from estorch_tpu.obs.agg.dash import fleet_snapshot, render
+
+        root = str(tmp_path / "store")
+        s = SeriesStore(root)
+        h = Histogram()
+        for v in (0.010, 0.020, 0.500):
+            h.observe(v)
+        now = time.time()
+        s.append([
+            {"name": "estorch_up", "labels": {"target": "serve-a"},
+             "value": 1},
+            {"name": "estorch_queue_depth",
+             "labels": {"target": "serve-a"}, "value": 3},
+            {"name": "estorch_serve_request_s",
+             "labels": {"target": "serve-a"}, "hist": h.to_dict()},
+            {"name": "estorch_up", "labels": {"target": "serve-b"},
+             "value": 0},
+        ], ts=now)
+        append_ledger(os.path.join(root, "alerts.jsonl"),
+                      [{"ts": now, "event": "firing",
+                        "rule": "replica-down", "target": "serve-b",
+                        "detail": "estorch_up=0 on target 'serve-b'"}])
+        snap = fleet_snapshot(root, window_s=60, now=now)
+        rows = {r["target"]: r for r in snap["targets"]}
+        assert rows["serve-a"]["up"] and not rows["serve-b"]["up"]
+        assert rows["serve-a"]["req_p99_s"] == h.quantile(0.99)
+        assert rows["serve-b"]["alerts"] == ["replica-down"]
+        assert rows["serve-b"]["req_p99_s"] is None  # renders as '-'
+        text = render(root, window_s=60, now=now)
+        assert "serve-a" in text and "DOWN" in text
+        assert "replica-down" in text
+
+    def test_resolved_alert_leaves_the_dash(self, tmp_path):
+        from estorch_tpu.obs.agg.dash import fleet_snapshot
+
+        root = str(tmp_path / "store")
+        s = SeriesStore(root)
+        now = time.time()
+        s.append([{"name": "estorch_up", "labels": {"target": "a"},
+                   "value": 1}], ts=now)
+        led = os.path.join(root, "alerts.jsonl")
+        append_ledger(led, [{"ts": now - 2, "event": "firing",
+                             "rule": "r", "target": "a", "detail": "d"}])
+        append_ledger(led, [{"ts": now - 1, "event": "resolved",
+                             "rule": "r", "target": "a", "detail": "d"}])
+        snap = fleet_snapshot(root, window_s=60, now=now)
+        assert snap["active_alerts"] == []
+
+
+# =====================================================================
+# THE acceptance demo: 3-target fleet under chaos + load
+# =====================================================================
+
+@pytest.fixture(scope="module")
+def fleet_bundle(tmp_path_factory):
+    import jax
+    import optax
+
+    from estorch_tpu import ES, JaxAgent, MLPPolicy
+    from estorch_tpu.envs.pendulum import Pendulum
+
+    es = ES(policy=MLPPolicy, agent=JaxAgent, optimizer=optax.adam,
+            population_size=8, sigma=0.05,
+            policy_kwargs={"action_dim": 1, "hidden": (16, 16),
+                           "discrete": False, "action_scale": 2.0},
+            agent_kwargs={"env": Pendulum(), "horizon": 10},
+            optimizer_kwargs={"learning_rate": 1e-2}, seed=0,
+            table_size=1 << 14, device=jax.devices()[0])
+    es.train(1, verbose=False)
+    path = str(tmp_path_factory.mktemp("fleet") / "bundle")
+    es.export_bundle(path, version="fleet-v1")
+    return path
+
+
+class TestFleetAcceptance:
+    def test_three_target_fleet_with_chaos_kill_and_latency_spike(
+            self, fleet_bundle, tmp_path):
+        """The E2E acceptance demo (ISSUE 11): two serve servers (one
+        chaos-killed mid-run and restarted) + a supervised-run sidecar
+        under loadgen while the collector scrapes throughout."""
+        from estorch_tpu.obs.export.sidecar import MetricsSidecar
+        from estorch_tpu.obs.spans import Telemetry
+        from estorch_tpu.serve import PolicyServer
+        from estorch_tpu.serve.loadgen import run_load
+        from estorch_tpu.serve.server import find_free_port
+
+        store_root = str(tmp_path / "store")
+        ledger = os.path.join(store_root, "alerts.jsonl")
+        os.makedirs(store_root, exist_ok=True)
+
+        # --- the fleet: serve-a (healthy), serve-b (to be killed),
+        # --- run-1 (a supervised-style run dir behind the sidecar)
+        srv_a = PolicyServer(fleet_bundle, port=0, max_batch=8,
+                             max_wait_ms=1.0,
+                             telemetry=Telemetry(enabled=True))
+        srv_a.start_background()
+        port_b = find_free_port()
+        srv_b = PolicyServer(fleet_bundle, port=port_b, max_batch=8,
+                             max_wait_ms=1.0,
+                             telemetry=Telemetry(enabled=True))
+        srv_b.start_background()
+        run_dir = str(tmp_path / "run1")
+        hb = Heartbeat(os.path.join(run_dir, "heartbeat.json"))
+        hb.beat("eval", 41, {"env_steps": 12345})
+        sidecar = MetricsSidecar(run_dir, port=0)
+        sidecar.start_background()
+
+        # the /stats collector-discovery stanza IS the targets entry
+        import urllib.request
+
+        with urllib.request.urlopen(
+                f"http://{srv_a.host}:{srv_a.port}/stats", timeout=10) as r:
+            stats_a = json.loads(r.read().decode())
+        stanza = stats_a["collector_target"]
+        assert stanza["url"].endswith("/metrics")
+        # a wildcard bind must never leak into the pasteable stanza (a
+        # remote collector cannot dial 0.0.0.0)
+        srv_a.host, saved = "0.0.0.0", srv_a.host
+        try:
+            wild = srv_a._collector_target()
+            assert "0.0.0.0" not in wild["url"] and wild["url"], wild
+        finally:
+            srv_a.host = saved
+
+        store = SeriesStore(store_root)
+        rules = RulesEngine([
+            {"name": "replica-down", "kind": "absence",
+             "metric": "estorch_up", "for_s": 0, "window_s": 30},
+            {"name": "p99-slo", "kind": "burn_rate",
+             "metric": "estorch_serve_request_s", "quantile": 0.99,
+             "slo_s": 0.25,
+             "windows": [{"window_s": 120}, {"window_s": 120}]},
+        ], ledger_path=ledger)
+        targets = [
+            Target("serve-a", url=stanza["url"], timeout_s=5.0),
+            Target("serve-b",
+                   url=f"http://{srv_b.host}:{srv_b.port}/metrics",
+                   timeout_s=1.0),
+            Target("run-1",
+                   url=f"http://{sidecar.host}:{sidecar.port}/metrics",
+                   timeout_s=5.0),
+        ]
+        col = Collector(targets, store, rules, port=0)
+        col.start_background()
+        try:
+            # --- loadgen over both replicas while the collector scrapes
+            results = {}
+
+            def load(name, srv, total):
+                results[name] = run_load(f"{srv.host}:{srv.port}",
+                                         conns=4, total=total,
+                                         duration_s=60.0,
+                                         obs=[0.0, 0.0, 0.0])
+
+            ta = threading.Thread(target=load,
+                                  args=("a", srv_a, 300), daemon=True)
+            tb = threading.Thread(target=load,
+                                  args=("b", srv_b, 60), daemon=True)
+            ta.start(), tb.start()
+            t1 = col.tick()  # mid-load: every target up, no alerts
+            assert all(r["ok"] for r in t1["targets"].values()), t1
+            assert t1["transitions"] == []
+            ta.join(60), tb.join(60)
+            assert results["a"]["requests"] == 300
+            assert not results["a"]["errors"]
+
+            # --- chaos: kill serve-b mid-run; the tick must tolerate the
+            # dead target (bounded) and the absence rule must fire
+            srv_b.shutdown(drain=True)
+            t0 = time.perf_counter()
+            t2 = col.tick()
+            tick_s = time.perf_counter() - t0
+            assert tick_s < 5.0, f"tick stalled on the dead target: " \
+                                 f"{tick_s:.1f}s"
+            assert not t2["targets"]["serve-b"]["ok"]
+            assert t2["targets"]["serve-a"]["ok"]  # others unaffected
+            fired = {(t["rule"], t["target"]): t
+                     for t in t2["transitions"] if t["event"] == "firing"}
+            assert ("replica-down", "serve-b") in fired
+            assert "serve-b" in fired[("replica-down",
+                                       "serve-b")]["detail"]
+            assert ("replica-down", "serve-a") not in fired
+
+            # --- restart the replica on the SAME port: absence resolves
+            srv_b2 = PolicyServer(fleet_bundle, port=port_b, max_batch=8,
+                                  max_wait_ms=1.0,
+                                  telemetry=Telemetry(enabled=True))
+            srv_b2.start_background()
+            try:
+                t3 = col.tick()
+                resolved = [t for t in t3["transitions"]
+                            if t["event"] == "resolved"]
+                assert [(t["rule"], t["target"]) for t in resolved] == \
+                    [("replica-down", "serve-b")]
+
+                # --- injected latency spike on serve-a breaches the p99
+                # burn-rate rule, naming the target and the endpoint
+                # metric
+                for _ in range(300):
+                    srv_a.obs.hists.observe("serve/request_s", 1.0)
+                t4 = col.tick()
+                burn = [t for t in t4["transitions"]
+                        if t["rule"] == "p99-slo"
+                        and t["event"] == "firing"]
+                assert burn and burn[0]["target"] == "serve-a", t4
+                assert "estorch_serve_request_s" in burn[0]["detail"]
+                assert "p99" in burn[0]["detail"]
+
+                # --- stored-history quantiles vs the server's own
+                # histogram, within the documented ladder bound
+                now = time.time()
+                h = srv_a.obs.hists.get("serve/request_s")
+                bound = h.quantile_error_bound()
+                for q in (0.50, 0.99):
+                    stored = store.quantile("estorch_serve_request_s", q,
+                                            {"target": "serve-a"},
+                                            window_s=300, now=now)
+                    own = h.quantile(q)
+                    assert stored is not None
+                    assert abs(stored - own) <= own * bound + 1e-9, (
+                        f"p{q * 100:g}: stored {stored} vs server {own}")
+
+                # --- the collector's own plane: /alerts + /metrics
+                with urllib.request.urlopen(
+                        f"http://{col.host}:{col.port}/alerts",
+                        timeout=10) as r:
+                    alerts = json.loads(r.read().decode())
+                active = {(a["rule"], a["target"])
+                          for a in alerts["active"]}
+                assert ("p99-slo", "serve-a") in active
+                events = [(t["event"], t["rule"], t["target"])
+                          for t in alerts["transitions"]]
+                assert ("firing", "replica-down", "serve-b") in events
+                assert ("resolved", "replica-down", "serve-b") in events
+                with urllib.request.urlopen(
+                        f"http://{col.host}:{col.port}/metrics",
+                        timeout=10) as r:
+                    parse_exposition(r.read().decode())
+
+                # --- obs dash --once renders all three targets + alerts,
+                # run AS A FILE (jax-free-ness itself is pinned by
+                # test_dash_file_run_never_imports_package_or_jax)
+                r = subprocess.run(
+                    [sys.executable, os.path.join(
+                        REPO, "estorch_tpu", "obs", "agg", "dash.py"),
+                     "--store", store_root, "--once"],
+                    capture_output=True, text=True, timeout=120)
+                assert r.returncode == 0, r.stderr
+                out = r.stdout
+                for name in ("serve-a", "serve-b", "run-1"):
+                    assert name in out, out
+                assert "p99-slo" in out  # the active alert renders
+                assert "3 target(s)" in out
+            finally:
+                srv_b2.shutdown(drain=True)
+        finally:
+            col.close()
+            sidecar.close()
+            srv_a.shutdown(drain=True)
+
+    def test_dash_file_run_never_imports_package_or_jax(self, tmp_path):
+        """The dash (and the store/rules it file-loads) must work with
+        the package never imported — same discipline as the sidecar."""
+        root = str(tmp_path / "store")
+        s = SeriesStore(root)
+        s.append([{"name": "estorch_up", "labels": {"target": "a"},
+                   "value": 1}], ts=time.time())
+        dash = os.path.join(REPO, "estorch_tpu", "obs", "agg", "dash.py")
+        probe = (
+            "import importlib.util, sys\n"
+            f"spec = importlib.util.spec_from_file_location('d', {dash!r})\n"
+            "m = importlib.util.module_from_spec(spec)\n"
+            "spec.loader.exec_module(m)\n"
+            "assert 'jax' not in sys.modules, 'dash imported jax'\n"
+            "assert 'estorch_tpu' not in sys.modules, 'package init ran'\n"
+            f"print(m.render({root!r}, window_s=3600))\n"
+        )
+        r = subprocess.run([sys.executable, "-c", probe],
+                           capture_output=True, text=True, timeout=120)
+        assert r.returncode == 0, r.stderr
+        assert "a" in r.stdout and "UP" in r.stdout
+
+    def test_collector_file_run_never_imports_package_or_jax(
+            self, tmp_path):
+        """collect as a plain file: scrape a run dir, store a sample,
+        evaluate a rule — all without the package or jax loading."""
+        Heartbeat(str(tmp_path / "heartbeat.json")).beat("eval", 1, {})
+        col = os.path.join(REPO, "estorch_tpu", "obs", "agg",
+                           "collector.py")
+        store_root = str(tmp_path / "store")
+        probe = (
+            "import importlib.util, sys, time\n"
+            f"spec = importlib.util.spec_from_file_location('c', {col!r})\n"
+            "m = importlib.util.module_from_spec(spec)\n"
+            "spec.loader.exec_module(m)\n"
+            "assert 'jax' not in sys.modules, 'collector imported jax'\n"
+            "assert 'estorch_tpu' not in sys.modules, 'package init ran'\n"
+            f"store = m.SeriesStore({store_root!r})\n"
+            "rules = m.RulesEngine([{'name': 'down', 'kind': 'absence',"
+            " 'metric': 'estorch_up', 'for_s': 0}])\n"
+            f"t = m.Target('run', run_dir={str(tmp_path)!r})\n"
+            "c = m.Collector([t], store, rules, serve_http=False)\n"
+            "tick = c.tick(time.time())\n"
+            "assert tick['targets']['run']['ok'], tick\n"
+            "assert tick['transitions'] == [], tick\n"
+            "print('FILE_RUN_OK')\n"
+        )
+        r = subprocess.run([sys.executable, "-c", probe],
+                           capture_output=True, text=True, timeout=120)
+        assert r.returncode == 0, r.stderr
+        assert "FILE_RUN_OK" in r.stdout
+
+
+# =====================================================================
+# CLI round trip (module form)
+# =====================================================================
+
+class TestCollectCLI:
+    def test_collect_once_against_run_dir(self, tmp_path, capsys):
+        from estorch_tpu.obs.agg.collector import main as collect_main
+
+        Heartbeat(str(tmp_path / "run" / "heartbeat.json")).beat(
+            "eval", 3, {"env_steps": 5})
+        targets = tmp_path / "targets.json"
+        targets.write_text(json.dumps({
+            "schema": 1, "interval_s": 0.1,
+            "targets": [{"name": "run-1", "run_dir": "run"}]}))
+        rules = tmp_path / "rules.json"
+        rules.write_text(json.dumps({
+            "schema": 1, "rules": [
+                {"name": "down", "kind": "absence",
+                 "metric": "estorch_up", "for_s": 0, "window_s": 30}]}))
+        store_dir = str(tmp_path / "store")
+        rc = collect_main(["--targets", str(targets), "--store", store_dir,
+                           "--rules", str(rules), "--once"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        ready = json.loads(out.splitlines()[0])
+        assert ready["ready"] and ready["targets"] == ["run-1"]
+        s = SeriesStore(store_dir)
+        got = s.latest("estorch_env_steps", {"target": "run-1"},
+                       window_s=600, now=time.time())
+        assert got and list(got.values())[0][2] == 5.0
+
+    def test_bad_targets_file_is_exit_2_one_line(self, tmp_path, capsys):
+        from estorch_tpu.obs.agg.collector import main as collect_main
+
+        bad = tmp_path / "targets.json"
+        bad.write_text(json.dumps({"schema": 1, "targets": [{"name": "x"}]}))
+        rc = collect_main(["--targets", str(bad),
+                           "--store", str(tmp_path / "s")])
+        assert rc == 2
+        err = capsys.readouterr().err
+        assert "exactly one of url / run_dir" in err

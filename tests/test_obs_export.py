@@ -532,6 +532,157 @@ class TestRegress:
         assert "regress:" in capsys.readouterr().err
 
 
+class TestMixedSchemaBaselines:
+    """BENCH_r06+ artifacts embed phase_rows/tail_rows; older baselines
+    predate them.  The gates must consume the new schema and DEGRADE
+    with a one-line diagnosis — never a traceback or a bogus verdict —
+    on the old one."""
+
+    def _run_jsonl(self, path, n=8):
+        with open(path, "w") as f:
+            for g in range(n):
+                f.write(json.dumps({
+                    "generation": g, "env_steps_per_sec": 1000.0,
+                    "wall_time_s": 0.10,
+                    "phases": {"eval": 0.08, "update": 0.02}}) + "\n")
+
+    def _r06(self, path, eval_s=0.08):
+        with open(path, "w") as f:
+            json.dump({
+                "n": 3, "platform": "cpu",
+                "parsed": {"metric": "env_steps_per_sec_per_chip",
+                           "value": 1000.0, "unit": "x (cpu)"},
+                "phase_rows": [
+                    {"generation": g, "env_steps_per_sec": 1000.0,
+                     "wall_time_s": eval_s + 0.02,
+                     "phases": {"eval": eval_s, "update": 0.02}}
+                    for g in range(8)],
+            }, f)
+
+    def test_r06_schema_feeds_phase_and_tail_gates(self, tmp_path):
+        from estorch_tpu.obs.export.regress import (compare_phase_files,
+                                                    compare_tail_files)
+
+        cur = str(tmp_path / "cur.jsonl")
+        self._run_jsonl(cur)
+        base = str(tmp_path / "BENCH_r06.json")
+        self._r06(base)
+        v = compare_phase_files(cur, base)
+        assert v["verdict"] == "pass"
+        assert set(v["phases"]) == {"eval", "update"}
+        t = compare_tail_files(cur, base)
+        assert t["verdict"] == "pass"
+        assert "eval" in t["groups"] and "wall_time_s" in t["groups"]
+
+    def test_r06_baseline_catches_phase_slowdown(self, tmp_path):
+        from estorch_tpu.obs.export.regress import compare_phase_files
+
+        cur = str(tmp_path / "cur.jsonl")
+        self._run_jsonl(cur)
+        base = str(tmp_path / "BENCH_r06.json")
+        self._r06(base, eval_s=0.05)  # baseline 37% faster at eval
+        v = compare_phase_files(cur, base)
+        assert v["verdict"] == "regress"
+        assert v["regressed_phases"] == ["eval"]
+
+    def test_pre_r06_baseline_degrades_one_line(self, tmp_path, capsys):
+        from estorch_tpu.obs.export.regress import (compare_phase_files,
+                                                    compare_tail_files)
+
+        cur = str(tmp_path / "cur.jsonl")
+        self._run_jsonl(cur)
+        old = str(tmp_path / "BENCH_r05.json")
+        with open(old, "w") as f:
+            json.dump({"n": 5, "parsed": {
+                "metric": "env_steps_per_sec_per_chip",
+                "value": 62791.4, "unit": "env-steps/s/chip (cpu)"}}, f)
+        for fn, what in ((compare_phase_files, "per-phase"),
+                         (compare_tail_files, "tail")):
+            with pytest.raises(ValueError) as ei:
+                fn(cur, old)
+            msg = str(ei.value)
+            assert "\n" not in msg, msg  # ONE line
+            assert "baseline" in msg and f"no {what} rows" in msg
+            assert "capture-baseline" in msg  # says how to fix it
+        # the CLI prints it as a one-line error, exit 1, no traceback
+        rc = obs_main(["regress", cur, "--baseline", old, "--phases"])
+        assert rc == 1
+        err = capsys.readouterr().err
+        assert err.startswith("regress:") and err.count("\n") == 1
+
+    def test_empty_current_names_the_current_side(self, tmp_path):
+        from estorch_tpu.obs.export.regress import compare_phase_files
+
+        base = str(tmp_path / "BENCH_r06.json")
+        self._r06(base)
+        bare = str(tmp_path / "bare.jsonl")
+        with open(bare, "w") as f:
+            f.write(json.dumps({"generation": 0,
+                                "env_steps_per_sec": 5.0}) + "\n")
+        with pytest.raises(ValueError) as ei:
+            compare_phase_files(bare, base)
+        assert "current measurement carries no per-phase rows" \
+            in str(ei.value)
+
+    def test_embedded_repeats_are_distinct_samples_not_replays(self):
+        """Baseline phase_rows carry a 'repeat' stamp: generation g of
+        repeat 0 and of repeat 1 are different measurements and must
+        BOTH survive; a replayed generation within one repeat (same
+        (repeat, generation)) still dedupes keeping the last."""
+        from estorch_tpu.obs.export.regress import (extract_phase_samples,
+                                                    extract_tail_groups)
+
+        rows = [{"phase_rows": [
+            {"repeat": r, "generation": g, "wall_time_s": 1.0 + r,
+             "phases": {"eval": 0.5 + r}}
+            for r in range(3) for g in range(4)]}]
+        phases = extract_phase_samples(rows)
+        assert len(phases["eval"]) == 12
+        assert sorted(set(phases["eval"])) == [0.5, 1.5, 2.5]
+        groups = extract_tail_groups(rows)
+        assert len(groups["wall_time_s"]) == 12
+        # replay within one repeat: last occurrence wins, no double count
+        rows[0]["phase_rows"].append(
+            {"repeat": 0, "generation": 0, "wall_time_s": 9.0,
+             "phases": {"eval": 9.0}})
+        phases = extract_phase_samples(rows)
+        assert len(phases["eval"]) == 12 and 9.0 in phases["eval"] \
+            and phases["eval"].count(0.5) == 3
+
+    def test_committed_r06_artifact_carries_what_the_gates_need(self):
+        """The REAL committed baseline (satellite: the trajectory no
+        longer ends at r05): embedded phase rows, a tail headline, and
+        the typed device probe."""
+        path = os.path.join(REPO, "BENCH_r06.json")
+        with open(path) as f:
+            art = json.load(f)
+        assert art["phase_rows"] and all(
+            isinstance(r.get("phases"), dict) for r in art["phase_rows"])
+        assert art["extras"]["phases_headline"]
+        assert art["extras"]["tail_headline"]["wall_time_s"]["p99_s"] > 0
+        # the tail baseline must be STEADY STATE: a warm-up/compile
+        # generation left in phase_rows becomes the p99 (nearest-rank
+        # over ~35 samples is the max) and would wave a real 100x
+        # dispatch-tail regression through
+        walls = [r["wall_time_s"] for r in art["phase_rows"]]
+        assert max(walls) < 3 * sorted(walls)[len(walls) // 2], (
+            "compile-spike rows leaked into the committed tail baseline")
+        assert art["extras"]["device_probe"]["status"] in (
+            "ok", "failed")
+        from estorch_tpu.obs.export.regress import (
+            extract_phase_samples, extract_tail_groups, load_rows,
+            measurement_platform)
+
+        rows = load_rows(path)
+        assert measurement_platform(rows) in ("cpu", "tpu")
+        phases = extract_phase_samples(rows)
+        # every repeat's every generation is a sample (n repeats ×
+        # gens-per-repeat == the embedded row count — nothing collapsed)
+        assert phases and all(len(v) == len(art["phase_rows"])
+                              for v in phases.values())
+        assert "wall_time_s" in extract_tail_groups(rows)
+
+
 # ---------------------------------------------------------------------
 # THE e2e acceptance demo
 # ---------------------------------------------------------------------

@@ -296,9 +296,19 @@ class TestPhaseRegress:
         assert v["phases"]["sample"]["verdict"] == "pass"
         assert v["phases"]["eval"]["slowdown_pct"] == pytest.approx(30, abs=1)
 
-    def test_no_shared_phases_is_an_error(self):
-        with pytest.raises(ValueError, match="no shared top-level phases"):
+    def test_no_phase_rows_is_a_one_line_error(self):
+        """Phase-less records degrade to the mixed-schema diagnosis (one
+        line, names the side lacking rows) — never a bogus verdict."""
+        with pytest.raises(ValueError,
+                           match="carries no per-phase rows") as ei:
             regress.compare_phases([{"generation": 0}], [{"generation": 0}])
+        assert "\n" not in str(ei.value)
+
+    def test_disjoint_phase_names_is_an_error(self):
+        with pytest.raises(ValueError, match="no shared top-level phases"):
+            regress.compare_phases(
+                [{"generation": 0, "phases": {"eval": 1.0}}],
+                [{"generation": 0, "phases": {"update": 1.0}}])
 
     def test_cli_phases_exit_codes(self, tmp_path, capsys):
         base, _, _ = _synth_run()
