@@ -1,5 +1,6 @@
 """Measurement-honesty rules: R07 unfenced-device-timing, R09
-nonmonotonic-span-clock, R12 gauge-shaped-latency.
+nonmonotonic-span-clock, R12 gauge-shaped-latency, R14
+jit-in-request-path.
 
 JAX dispatch is asynchronous: a jitted call returns a future-like array
 immediately and the device executes in the background.  So
@@ -27,6 +28,7 @@ not dispatch, and stays clean.
 from __future__ import annotations
 
 import ast
+import re
 
 from .context import ModuleContext
 from .engine import get_rule, iter_scopes, make_finding, rule, scope_nodes
@@ -333,4 +335,108 @@ def check_gauge_shaped_latency(ctx: ModuleContext):
                     "(obs/hist.py streaming histogram); keep gauges for "
                     "genuinely last-write facts like queue depth",
                     symbol))
+    return out
+
+
+# ---------------------------------------------------------------------
+# R14: jax.jit constructed in a per-request/per-call scope
+# ---------------------------------------------------------------------
+#
+# ``jax.jit(...)`` returns a WRAPPER whose compiled executables are
+# cached ON THAT WRAPPER OBJECT.  Construct it once at load time and
+# every call after the first reuses the executable; construct it inside
+# a request handler or a dispatch loop and every single call traces and
+# compiles from scratch — the serving-path recompile storm the warm-
+# bundle machinery (serve/warm.py) exists to kill, re-introduced one
+# innocent-looking line at a time.  The rule flags jit/pmap/shard_map
+# APPLICATIONS (not calls of an already-jitted name) in the two shapes
+# that are per-call by construction:
+#
+# * anywhere inside an HTTP handler method (``do_GET``/``do_POST``/…) —
+#   stdlib http.server calls these once per request;
+# * inside a ``for``/``while`` loop body, EXCEPT in recognized
+#   load-time scopes where building a ladder of programs in a loop is
+#   the legitimate idiom: module level, ``__init__``/``__post_init__``,
+#   and builder-named functions (``build``/``init``/``setup``/``load``/
+#   ``warm``/``compile``/``export``/``make`` in the name).
+#
+# Conservative by the R02/R03 philosophy: a jit constructed in a plain
+# helper (called who-knows-how-often) stays silent — only provably
+# per-request/per-iteration construction sites report.
+
+_HANDLER_RE = re.compile(r"(^|\.)do_[A-Z]+$")
+_SETUP_NAME_PARTS = ("build", "init", "setup", "load", "warm", "compile",
+                     "export", "make")
+_JIT_CTORS = ("jit", "pmap", "shard_map")
+
+
+def _is_jit_ctor_call(ctx: ModuleContext, node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    resolved = ctx.resolve(node.func)
+    return (resolved is not None
+            and resolved.rsplit(".", 1)[-1] in _JIT_CTORS)
+
+
+def _loop_jit_calls(ctx: ModuleContext, loop: ast.AST):
+    """jit-ctor calls inside one loop's per-iteration subtree, nested
+    defs excluded (a def in a loop body is not executed per iteration's
+    request).  A ``for``'s iterator/target evaluate ONCE, before the
+    loop — `for f in (jax.jit(g),):` is construction, not per-iteration
+    work — so only body/orelse are walked; a ``while``'s test re-runs
+    every iteration and stays in scope."""
+    if isinstance(loop, (ast.For, ast.AsyncFor)):
+        stack = list(loop.body) + list(loop.orelse)
+    else:
+        stack = list(ast.iter_child_nodes(loop))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if _is_jit_ctor_call(ctx, node):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+@rule("R14", "jit-in-request-path", "error",
+      "jax.jit constructed inside a per-request/per-call scope recompiles "
+      "on every call — hoist the jit to load time and reuse the wrapper")
+def check_jit_in_request_path(ctx: ModuleContext):
+    r = get_rule("R14")
+    out = []
+    seen: set[int] = set()
+
+    def report(node: ast.AST, symbol: str, where: str) -> None:
+        if id(node) in seen:
+            return
+        seen.add(id(node))
+        out.append(make_finding(
+            ctx, r, node,
+            f"jax.jit/pmap/shard_map constructed {where} — the compiled "
+            "executable caches on the wrapper object, so constructing it "
+            "per call means tracing + XLA-compiling per call",
+            "construct the jitted callable once at load/init time (the "
+            "server's engine build, __init__, a module-level builder) and "
+            "call the stored wrapper here",
+            symbol))
+
+    for symbol, scope in iter_scopes(ctx):
+        is_handler = bool(_HANDLER_RE.search(symbol))
+        if is_handler:
+            for node in scope_nodes(scope):
+                if _is_jit_ctor_call(ctx, node):
+                    report(node, symbol,
+                           "inside an HTTP request handler (called once "
+                           "per request)")
+        name = symbol.rsplit(".", 1)[-1].lower()
+        is_setup = (symbol == "<module>"
+                    or name in ("__init__", "__post_init__")
+                    or any(part in name for part in _SETUP_NAME_PARTS))
+        if is_setup:
+            continue
+        for node in scope_nodes(scope):
+            if isinstance(node, (ast.For, ast.While, ast.AsyncFor)):
+                for call in _loop_jit_calls(ctx, node):
+                    report(call, symbol,
+                           "inside a loop body (recompile per iteration)")
     return out

@@ -693,10 +693,56 @@ def check_serve(bundle: str | None = None) -> dict:
                 "module": man["module"]["import"],
                 "obs_norm": bool(man.get("obs_norm")),
                 "recurrent": bool(man.get("recurrent")),
+                "warm": _probe_bundle_warmth(man),
             }
         except (BundleError, OSError) as e:
             out["bundle"] = {"path": bundle, "valid": False,
                              "error": str(e)}
+    return out
+
+
+def _probe_bundle_warmth(manifest: dict) -> dict:
+    """The warm-bundle probe (serve/warm.py, docs/serving.md "Cold start
+    & quantized serving"), jax-free like the rest of check_serve:
+    validate_bundle already proved the packed warmth structurally sound
+    (entries present, checksummed, ladder complete), so what is left is
+    the COMPATIBILITY finding — warmth built under a different jax
+    version than this host's install can never hit and will be ignored
+    at load; an operator should re-export rather than wonder why the
+    replica still pays the JIT storm.  The installed jax version comes
+    from package metadata, so a wedged runtime can still be probed."""
+    warm = manifest.get("warm")
+    if not isinstance(warm, dict):
+        return {"present": False}
+    out = {
+        "present": True,
+        "format": warm.get("format"),
+        "entries": len(warm.get("entries") or {}),
+        "buckets": warm.get("buckets"),
+        "dtypes": warm.get("dtypes"),
+        "jax_version": warm.get("jax_version"),
+        "platform": warm.get("platform"),
+    }
+    try:
+        from importlib.metadata import version
+
+        installed = version("jax")
+    except Exception:
+        installed = None
+    out["installed_jax"] = installed
+    if installed is None:
+        out["compatible"] = None
+        out["finding"] = ("jax is not importable as package metadata on "
+                          "this host — warmth compatibility unknown")
+    elif installed != warm.get("jax_version"):
+        out["compatible"] = False
+        out["finding"] = (
+            f"warmth was built under jax {warm.get('jax_version')} but "
+            f"this host has jax {installed} — cache keys cannot match, "
+            "the warmth will be ignored at load; re-export the bundle "
+            "with warm=True under the serving jax version")
+    else:
+        out["compatible"] = True
     return out
 
 

@@ -109,6 +109,10 @@ def fleet_snapshot(store_root: str, *, window_s: float = 60.0,
             "target": name,
             "up": bool(up == 1.0),
             "generation": latest("estorch_heartbeat_generation"),
+            # cold-start health (serve replicas publish startup_s /
+            # compiles_at_load gauges; a training run honestly has none)
+            "startup_s": latest("estorch_startup_s"),
+            "compiles_at_load": latest("estorch_compiles_at_load"),
             "req_p50_s": store.quantile(REQUEST_HIST, 0.50, labels,
                                         window_s, now),
             "req_p99_s": store.quantile(REQUEST_HIST, 0.99, labels,
@@ -134,14 +138,27 @@ def render(store_root: str, *, window_s: float = 60.0,
     """One human frame of the fleet (see module docstring)."""
     snap = fleet_snapshot(store_root, window_s=window_s, now=now,
                           store=store)
-    header = ("target", "up", "gen", "req p50/p99 ms", "disp p99 ms",
-              "queue", "recomp", "alerts")
+    header = ("target", "up", "gen", "cold", "req p50/p99 ms",
+              "disp p99 ms", "queue", "recomp", "alerts")
     table = [header]
     for row in snap["targets"]:
+        # cold: startup seconds, suffixed ! when the replica paid fresh
+        # XLA builds at load (a warm bundle would have made it 0); -1 is
+        # the server's "no monitoring stream, warmth unproven" sentinel —
+        # rendered ? so unproven never reads as proven-clean
+        cold = "-"
+        if row.get("startup_s") is not None:
+            cold = f"{row['startup_s']:.1f}s"
+            compiles = row.get("compiles_at_load")
+            if compiles is not None and compiles > 0:
+                cold += f"!{int(compiles)}"
+            elif compiles is not None and compiles < 0:
+                cold += "?"
         table.append((
             row["target"],
             "UP" if row["up"] else "DOWN",
             _fmt_num(row["generation"]),
+            cold,
             f"{_fmt_ms(row['req_p50_s'])} / {_fmt_ms(row['req_p99_s'])}",
             _fmt_ms(row["dispatch_p99_s"]),
             _fmt_num(row["queue_depth"]),

@@ -52,6 +52,12 @@ GAUGE_NAMES = frozenset({
     "queue_depth",
     "batch_size_last",
     "bucket_last",
+    # cold-start facts (serve/server.py): set once at load / first
+    # answer, re-derivable from the compile ledger — gauges
+    "startup_s",
+    "first_request_s",
+    "compiles_at_load",
+    "warm_cache_hits",
 })
 
 _METRIC_OK = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")

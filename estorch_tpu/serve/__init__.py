@@ -29,6 +29,11 @@ _LAZY = {
     "PolicyServer": "server",
     "find_free_port": "server",
     "run_load": "loadgen",
+    "coldstart_probe": "loadgen",
+    "BF16_DIVERGENCE_BOUND": "warm",
+    "build_serving_batcher": "warm",
+    "warm_bundle": "warm",
+    "install_warmth": "warm",
 }
 
 __all__ = [

@@ -46,6 +46,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="pre-compile every bucket before READY (flat "
                         "first-request latency; counts toward the "
                         "recompiles counter exactly like lazy compiles)")
+    p.add_argument("--no-warm", action="store_true",
+                   help="ignore warmth packed in the bundle (serve/warm.py)"
+                        " — the cold-start A/B's control leg")
+    p.add_argument("--dtype", choices=("f32", "bf16"), default="f32",
+                   help="serving compute dtype; bf16 is the quantized "
+                        "fast path — refused (exit 2 / 409) unless the "
+                        "bundle opted in at export and its measured "
+                        "divergence stays inside the documented bound "
+                        "(docs/serving.md)")
     p.add_argument("--port-file", default=None, metavar="PATH",
                    help="atomically write {host,port,pid} JSON once bound")
     p.add_argument("--beat-interval", type=float, default=2.0,
@@ -62,8 +71,12 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv=None) -> int:
+    import time
+
+    t0 = time.monotonic()  # startup_s covers the jax import + load
     argv = list(sys.argv[1:] if argv is None else argv)
     args = build_parser().parse_args(argv)
+    args._t0_monotonic = t0
     # config validation BEFORE anything heavy (and before --supervised
     # forks): a bad --max-batch must be exit 2 with one line, not a
     # traceback — or worse, a supervised child crash-looping through

@@ -24,6 +24,11 @@ Mechanics:
 * admission control: a full queue SHEDS (``BatcherSaturated`` →
   HTTP 503 + ``shed_total``) instead of growing without bound — graceful
   backpressure, not OOM;
+* optional quantized fast path (``quant_fn``/``quant_bound``): per-bucket
+  divergence vs the f32 anchor is MEASURED at construction
+  (:func:`measure_quant_divergence`); out-of-bound buckets dispatch the
+  exact f32 program instead, and a policy past the bound at the anchor
+  is refused (docs/serving.md "Cold start & quantized serving");
 * ``close(drain=True)`` stops intake, finishes every queued request, and
   joins the worker — the SIGTERM drain path.
 
@@ -169,6 +174,51 @@ def verify_stable_buckets(
     return tuple(stable), tuple(excluded)
 
 
+def measure_quant_divergence(
+    quant_fn: Callable[[np.ndarray], np.ndarray],
+    batch_fn: Callable[[np.ndarray], np.ndarray],
+    obs_shape: Sequence[int],
+    buckets: Sequence[int],
+    *,
+    trials: int = 2,
+    seed: int = 0,
+) -> dict[int, float]:
+    """Per-bucket divergence of the quantized program vs the f32 anchor —
+    the :func:`verify_stable_buckets` discipline applied to accuracy.
+
+    The f32 anchor rows are THE reference (they are what the f32 ladder's
+    own bit-determinism contract chains to), and the quantized path's
+    error is MEASURED against them per bucket: random obs drawn once at
+    the anchor shape, each bucket fed row subsets, and the divergence
+    reported as  ``max |quant - f32| / max(|f32 anchor rows|)``  — a
+    relative-to-output-scale worst-row error.  Measuring per bucket (not
+    once) matters because it captures BOTH quantization error and the
+    quantized program's cross-shape variation, which (unlike f32's
+    occasional 1 ulp) can be orders of magnitude above the rounding
+    floor.  Non-finite quantized outputs count as infinite divergence.
+    """
+    buckets = sorted(set(int(b) for b in buckets))
+    anchor = buckets[-1]
+    rng = np.random.default_rng(seed)
+    shape = tuple(int(d) for d in obs_shape)
+    obs = rng.standard_normal((anchor,) + shape).astype(np.float32)
+    ref = np.asarray(batch_fn(obs), np.float32)
+    scale = float(max(np.max(np.abs(ref)), 1e-6))
+    out: dict[int, float] = {}
+    for b in buckets:
+        worst = 0.0
+        for _ in range(max(1, int(trials))):
+            idx = rng.choice(anchor, size=b, replace=False)
+            got = np.asarray(quant_fn(obs[idx]), np.float32)
+            err = np.max(np.abs(got - ref[idx]))
+            if not np.isfinite(err):
+                worst = float("inf")
+                break
+            worst = max(worst, float(err) / scale)
+        out[b] = worst
+    return out
+
+
 class DynamicBatcher:
     """Bounded-queue request coalescer over a batched predict callable."""
 
@@ -182,6 +232,9 @@ class DynamicBatcher:
         max_queue: int = 256,
         telemetry=None,
         verify: bool = True,
+        quant_fn: Callable[[np.ndarray], np.ndarray] | None = None,
+        quant_bound: float | None = None,
+        quant_label: str = "bf16",
     ):
         self.batch_fn = batch_fn
         self.obs_shape = tuple(int(d) for d in obs_shape)
@@ -189,6 +242,14 @@ class DynamicBatcher:
         self.max_wait_s = float(max_wait_ms) / 1e3
         self.obs = telemetry if telemetry is not None else NULL_TELEMETRY
         ladder = bucket_sizes(self.max_batch)
+        if quant_fn is not None:
+            if quant_bound is None:
+                raise ValueError("quant_fn needs quant_bound (the documented "
+                                 "per-bucket divergence bound)")
+            if not verify and ladder[-1] >= 2:
+                raise ValueError(
+                    "quantized serving requires bucket verification — the "
+                    "divergence contract chains to the VERIFIED f32 anchor")
         self.buckets_excluded: tuple[int, ...] = ()
         # verification applies to every coalescing ladder (anchor ≥ 2):
         # even a single-bucket ladder of 2 must prove slot-independence —
@@ -229,6 +290,60 @@ class DynamicBatcher:
             for b in ladder:
                 self._buckets_seen.add(b)
                 self.obs.counters.inc("recompiles")
+        # ------------------------------------------------ quantized path
+        # opt-in accuracy-bounded fast path (docs/serving.md "Cold start &
+        # quantized serving"): per-bucket divergence vs the f32 anchor is
+        # MEASURED here; drifting buckets fall back to the f32 program at
+        # the same shape (exact answers, evidence in the counters), and a
+        # policy whose divergence exceeds the bound AT THE ANCHOR — pure
+        # quantization error, no shape effects — is refused outright.
+        self.quant_fn = quant_fn
+        self.quant_bound = float(quant_bound) if quant_bound is not None \
+            else None
+        self.quant_label = str(quant_label)
+        self.quant_divergence: dict[int, float] = {}
+        self.quant_buckets: tuple[int, ...] = ()
+        self.quant_buckets_excluded: tuple[int, ...] = ()
+        self._quant_buckets: set[int] = set()
+        if quant_fn is not None:
+            t0 = time.perf_counter()
+            div = measure_quant_divergence(
+                quant_fn, batch_fn, self.obs_shape, self.buckets)
+            self.quant_divergence = div
+            anchor = self.buckets[-1]
+            if not div[anchor] <= self.quant_bound:
+                raise ValueError(
+                    f"{self.quant_label} path exceeds the divergence bound "
+                    f"at the anchor bucket {anchor}: measured "
+                    f"{div[anchor]:.3g} > {self.quant_bound:g} — this "
+                    "policy cannot serve quantized within the documented "
+                    "accuracy bound; serve it f32"
+                )
+            keep = [b for b in self.buckets if div[b] <= self.quant_bound]
+            dropped = [b for b in self.buckets if b not in keep]
+            self.quant_buckets = tuple(keep)
+            self.quant_buckets_excluded = tuple(dropped)
+            self._quant_buckets = set(keep)
+            for b in dropped:
+                self.obs.counters.inc("quant_buckets_excluded")
+                self.obs.event("quant_bucket_excluded", bucket=b,
+                               dtype=self.quant_label,
+                               divergence=round(div[b], 6),
+                               bound=self.quant_bound)
+            # the measurement compiled one quantized program per stable
+            # bucket (and, when f32 verification did not run — the (1,)
+            # ladder — the f32 anchor program too); count them so the
+            # recompile budget stays honest and dispatch never adds more
+            for b in self.buckets:
+                self.obs.counters.inc("recompiles")
+            if not self._buckets_seen:
+                for b in self.buckets:
+                    self._buckets_seen.add(b)
+                    self.obs.counters.inc("recompiles")
+            self.obs.compile_event(
+                "quant_verify", time.perf_counter() - t0,
+                count_recompiles=0, buckets=len(self.buckets),
+                dtype=self.quant_label, first_call=True)
         self._worker = threading.Thread(
             target=self._run, name="batcher", daemon=True)
         self._worker.start()
@@ -375,9 +490,14 @@ class DynamicBatcher:
         # shows "predict" as the last phase under load, and the timing
         # lands in counters (which is all the serving summary reads).
         obs.note("predict")
+        # quantized fast path for buckets measured within the divergence
+        # bound; excluded buckets dispatch the f32 program at the SAME
+        # shape — a drifting bucket degrades to exact, never to wrong
+        use_quant = self.quant_fn is not None and bucket in self._quant_buckets
+        fn = self.quant_fn if use_quant else self.batch_fn
         t_predict = time.perf_counter()
         try:
-            out = self.batch_fn(arr)
+            out = fn(arr)
             err = None
         except Exception as e:  # noqa: BLE001 — propagated to every waiter
             # typed so the server can answer 500 (server fault), never
@@ -396,6 +516,9 @@ class DynamicBatcher:
             obs.compile_event(f"bucket_{bucket}", dt, count_recompiles=0,
                               bucket=bucket, first_call=True)
         obs.counters.inc("predict_time_s_total", dt)
+        if use_quant:
+            obs.counters.inc("quant_batches_total")
+            obs.counters.inc("quant_requests_total", n)
         # the compute cost every coalesced request shared, as a
         # DISTRIBUTION (n-weighted: per request, not per batch) — a
         # last-write gauge here would keep exactly the sample the tail
@@ -489,6 +612,16 @@ class DynamicBatcher:
             "recompiles": int(c.get("recompiles")),
             "mean_batch": round(served / batches, 3) if batches else None,
         }
+        if self.quant_fn is not None:
+            out["quant"] = {
+                "dtype": self.quant_label,
+                "bound": self.quant_bound,
+                "buckets": list(self.quant_buckets),
+                "excluded": list(self.quant_buckets_excluded),
+                "divergence": {str(b): round(v, 6)
+                               for b, v in self.quant_divergence.items()},
+                "batches_total": int(c.get("quant_batches_total")),
+            }
         hists = self.obs.hists
         lat = {}
         for q, key in ((0.5, "p50"), (0.99, "p99")):

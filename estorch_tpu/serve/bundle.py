@@ -44,6 +44,7 @@ import numpy as np
 BUNDLE_SCHEMA = 1
 MANIFEST_NAME = "MANIFEST.json"
 ARRAYS_NAME = "arrays.npz"
+WARM_DIR = "warm"  # packed XLA-cache entries (serve/warm.py)
 
 
 class BundleError(ValueError):
@@ -185,6 +186,9 @@ def export_bundle(
     module_import: str | None = None,
     module_kwargs: dict | None = None,
     extra: dict | None = None,
+    warm: bool = False,
+    warm_max_batch: int = 32,
+    serve_bf16: bool = False,
 ) -> str:
     """Export a trained ``ES`` (device/pooled backend) into a bundle dir.
 
@@ -193,6 +197,22 @@ def export_bundle(
     generation).  ``module_import``/``module_kwargs`` override the
     automatic module spec for policies whose config fields don't encode
     to JSON.  Returns the absolute bundle path.
+
+    ``warm=True`` additionally packs the serving programs' compiled XLA
+    executables into the bundle (``warm/`` + manifest ``warm`` block,
+    serve/warm.py): the export process replays the serve-time load for a
+    ``warm_max_batch`` bucket ladder under a scoped compilation-cache
+    redirect, paying the JIT storm ONCE so every replica that loads the
+    bundle serves its first request without a fresh XLA build.
+
+    ``serve_bf16=True`` opts the bundle into the quantized serving fast
+    path (manifest ``serve_dtypes``) — the exporter's assertion that
+    accuracy-bounded bf16 answers are acceptable for this policy.  A
+    server started with ``--dtype bf16`` refuses bundles that did not
+    opt in.  Combined with ``warm=True`` the bf16 ladder is warmed too,
+    and a policy whose measured divergence exceeds the documented bound
+    fails the export with the diagnosis instead of shipping a bundle
+    every server will refuse.
     """
     if getattr(es, "backend", None) == "host":
         raise NotImplementedError(
@@ -260,6 +280,7 @@ def export_bundle(
         "obs_shape": [int(d) for d in np.shape(es._obs0)],
         "param_dim": int(flat.shape[0]),
         "recurrent": bool(getattr(es, "_recurrent", False)),
+        "serve_dtypes": ["f32"] + (["bf16"] if serve_bf16 else []),
         "obs_norm": obs_norm,
         "obs_clip": float(getattr(es, "_obs_clip", 5.0)),
         "frozen": frozen_meta,
@@ -278,11 +299,37 @@ def export_bundle(
     }
     if extra:
         manifest["extra"] = extra
+    _commit_manifest(path, manifest)
+    if warm:
+        from .warm import warm_bundle
+
+        # warm against the COMMITTED bundle (the replay loads it through
+        # the real load path), then re-commit the manifest with the warm
+        # block + checksums — a crash mid-warm leaves a valid cold bundle
+        warm_block, warm_shas = warm_bundle(
+            path, max_batch=warm_max_batch,
+            dtypes=manifest["serve_dtypes"])
+        manifest["warm"] = warm_block
+        manifest["sha256"].update(warm_shas)
+        # no decommit here: nothing between the two commits mutates the
+        # payload (unlike a re-export), and os.replace swaps atomically —
+        # a reader sees either the valid cold manifest or the warm one
+        _commit_manifest(path, manifest)
+    else:
+        # a re-export without warmth must not leave the PREVIOUS export's
+        # warm entries beside a manifest that no longer references them
+        import shutil
+
+        shutil.rmtree(os.path.join(path, WARM_DIR), ignore_errors=True)
+    return path
+
+
+def _commit_manifest(path: str, manifest: dict) -> None:
+    manifest_path = os.path.join(path, MANIFEST_NAME)
     tmp = manifest_path + ".tmp"
     with open(tmp, "w") as f:
         json.dump(manifest, f, indent=2, default=float)
     os.replace(tmp, manifest_path)  # the commit point
-    return path
 
 
 # ----------------------------------------------------------------- validate
@@ -325,13 +372,21 @@ def validate_bundle(path: str) -> dict:
             f"{MANIFEST_NAME} records no checksum for {ARRAYS_NAME} — "
             "not a bundle this version can trust"
         )
-    got = _sha256_file(arrays_path)
-    if got != want:
-        raise BundleError(
-            f"{ARRAYS_NAME} checksum mismatch (manifest {want[:12]}…, file "
-            f"{got[:12]}…) — the payload is corrupt or was modified after "
-            "export"
-        )
+    # EVERY checksummed file is verified — the warm payload is part of
+    # the artifact and gets the same integrity contract as arrays.npz
+    for rel, want in sorted(sha.items()):
+        fpath = os.path.join(path, *rel.split("/"))
+        if not os.path.exists(fpath):
+            raise BundleError(
+                f"bundle is missing checksummed file {rel!r}")
+        got = _sha256_file(fpath)
+        if got != want:
+            raise BundleError(
+                f"{rel} checksum mismatch (manifest {str(want)[:12]}…, "
+                f"file {got[:12]}…) — the payload is corrupt or was "
+                "modified after export"
+            )
+    _validate_warm_block(path, manifest)
     with np.load(arrays_path) as z:
         if "params_flat" not in z.files:
             raise BundleError(f"{ARRAYS_NAME} has no params_flat array")
@@ -342,6 +397,54 @@ def validate_bundle(path: str) -> dict:
             f"{manifest['param_dim']}"
         )
     return manifest
+
+
+def _validate_warm_block(path: str, manifest: dict) -> None:
+    """Structural validation of the packed warmth (jax-free — doctor's
+    warm probe runs this on wedged-runtime machines): the warm block must
+    name a known format, every entry must be checksummed AND present
+    (checksum bytes verified by the caller's sha loop), and the bucket
+    ladder must be COMPLETE — warmed + verification-excluded buckets
+    covering exactly the ladder of its recorded ``max_batch``, so a
+    served shape can't silently fall outside the warmth.  Version or
+    platform mismatch is NOT an error here — the bundle is valid, the
+    warmth just won't hit; ``serve/warm.py::install_warmth`` (and the
+    doctor) reports that as a finding."""
+    warm = manifest.get("warm")
+    if warm is None:
+        return
+    if not isinstance(warm, dict):
+        raise BundleError("manifest 'warm' block is not an object")
+    if warm.get("format") != "xla_cache":
+        raise BundleError(
+            f"warm block has unknown format {warm.get('format')!r} — "
+            "this version packs only 'xla_cache'")
+    for key in ("max_batch", "entries", "jax_version", "platform"):
+        if key not in warm:
+            raise BundleError(f"warm block is missing {key!r}")
+    entries = warm["entries"]
+    if not isinstance(entries, dict) or not entries:
+        raise BundleError("warm block packs no cache entries")
+    sha = manifest.get("sha256") or {}
+    for fname in entries:
+        rel = f"{WARM_DIR}/{fname}"
+        if rel not in sha:
+            raise BundleError(
+                f"warm entry {fname!r} has no checksum in the manifest — "
+                "the warmth cannot be trusted")
+    if not bool(warm.get("recurrent_only")):
+        try:
+            from .batcher import bucket_sizes
+
+            ladder = set(bucket_sizes(int(warm["max_batch"])))
+        except ValueError as e:
+            raise BundleError(f"warm block max_batch invalid: {e}") from e
+        covered = set(int(b) for b in warm.get("buckets", [])) | set(
+            int(b) for b in warm.get("buckets_excluded", []))
+        if covered != ladder:
+            raise BundleError(
+                f"warm block ladder incomplete: covers {sorted(covered)} "
+                f"but max_batch {warm['max_batch']} needs {sorted(ladder)}")
 
 
 # --------------------------------------------------------------------- load
@@ -363,6 +466,14 @@ class Bundle:
         self.obs_shape = tuple(manifest["obs_shape"])
         self.obs_clip = float(manifest.get("obs_clip", 5.0))
         self._obs_norm = bool(manifest.get("obs_norm", False))
+        # dtypes the EXPORTER opted this policy into serving with (old
+        # bundles predate the key: f32 only)
+        self.serve_dtypes = tuple(manifest.get("serve_dtypes") or ("f32",))
+        # packed warmth facts (serve/warm.py) — None on cold bundles;
+        # install status is recorded by load_bundle(install_warm=True)
+        self.warm_info = manifest.get("warm")
+        self.warm_status: dict | None = None
+        self._params_cast: dict = {}
 
         frozen_d = frozen
 
@@ -402,10 +513,31 @@ class Bundle:
             return self._predict_fn(self.params, self.obs_stats, obs, carry)
         return self._predict_fn(self.params, self.obs_stats, obs)
 
-    def batched_predict_fn(self):
+    def _params_for(self, dtype: str):
+        """Param tree for a serving dtype — the quantized cast happens
+        ONCE here (the engine's once-per-member discipline), never inside
+        the jitted forward."""
+        if dtype == "f32":
+            return self.params
+        if dtype not in self._params_cast:
+            import jax.numpy as jnp
+
+            from ..parallel.engine import _cast_leaves
+
+            self._params_cast[dtype] = _cast_leaves(self.params,
+                                                    jnp.bfloat16)
+        return self._params_cast[dtype]
+
+    def batched_predict_fn(self, dtype: str = "f32"):
         """``f(obs_batch (B, *obs_shape) np.ndarray) -> np.ndarray`` — the
         dynamic batcher's compute, one XLA compile per batch shape.
-        Stateless policies only (the server's contract)."""
+        Stateless policies only (the server's contract).
+
+        ``dtype="bf16"`` returns the quantized fast path (engine shim,
+        half the weight bytes streamed per batch) — refused with
+        :class:`BundleError` unless the bundle opted in at export
+        (``serve_dtypes``): quantized answers are an accuracy decision
+        the exporter makes, never a silent server-side downgrade."""
         if self.recurrent:
             raise BundleError(
                 "recurrent bundles cannot serve through the dynamic "
@@ -413,15 +545,22 @@ class Bundle:
                 "batcher coalesces unrelated requests; use predict(obs, "
                 "carry) in-process"
             )
+        if dtype != "f32" and dtype not in self.serve_dtypes:
+            raise BundleError(
+                f"bundle at {self.path!r} did not opt into {dtype} "
+                f"serving (serve_dtypes={list(self.serve_dtypes)}) — "
+                "re-export with export_bundle(..., serve_bf16=True) to "
+                "assert the quantized path is acceptable for this policy"
+            )
         import jax.numpy as jnp
 
         from .predictor import make_batched_predict
 
         fn = make_batched_predict(
             self._policy_apply, obs_norm=self._obs_norm,
-            obs_clip=self.obs_clip,
+            obs_clip=self.obs_clip, dtype=dtype,
         )
-        params, stats = self.params, self.obs_stats
+        params, stats = self._params_for(dtype), self.obs_stats
 
         def batch_predict(obs_batch: np.ndarray) -> np.ndarray:
             return np.asarray(fn(params, stats, jnp.asarray(obs_batch)))
@@ -429,11 +568,25 @@ class Bundle:
         return batch_predict
 
 
-def load_bundle(path: str) -> Bundle:
+def load_bundle(path: str, install_warm: bool = False) -> Bundle:
     """Validate + load a bundle; raises :class:`BundleError` on any
-    structural, checksum, or module-compatibility problem."""
+    structural, checksum, or module-compatibility problem.
+
+    ``install_warm=True`` installs the bundle's packed warmth (compiled
+    XLA programs, serve/warm.py) into this process's compilation cache
+    BEFORE any jax work — the serving fast path.  Incompatible warmth
+    (different jax version/platform) is skipped with the reason recorded
+    in ``bundle.warm_status``, never an error."""
     manifest = validate_bundle(path)
     path = os.path.abspath(path)
+
+    warm_status = None
+    if install_warm:
+        from .warm import install_warmth
+
+        # BEFORE the first jax compile below: the module re-init and
+        # param unravel are themselves programs the warmth covers
+        warm_status = install_warmth(path, manifest)
 
     import jax
     import jax.numpy as jnp
@@ -501,4 +654,6 @@ def load_bundle(path: str) -> Bundle:
             jnp.asarray(arrays["obs_stats.m2"]),
         )
 
-    return Bundle(path, manifest, module, params, frozen, obs_stats)
+    bundle = Bundle(path, manifest, module, params, frozen, obs_stats)
+    bundle.warm_status = warm_status
+    return bundle

@@ -277,6 +277,44 @@ def run_load(
     return out
 
 
+def coldstart_probe(
+    address: str,
+    *,
+    total: int = 100,
+    conns: int = 4,
+    obs: list | None = None,
+    timeout_s: float = 180.0,
+) -> dict:
+    """Cold-start measurement against a just-started server: the FIRST
+    request is fired alone on one connection (so any JIT pause lands on
+    exactly one measured sample — ``ttfr_s``), then the remainder of the
+    first ``total`` requests run concurrently for the early-tail
+    percentiles (``first_p99_ms``) — the two facts
+    ``bench.py --coldstart`` gates (docs/serving.md "Cold start &
+    quantized serving").  The caller measures process spawn → ready
+    separately; this probe owns ready → first answers."""
+    first = run_load(address, conns=1, total=1, duration_s=timeout_s,
+                     obs=obs, collect_latencies=True, timeout_s=timeout_s)
+    rest = {"errors": 0, "shed": 0, "latencies_s": []}
+    if total > 1:
+        rest = run_load(address, conns=conns, total=int(total) - 1,
+                        duration_s=timeout_s, obs=obs,
+                        collect_latencies=True, timeout_s=timeout_s)
+    lats = list(first.get("latencies_s", [])) + list(
+        rest.get("latencies_s", []))
+    lat_sorted = sorted(lats)
+    return {
+        "ttfr_s": round(first["latencies_s"][0], 4)
+        if first.get("latencies_s") else None,
+        "first_requests": len(lats),
+        "first_p50_ms": round(_percentile(lat_sorted, 0.50) * 1e3, 3),
+        "first_p99_ms": round(_percentile(lat_sorted, 0.99) * 1e3, 3),
+        "errors": first["errors"] + rest["errors"],
+        "shed": first.get("shed", 0) + rest.get("shed", 0),
+        "latencies_s": lats,
+    }
+
+
 def write_latency_rows(latencies_s: list, path: str,
                        endpoint: str = "/predict") -> str:
     """Per-request latency rows as JSONL (``{"endpoint", "latency_s"}``)
@@ -365,6 +403,10 @@ def main(argv=None) -> int:
     p.add_argument("--target-rps", type=float, default=None)
     p.add_argument("--obs", default=None,
                    help="JSON observation, e.g. '[0.1, 0.2, 0.3]'")
+    p.add_argument("--coldstart", type=int, default=None, metavar="N",
+                   help="cold-start probe instead of a load run: first "
+                        "request alone (time-to-first-response), then the "
+                        "first N requests' p50/p99")
     p.add_argument("--latencies-out", default=None, metavar="PATH",
                    help="also write per-request latency rows as JSONL "
                         "({'endpoint', 'latency_s'}) — the obs regress "
@@ -377,6 +419,16 @@ def main(argv=None) -> int:
         return _selfcheck()
     if not args.address:
         p.error("--address is required (or --selfcheck)")
+    if args.coldstart:
+        res = coldstart_probe(
+            args.address, total=args.coldstart, conns=args.conns,
+            obs=json.loads(args.obs) if args.obs else None)
+        lats = res.pop("latencies_s")
+        if args.latencies_out:
+            write_latency_rows(lats, args.latencies_out)
+            res["latencies_out"] = args.latencies_out
+        print(json.dumps(res))
+        return 0
     res = run_load(
         args.address, mode=args.mode, conns=args.conns,
         duration_s=args.duration, target_rps=args.target_rps,

@@ -29,6 +29,31 @@ from typing import Any, Callable
 
 import jax
 
+SERVE_DTYPES = ("f32", "bf16")
+
+
+def _apply_for_dtype(policy_apply: Callable[..., Any], dtype: str,
+                     recurrent: bool = False) -> Callable[..., Any]:
+    """The engine's bf16 I/O shim applied to a serving forward pass.
+
+    ``dtype="bf16"`` reuses ``parallel/engine.py``'s compute-dtype
+    machinery (obs cast in, output cast back to f32, params must ALREADY
+    be bf16 — cast once where they are built, ``Bundle._params_for``),
+    so the served quantized program is the same family the bf16 training
+    path runs.  Normalization composes OUTSIDE the shim exactly like the
+    engine: raw observations are normalized in f32, then cast.
+    """
+    if dtype not in SERVE_DTYPES:
+        raise ValueError(
+            f"serving dtype must be one of {SERVE_DTYPES}, got {dtype!r}")
+    if dtype == "f32":
+        return policy_apply
+    from ..parallel.engine import _bf16_io_apply, _bf16_io_apply_stateful
+
+    if recurrent:
+        return _bf16_io_apply_stateful(policy_apply)
+    return _bf16_io_apply(policy_apply)
+
 
 def make_single_predict(
     policy_apply: Callable[..., Any],
@@ -36,6 +61,7 @@ def make_single_predict(
     recurrent: bool = False,
     obs_norm: bool = False,
     obs_clip: float = 5.0,
+    dtype: str = "f32",
 ) -> Callable[..., Any]:
     """Jitted ``f(params, obs_stats, obs[, carry])`` for one observation.
 
@@ -49,7 +75,12 @@ def make_single_predict(
     broadcast over leading dims, and normalization is elementwise — the
     jitted batch call lands in the same GEMM family as
     :func:`make_batched_predict`'s rows.
+
+    ``dtype="bf16"`` builds the quantized program (engine shim, see
+    :func:`_apply_for_dtype`); params must already be bf16.
     """
+    policy_apply = _apply_for_dtype(policy_apply, dtype,
+                                    recurrent=recurrent)
     if obs_norm:
         from ..parallel.engine import normalize_obs
 
@@ -86,6 +117,7 @@ def make_batched_predict(
     *,
     obs_norm: bool = False,
     obs_clip: float = 5.0,
+    dtype: str = "f32",
 ) -> Callable[..., Any]:
     """Jitted ``f(params, obs_stats, obs_batch (B, *obs_shape)) -> (B, ...)``
     — the dynamic batcher's program, one XLA compile per batch shape.
@@ -93,7 +125,13 @@ def make_batched_predict(
     Stateless policies only: a recurrent policy's carry belongs to a
     session, and the batcher coalesces *unrelated* requests — the server
     refuses recurrent bundles rather than silently mixing carries.
+
+    ``dtype="bf16"`` builds the quantized fast path (engine shim; params
+    must already be bf16).  Its accuracy vs the f32 program is MEASURED
+    per bucket at load (``serve/batcher.py::measure_quant_divergence``),
+    never assumed — see docs/serving.md "Cold start & quantized serving".
     """
+    policy_apply = _apply_for_dtype(policy_apply, dtype)
     if obs_norm:
         from ..parallel.engine import normalize_obs
 

@@ -69,6 +69,10 @@ class PolicyServer:
         request_timeout_s: float = 30.0,
         telemetry=None,
         warm: bool = False,
+        dtype: str = "f32",
+        warm_install: bool = True,
+        quant_bound: float | None = None,
+        t0_monotonic: float | None = None,
     ):
         self.obs = resolve_telemetry(telemetry)
         self.max_batch = int(max_batch)
@@ -82,9 +86,23 @@ class PolicyServer:
         self.max_queue = int(max_queue)
         self.request_timeout_s = float(request_timeout_s)
         self.warm = bool(warm)
+        from .predictor import SERVE_DTYPES
+
+        if dtype not in SERVE_DTYPES:
+            raise BundleError(
+                f"serving dtype must be one of {SERVE_DTYPES}, got "
+                f"{dtype!r}")
+        self.dtype = dtype
+        self.warm_install = bool(warm_install)
+        self.quant_bound = quant_bound
         # monotonic: uptime is an elapsed measure (esguard R09 — an NTP
         # step must not make a healthy server report negative uptime)
-        self._started_mono = time.monotonic()
+        # t0_monotonic: the CLI stamps it at main() entry so startup_s
+        # covers the jax import, not just this constructor
+        self._started_mono = (time.monotonic() if t0_monotonic is None
+                              else float(t0_monotonic))
+        self._first_request_recorded = False
+        self._first_request_lock = threading.Lock()
         self.draining = False
         # per-request trace ids (docs/observability.md "Tails & traces"):
         # minted at HTTP entry, threaded through the batcher's recorder
@@ -100,34 +118,59 @@ class PolicyServer:
         # swaps would double-close one old engine and leak the other
         self._engine_lock = threading.Lock()
         self._engine = self._build_engine(bundle_path)
+        # cold-start facts (docs/serving.md "Cold start & quantized
+        # serving"): gauges so /metrics, the heartbeat, and the fleet
+        # dash all see how this replica came up
+        self.obs.counters.gauge(
+            "startup_s", round(time.monotonic() - self._started_mono, 3))
         self._httpd = _Httpd((host, int(port)), _make_handler(self))
         self.host, self.port = self._httpd.server_address[:2]
 
     # ----------------------------------------------------------- engine
 
     def _build_engine(self, bundle_path: str) -> _Engine:
-        bundle = load_bundle(bundle_path)
-        batch_fn = bundle.batched_predict_fn()  # refuses recurrent bundles
+        # count XLA executable builds across the load: fresh builds vs
+        # persistent-cache retrievals is THE warm-bundle proof (a warm
+        # load is all hits; utils/backend.py explains the event stream)
+        from ..utils.backend import (compile_event_counts,
+                                     install_compile_event_counters)
+        from .warm import build_serving_batcher
+
+        counted = install_compile_event_counters()
+        before = compile_event_counts()
+        t0 = time.perf_counter()
+        bundle = load_bundle(bundle_path, install_warm=self.warm_install)
         # the batcher's construction-time bucket verification doubles as
         # the compile warm-up for every ladder shape (serve/batcher.py);
         # --warm additionally pre-compiles the single-bucket case the
         # verification skips (max_batch=1, the A/B baseline)
-        try:
-            batcher = DynamicBatcher(
-                batch_fn, bundle.obs_shape, max_batch=self.max_batch,
-                max_wait_ms=self.max_wait_ms, max_queue=self.max_queue,
-                telemetry=self.obs,
-            )
-        except ValueError as e:
-            # slot-dependent anchor: a bundle-grade rejection, so /reload
-            # answers 409 and the CLI exits 2 with the diagnosis
-            raise BundleError(
-                f"bundle at {bundle_path!r} cannot serve deterministically "
-                f"under coalescing: {e}"
-            ) from e
+        batcher = build_serving_batcher(
+            bundle, max_batch=self.max_batch, max_wait_ms=self.max_wait_ms,
+            max_queue=self.max_queue, dtype=self.dtype,
+            quant_bound=self.quant_bound, telemetry=self.obs,
+        )
         if self.warm and len(batcher.buckets) == 1:
             b = batcher.buckets[0]
-            batch_fn(np.zeros((b,) + bundle.obs_shape, np.float32))
+            batcher.batch_fn(np.zeros((b,) + bundle.obs_shape, np.float32))
+        dt = time.perf_counter() - t0
+        after = compile_event_counts()
+        warm_installed = bool(bundle.warm_status
+                              and bundle.warm_status.get("installed"))
+        if counted:
+            hits = int(after["cache_hits"] - before["cache_hits"])
+            fresh = int(after["programs"] - before["programs"]) - hits
+        else:  # no monitoring stream on this jax build: warmth unproven
+            hits, fresh = 0, None
+        self.obs.counters.gauge("warm_cache_hits", hits)
+        self.obs.counters.gauge(
+            "compiles_at_load", -1 if fresh is None else fresh)
+        self.obs.compile_event(
+            "bundle_load", dt, count_recompiles=0, first_call=True,
+            cache_hits=hits, fresh_builds=fresh,
+            warm_installed=warm_installed,
+            **({"warm_skip_reason": bundle.warm_status["reason"]}
+               if bundle.warm_status and bundle.warm_status.get("reason")
+               else {}))
         return _Engine(bundle, batcher)
 
     def reload(self, bundle_path: str) -> dict:
@@ -158,12 +201,24 @@ class PolicyServer:
         while True:
             eng = self._engine
             try:
-                return eng.batcher.predict(obs,
-                                           timeout=self.request_timeout_s,
-                                           trace=trace)
+                out = eng.batcher.predict(obs,
+                                          timeout=self.request_timeout_s,
+                                          trace=trace)
             except BatcherClosed:
                 if self.draining or eng is self._engine:
                     raise
+                continue
+            if not self._first_request_recorded:
+                # time-to-first-response from process start — THE
+                # cold-start product metric; set once, raced safely
+                with self._first_request_lock:
+                    if not self._first_request_recorded:
+                        self._first_request_recorded = True
+                        self.obs.counters.gauge(
+                            "first_request_s",
+                            round(time.monotonic() - self._started_mono,
+                                  3))
+            return out
 
     def track_request(self):
         with self._inflight_lock:
@@ -245,6 +300,24 @@ class PolicyServer:
             "url": f"http://{host}:{self.port}/metrics",
         }
 
+    def cold_start(self) -> dict:
+        """The replica's cold-start facts (docs/serving.md): how long to
+        come up, how long to first answer, and the warm-bundle proof —
+        fresh XLA builds vs cache hits at load."""
+        c = self.obs.counters
+        fresh = c.get("compiles_at_load", -1)
+        eng = self._engine
+        out = {
+            "startup_s": c.get("startup_s") or None,
+            "first_request_s": (c.get("first_request_s")
+                                if self._first_request_recorded else None),
+            "compiles_at_load": None if fresh < 0 else int(fresh),
+            "warm_cache_hits": int(c.get("warm_cache_hits")),
+            "warm": eng.bundle.warm_status
+            or {"installed": False, "reason": "no warmth packed"},
+        }
+        return out
+
     def stats(self) -> dict:
         eng = self._engine
         return {
@@ -252,6 +325,8 @@ class PolicyServer:
             "bundle": eng.bundle.path,
             "source": eng.bundle.manifest.get("source"),
             "obs_shape": list(eng.bundle.obs_shape),
+            "dtype": self.dtype,
+            "cold_start": self.cold_start(),
             "max_wait_ms": self.max_wait_ms,
             "counters": self.obs.counters.snapshot(),
             # collector-discovery stanza (obs/agg/, docs/observability.md
@@ -446,6 +521,8 @@ def run_server(args) -> int:
         args.bundle, host=args.host, port=args.port,
         max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
         max_queue=args.max_queue, telemetry=telemetry, warm=args.warm,
+        dtype=args.dtype, warm_install=not args.no_warm,
+        t0_monotonic=getattr(args, "_t0_monotonic", None),
     )
 
     stop = threading.Event()
@@ -463,6 +540,8 @@ def run_server(args) -> int:
         "version": server._engine.bundle.version,
         "max_batch": server.max_batch,
         "buckets": list(server._engine.batcher.buckets),
+        "dtype": server.dtype,
+        "cold_start": server.cold_start(),
     }
     print(json.dumps(ready), flush=True)
     if args.port_file:
@@ -491,9 +570,11 @@ def supervised_child(root: str, argv: list) -> None:
     ``root`` by the Supervisor plumbing (resilience/supervisor.py), so
     platform policy must be re-applied here before jax initializes."""
     del root
+    t0 = time.monotonic()
     from .__main__ import build_parser
 
     args = build_parser().parse_args(argv)
+    args._t0_monotonic = t0
     if args.cpu_devices > 0:
         from ..utils import force_cpu_backend
 

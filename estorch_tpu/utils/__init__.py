@@ -1,5 +1,6 @@
-from .backend import (enable_compilation_cache, force_cpu_backend,
-                      set_host_device_count_flag)
+from .backend import (compile_event_counts, enable_compilation_cache,
+                      force_cpu_backend, install_compile_event_counters,
+                      scoped_compilation_cache, set_host_device_count_flag)
 from .checkpoint import (PeriodicCheckpointer, latest_checkpoint,
                          restore_checkpoint, save_checkpoint)
 from .fault import mask_and_renormalize, rank_weights_with_failures, valid_mask
@@ -7,8 +8,11 @@ from .metrics import JsonlWriter, MultiWriter, TensorBoardWriter
 from .profiler import annotate, timed_generations, trace
 
 __all__ = [
+    "compile_event_counts",
     "enable_compilation_cache",
     "force_cpu_backend",
+    "install_compile_event_counters",
+    "scoped_compilation_cache",
     "set_host_device_count_flag",
     "PeriodicCheckpointer",
     "latest_checkpoint",

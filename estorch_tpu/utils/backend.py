@@ -60,8 +60,27 @@ def enable_compilation_cache(
     # -1: no size floor AND no filesystem-specific override (the default 0
     # permits an override that can skip small entries on some filesystems)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    _disable_path_dependent_cache_keys()
     _reset_live_cache()
     return cache_dir
+
+
+def _disable_path_dependent_cache_keys() -> None:
+    """Keep cache keys independent of the cache DIRECTORY's path.
+
+    With the persistent cache enabled, jax (0.4.36+) default-enables
+    auxiliary XLA caches whose path — derived from the cache dir — lands
+    in ``debug_options`` and is hashed into every cache key
+    (``xla_gpu_per_fusion_autotune_cache_dir`` is not on the cache-key
+    sanitizer's clear list).  That makes entries non-portable: a warm
+    bundle's programs (compiled under ``<bundle>/warm``) could never hit
+    from the serving process's cache dir.  The auxiliary caches are
+    GPU-only machinery (fusion autotuning), nothing lost on cpu/tpu."""
+    import jax
+
+    if hasattr(jax.config, "jax_persistent_cache_enable_xla_caches"):
+        jax.config.update("jax_persistent_cache_enable_xla_caches", "")
+    # else: older jax — no auxiliary caches, keys were already portable
 
 
 def _reset_live_cache() -> None:
@@ -73,6 +92,116 @@ def _reset_live_cache() -> None:
         cc.reset_cache()
     except Exception:
         pass
+
+
+def current_compilation_cache_dir() -> str | None:
+    """The persistent-cache directory this process is configured with, or
+    None when the cache is disabled (the default outside conftest)."""
+    import jax
+
+    try:
+        return jax.config.jax_compilation_cache_dir or None
+    except AttributeError:
+        return None
+
+
+def scoped_compilation_cache(cache_dir: str, min_compile_time_s: float = 0.0):
+    """Context manager: redirect the persistent XLA compilation cache to
+    ``cache_dir`` for the duration of the block, then restore the prior
+    configuration (including "disabled").
+
+    ``min_compile_time_s=0`` persists EVERY program compiled inside the
+    block — the warm-bundle export wants the tiny auxiliary programs
+    (``convert_element_type``, ``broadcast_in_dim``, …) too, because a
+    "zero fresh builds at load" proof fails on any program left out.
+    Process-global (jax config is), so don't run concurrent exports.
+    """
+    import contextlib
+    import os
+
+    import jax
+
+    @contextlib.contextmanager
+    def _scope():
+        prior_dir = current_compilation_cache_dir()
+        prior_min = jax.config.jax_persistent_cache_min_compile_time_secs
+        prior_size = jax.config.jax_persistent_cache_min_entry_size_bytes
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          float(min_compile_time_s))
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        # portability is the POINT of the warm export: keys must not
+        # depend on where the cache dir happens to live
+        _disable_path_dependent_cache_keys()
+        _reset_live_cache()
+        try:
+            yield cache_dir
+        finally:
+            jax.config.update("jax_compilation_cache_dir", prior_dir or "")
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", prior_min)
+            jax.config.update(
+                "jax_persistent_cache_min_entry_size_bytes", prior_size)
+            _reset_live_cache()
+
+    return _scope()
+
+
+# --------------------------------------------------------------- XLA builds
+#
+# Cold start is made of XLA executable builds, and proving a warm bundle
+# works means COUNTING them: jax's monitoring stream emits
+# ``/jax/core/compile/backend_compile_duration`` once per executable
+# ACQUISITION (fresh build or persistent-cache retrieval — pxla wraps
+# ``compile_or_get_cached`` in it) and ``/jax/compilation_cache/cache_hits``
+# once per retrieval, so ``fresh = programs - cache_hits`` holds whether or
+# not a persistent cache is configured.  The serve server snapshots these
+# around bundle load to publish ``compiles_at_load`` / ``warm_cache_hits``.
+
+_COMPILE_EVENT_COUNTS = {"programs": 0, "cache_hits": 0, "build_s": 0.0}
+_COMPILE_COUNTERS_INSTALLED = False
+
+
+def install_compile_event_counters() -> bool:
+    """Idempotently register jax monitoring listeners feeding
+    :func:`compile_event_counts`.  Returns False (and stays inert) when
+    this jax version has no monitoring stream — callers degrade to
+    "warmth unproven", never to a crash."""
+    global _COMPILE_COUNTERS_INSTALLED
+    if _COMPILE_COUNTERS_INSTALLED:
+        return True
+    try:
+        from jax._src import monitoring
+    except Exception:
+        return False
+
+    def _on_event(event, **kw):
+        if event == "/jax/compilation_cache/cache_hits":
+            _COMPILE_EVENT_COUNTS["cache_hits"] += 1
+
+    def _on_duration(event, duration, **kw):
+        if event == "/jax/core/compile/backend_compile_duration":
+            _COMPILE_EVENT_COUNTS["programs"] += 1
+            _COMPILE_EVENT_COUNTS["build_s"] += float(duration)
+
+    try:
+        monitoring.register_event_listener(_on_event)
+        monitoring.register_event_duration_secs_listener(_on_duration)
+    except Exception:
+        return False
+    _COMPILE_COUNTERS_INSTALLED = True
+    return True
+
+
+def compile_event_counts() -> dict:
+    """Point-in-time copy of the build counters: ``programs`` (executable
+    acquisitions), ``cache_hits`` (persistent-cache retrievals among
+    them), ``build_s`` (wall seconds in acquisition — retrievals included,
+    they are milliseconds).  Delta two snapshots around a load to get the
+    load's fresh-build count: ``(programs - cache_hits)`` after minus
+    before."""
+    return dict(_COMPILE_EVENT_COUNTS)
 
 
 def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True):

@@ -105,6 +105,17 @@ echo "== serve selfcheck =="
 # the full `bench.py --serve` form and the tier-1 serving demo.
 python bench.py --serve --selfcheck
 
+echo "== coldstart selfcheck =="
+# warm-bundle + quantized-serving gate (serve/warm.py, docs/serving.md
+# "Cold start & quantized serving"): a warm bundle must load with ZERO
+# fresh XLA builds (all persistent-cache hits) while the cold control
+# leg provably pays the JIT storm, warm time-to-first-response must beat
+# cold beyond the learned noise band, and every bf16 bucket's divergence
+# must be measured inside the documented bound.  The >=1.5x bf16
+# throughput gate applies on native-bf16 hardware (TPU); off-chip the
+# ratio is recorded honestly (XLA:CPU bf16 is an upconvert path).
+python bench.py --coldstart --selfcheck
+
 echo "== compileall =="
 python -m compileall -q estorch_tpu/ tests/ examples/
 

@@ -1315,6 +1315,127 @@ class TestR13:
             assert not hits, [h.message for h in hits]
 
 
+class TestR14:
+    def test_jit_in_http_handler_flagged(self):
+        """The motivating hazard: a jit constructed inside do_POST means
+        trace + XLA compile on EVERY request — the recompile storm the
+        warm-bundle machinery kills, reintroduced one line at a time."""
+        found = findings("""
+            import jax
+
+            class Handler:
+                def do_POST(self):
+                    fn = jax.jit(lambda x: x * 2)
+                    return fn(self.obs)
+        """, "R14")
+        assert len(found) == 1
+        assert "per call" in found[0].message
+        assert "load/init" in found[0].hint
+
+    def test_jit_in_loop_body_flagged(self):
+        found = findings("""
+            import jax
+
+            def worker(batches):
+                while True:
+                    batch = batches.get()
+                    out = jax.jit(forward)(batch)
+        """, "R14")
+        assert len(found) == 1
+
+    def test_pmap_and_shard_map_count_as_ctors(self):
+        found = findings("""
+            import jax
+
+            def drain(items):
+                for x in items:
+                    jax.pmap(step)(x)
+        """, "R14")
+        assert len(found) == 1
+
+    def test_module_level_and_init_loops_clean(self):
+        """Load-time construction is the FIX, not a finding: module
+        scope, __init__, and builder-named functions may build a ladder
+        of programs in a loop."""
+        assert not findings("""
+            import jax
+
+            PROGRAMS = {}
+            for b in (2, 4, 8):
+                PROGRAMS[b] = jax.jit(forward)
+
+            class Engine:
+                def __init__(self, buckets):
+                    self._fns = {b: jax.jit(forward) for b in buckets}
+
+            def build_ladder(buckets):
+                out = {}
+                for b in buckets:
+                    out[b] = jax.jit(forward)
+                return out
+        """, "R14")
+
+    def test_calling_a_jitted_name_in_a_loop_clean(self):
+        """Dispatching an already-built wrapper per iteration is the
+        correct steady state — only CONSTRUCTION reports."""
+        assert not findings("""
+            import jax
+
+            fn = jax.jit(lambda x: x * 2)
+
+            def worker(batches):
+                for batch in batches:
+                    fn(batch)
+        """, "R14")
+
+    def test_for_iterator_expression_clean_while_test_flagged(self):
+        """A for's iterator evaluates ONCE before the loop — jit there
+        is construction, not per-iteration work; a while's TEST re-runs
+        every iteration and stays flagged."""
+        assert not findings("""
+            import jax
+
+            def drain(batch):
+                for row in jax.jit(forward)(batch):
+                    consume(row)
+        """, "R14")
+        found = findings("""
+            import jax
+
+            def spin(state):
+                while jax.jit(pred)(state):
+                    state = step(state)
+        """, "R14")
+        assert len(found) == 1
+
+    def test_nested_def_in_loop_clean(self):
+        assert not findings("""
+            import jax
+
+            def router(routes):
+                for name in routes:
+                    def handler(x):
+                        return jax.jit(lambda y: y)(x)
+                    routes[name] = handler
+        """, "R14")
+
+    def test_serve_modules_self_clean(self):
+        """Self-application across the serving vertical the rule was
+        written for."""
+        import estorch_tpu.serve.batcher as batcher
+        import estorch_tpu.serve.bundle as bundle
+        import estorch_tpu.serve.predictor as predictor
+        import estorch_tpu.serve.server as server
+        import estorch_tpu.serve.warm as warm
+
+        for mod in (predictor, bundle, batcher, server, warm):
+            with open(mod.__file__) as f:
+                src = f.read()
+            hits = [x for x in analyze_source(mod.__file__, src)
+                    if x.rule == "R14"]
+            assert not hits, [h.message for h in hits]
+
+
 # ---------------------------------------------------------------------
 # engine / CLI / config / baseline mechanics
 # ---------------------------------------------------------------------
@@ -1340,7 +1461,7 @@ class TestEngine:
     def test_every_rule_registered(self):
         ids = [r.id for r in all_rules()]
         assert ids == ["R01", "R02", "R03", "R04", "R05", "R06", "R07",
-                       "R08", "R09", "R10", "R11", "R12", "R13"]
+                       "R08", "R09", "R10", "R11", "R12", "R13", "R14"]
 
     def test_syntax_error_becomes_finding(self):
         found = analyze_source("bad.py", "def broken(:\n")
@@ -1474,7 +1595,7 @@ class TestConfig:
         assert cfg.baseline == "esguard_baseline.json"
         assert cfg.rule_ids([r.id for r in all_rules()]) == [
             "R01", "R02", "R03", "R04", "R05", "R06", "R07", "R08", "R09",
-            "R10", "R11", "R12", "R13"]
+            "R10", "R11", "R12", "R13", "R14"]
 
 
 class TestCLI:

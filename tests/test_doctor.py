@@ -423,6 +423,76 @@ class TestServeCheck:
         assert out["bundle"]["valid"] is True
         assert out["bundle"]["version"] == "v9"
         assert out["bundle"]["param_dim"] == 7
+        assert out["bundle"]["warm"] == {"present": False}
+
+    @staticmethod
+    def _warm_bundle(tmp_path, jax_version, **warm_over):
+        """Hand-crafted warm bundle — the probe must stay jax-free, so
+        the fixture is raw files + checksums, no export machinery."""
+        import hashlib
+        import json
+
+        import numpy as np
+
+        bdir = tmp_path / "wb"
+        bdir.mkdir()
+        arrays = bdir / "arrays.npz"
+        with open(arrays, "wb") as f:
+            np.savez(f, params_flat=np.zeros(7, np.float32))
+        (bdir / "warm").mkdir()
+        entry = bdir / "warm" / "jit_one-abc123-cache"
+        entry.write_bytes(b"fake executable bytes")
+        sha = {
+            "arrays.npz": hashlib.sha256(arrays.read_bytes()).hexdigest(),
+            "warm/jit_one-abc123-cache": hashlib.sha256(
+                entry.read_bytes()).hexdigest(),
+        }
+        warm = {
+            "format": "xla_cache", "max_batch": 4,
+            "buckets": [2, 4], "buckets_excluded": [],
+            "dtypes": ["f32"],
+            "entries": {"jit_one-abc123-cache": entry.stat().st_size},
+            "jax_version": jax_version, "platform": "cpu",
+            "device_count": 8,
+        }
+        warm.update(warm_over)
+        (bdir / "MANIFEST.json").write_text(json.dumps({
+            "schema": 1, "version": "v9",
+            "module": {"import": "whatever:NotImported", "kwargs": {}},
+            "obs_shape": [3], "param_dim": 7, "obs_norm": False,
+            "sha256": sha, "warm": warm,
+        }))
+        return bdir
+
+    def test_warm_probe_compatible(self, tmp_path):
+        from importlib.metadata import version
+
+        bdir = self._warm_bundle(tmp_path, version("jax"))
+        out = doctor.check_serve(bundle=str(bdir))
+        warm = out["bundle"]["warm"]
+        assert warm["present"] and warm["compatible"] is True
+        assert warm["entries"] == 1
+        assert "finding" not in warm
+
+    def test_warm_probe_version_mismatch_is_finding(self, tmp_path):
+        """The satellite contract: stale warmth (built under another jax)
+        is a structured FINDING naming the fix, never a traceback — and
+        the bundle itself still validates."""
+        bdir = self._warm_bundle(tmp_path, "0.0.0")
+        out = doctor.check_serve(bundle=str(bdir))
+        assert out["bundle"]["valid"] is True
+        warm = out["bundle"]["warm"]
+        assert warm["compatible"] is False
+        assert "0.0.0" in warm["finding"]
+        assert "re-export" in warm["finding"]
+
+    def test_warm_probe_ladder_incomplete_rejected(self, tmp_path):
+        """Structural breakage IS an error: a warm block whose buckets
+        don't cover its own max_batch ladder can't be trusted."""
+        bdir = self._warm_bundle(tmp_path, "0.0.0", buckets=[2])
+        out = doctor.check_serve(bundle=str(bdir))
+        assert out["bundle"]["valid"] is False
+        assert "ladder incomplete" in out["bundle"]["error"]
 
 
 class TestReport:

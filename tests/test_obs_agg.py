@@ -442,6 +442,51 @@ class TestDashUnits:
         assert "serve-a" in text and "DOWN" in text
         assert "replica-down" in text
 
+    def test_cold_column_renders_startup_and_fresh_builds(self, tmp_path):
+        """The PR-12 cold-start gauges land as a `cold` dash column:
+        startup seconds, with a `!N` suffix when the replica paid N
+        fresh XLA builds at load (a warm bundle makes that 0); targets
+        without the gauges (training runs) honestly render '-'."""
+        from estorch_tpu.obs.agg.dash import fleet_snapshot, render
+
+        root = str(tmp_path / "store")
+        s = SeriesStore(root)
+        now = time.time()
+        s.append([
+            {"name": "estorch_up", "labels": {"target": "warm"},
+             "value": 1},
+            {"name": "estorch_startup_s", "labels": {"target": "warm"},
+             "value": 0.9},
+            {"name": "estorch_compiles_at_load",
+             "labels": {"target": "warm"}, "value": 0},
+            {"name": "estorch_up", "labels": {"target": "coldish"},
+             "value": 1},
+            {"name": "estorch_startup_s",
+             "labels": {"target": "coldish"}, "value": 7.2},
+            {"name": "estorch_compiles_at_load",
+             "labels": {"target": "coldish"}, "value": 41},
+            {"name": "estorch_up", "labels": {"target": "train-run"},
+             "value": 1},
+            # -1 = the server's "no monitoring stream, warmth unproven"
+            # sentinel — must render distinctly from a proven-clean 0
+            {"name": "estorch_up", "labels": {"target": "unproven"},
+             "value": 1},
+            {"name": "estorch_startup_s",
+             "labels": {"target": "unproven"}, "value": 1.5},
+            {"name": "estorch_compiles_at_load",
+             "labels": {"target": "unproven"}, "value": -1},
+        ], ts=now)
+        snap = fleet_snapshot(root, window_s=60, now=now)
+        rows = {r["target"]: r for r in snap["targets"]}
+        assert rows["warm"]["startup_s"] == 0.9
+        assert rows["warm"]["compiles_at_load"] == 0
+        assert rows["train-run"]["startup_s"] is None
+        text = render(root, window_s=60, now=now)
+        assert "cold" in text.splitlines()[1]  # the header row
+        assert "0.9s" in text
+        assert "7.2s!41" in text  # fresh builds called out
+        assert "1.5s?" in text  # unproven warmth never reads as clean
+
     def test_resolved_alert_leaves_the_dash(self, tmp_path):
         from estorch_tpu.obs.agg.dash import fleet_snapshot
 

@@ -19,6 +19,7 @@ import sys
 import threading
 import time
 
+import flax.linen as flax_nn
 import jax
 import numpy as np
 import optax
@@ -791,13 +792,14 @@ def demo_bundle(tmp_path_factory):
     return es, path
 
 
-def _spawn_server(bundle, max_batch, extra_env=None, max_wait_ms=4.0):
+def _spawn_server(bundle, max_batch, extra_env=None, max_wait_ms=4.0,
+                  extra_args=()):
     env = {**os.environ, "JAX_PLATFORMS": "cpu", **(extra_env or {})}
     proc = subprocess.Popen(
         [sys.executable, "-m", "estorch_tpu.serve", "--bundle", bundle,
          "--port", "0", "--cpu-devices", "8",
          "--max-batch", str(max_batch), "--max-wait-ms", str(max_wait_ms),
-         "--beat-interval", "0.5"],
+         "--beat-interval", "0.5", *extra_args],
         stdout=subprocess.PIPE, text=True, env=env,
     )
     ready = json.loads(proc.stdout.readline())
@@ -960,3 +962,332 @@ class TestServingDemo:
         assert ratio >= 3.0, (
             f"dynamic batching {dyn_rps} rps vs batch-1 {b1_rps} rps = "
             f"{ratio:.2f}x < 3x")
+
+
+# =====================================================================
+# warm-start bundles (serve/warm.py, docs/serving.md "Cold start &
+# quantized serving")
+# =====================================================================
+
+@pytest.fixture(scope="module")
+def warm_bundle_path(small_es, tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("warm") / "pendulum_warm")
+    small_es.export_bundle(path, version="warm-v1", warm=True,
+                           warm_max_batch=4, serve_bf16=True)
+    return path
+
+
+class TestWarmBundle:
+    def test_warm_block_packed_and_checksummed(self, warm_bundle_path):
+        man = validate_bundle(warm_bundle_path)
+        warm = man["warm"]
+        assert warm["format"] == "xla_cache"
+        assert warm["entries"], "warm export packed no cache entries"
+        sha = man["sha256"]
+        for fname in warm["entries"]:
+            assert f"warm/{fname}" in sha
+            assert os.path.exists(
+                os.path.join(warm_bundle_path, "warm", fname))
+        # ladder complete: warmed + verification-excluded covers exactly
+        # the bucket ladder of the recorded max_batch
+        covered = set(warm["buckets"]) | set(warm["buckets_excluded"])
+        assert covered == set(bucket_sizes(warm["max_batch"]))
+        assert warm["dtypes"] == ["f32", "bf16"]
+        assert warm["jax_version"] == jax.__version__
+        assert warm["platform"] == "cpu"
+
+    def test_warm_corruption_rejected(self, warm_bundle_path, tmp_path):
+        import shutil
+
+        dst = str(tmp_path / "tampered")
+        shutil.copytree(warm_bundle_path, dst)
+        man = validate_bundle(warm_bundle_path)
+        fname = sorted(man["warm"]["entries"])[0]
+        victim = os.path.join(dst, "warm", fname)
+        with open(victim, "r+b") as f:
+            f.seek(0)
+            b = f.read(1)
+            f.seek(0)
+            f.write(bytes([b[0] ^ 0xFF]))
+        with pytest.raises(BundleError, match="checksum"):
+            validate_bundle(dst)
+        os.remove(victim)
+        with pytest.raises(BundleError, match="missing"):
+            validate_bundle(dst)
+
+    def test_version_mismatch_is_finding_not_error(self, warm_bundle_path,
+                                                   tmp_path):
+        """Warmth built under another jax version must be IGNORED with a
+        structured reason (load still succeeds, serving still works) —
+        and the doctor's warm probe reports the same finding."""
+        import shutil
+
+        dst = str(tmp_path / "stale_warm")
+        shutil.copytree(warm_bundle_path, dst)
+        man_path = os.path.join(dst, "MANIFEST.json")
+        with open(man_path) as f:
+            man = json.load(f)
+        man["warm"]["jax_version"] = "0.0.0"
+        with open(man_path, "w") as f:
+            json.dump(man, f)
+        b = load_bundle(dst, install_warm=True)
+        assert b.warm_status["installed"] is False
+        assert "0.0.0" in b.warm_status["reason"]
+        # still a perfectly servable bundle
+        out = b.batched_predict_fn()(np.zeros((2, 3), np.float32))
+        assert out.shape == (2, 1)
+        from estorch_tpu.doctor import check_serve
+
+        probe = check_serve(bundle=dst)["bundle"]["warm"]
+        assert probe["present"] and probe["compatible"] is False
+        assert "re-export" in probe["finding"]
+
+    def test_cold_bundle_reports_no_warmth(self, small_bundle):
+        b = load_bundle(small_bundle, install_warm=True)
+        assert b.warm_status["installed"] is False
+        assert "no warmth" in b.warm_status["reason"]
+        from estorch_tpu.doctor import check_serve
+
+        probe = check_serve(bundle=small_bundle)["bundle"]["warm"]
+        assert probe == {"present": False}
+
+    def test_reexport_without_warm_clears_stale_entries(self, small_es,
+                                                        tmp_path):
+        path = str(tmp_path / "re")
+        small_es.export_bundle(path, warm=True, warm_max_batch=4)
+        assert os.path.isdir(os.path.join(path, "warm"))
+        small_es.export_bundle(path)  # cold re-export over the same dir
+        man = validate_bundle(path)
+        assert "warm" not in man
+        assert not os.path.isdir(os.path.join(path, "warm"))
+
+    def test_warm_roundtrip_fresh_process_zero_fresh_builds(
+            self, small_es, warm_bundle_path):
+        """THE warm-bundle acceptance: a fresh --cpu-devices-pinned
+        process loads the warm bundle and serves its first request with
+        ZERO fresh XLA builds (every program a persistent-cache hit, per
+        the compile ledger's bundle_load accounting), answers bit-equal
+        to the exporting run, and leaves the bundle's checksums intact.
+        The --no-warm control leg on the SAME bundle pays the JIT storm,
+        proving the A/B is real."""
+        proc, ready = _spawn_server(warm_bundle_path, max_batch=4)
+        try:
+            cold = ready["cold_start"]
+            assert cold["warm"]["installed"] is True
+            assert cold["compiles_at_load"] == 0, (
+                f"warm load paid {cold['compiles_at_load']} fresh builds")
+            assert cold["warm_cache_hits"] > 0
+            obs = np.random.default_rng(11).standard_normal(3).astype(
+                np.float32)
+            with ServeClient(ready["url"].split("://")[1]) as c:
+                got = np.asarray(c.predict(obs), np.float32)
+                stats = c.stats()
+            ref = _anchor_ref(small_es, obs, max(stats["buckets"]))
+            assert got.tobytes() == ref.tobytes()
+            assert stats["cold_start"]["first_request_s"] is not None
+            assert stats["cold_start"]["startup_s"] is not None
+            code, final = _finish(proc)
+            assert code == 0 and final["clean"]
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+        # serving never wrote into the bundle: checksums still hold
+        validate_bundle(warm_bundle_path)
+
+        # control leg: same bundle, warmth ignored -> the JIT storm
+        proc, ready = _spawn_server(warm_bundle_path, max_batch=4,
+                                    extra_args=["--no-warm"])
+        try:
+            cold = ready["cold_start"]
+            assert cold["warm"]["installed"] is False
+            assert cold["compiles_at_load"] > 0
+            assert cold["warm_cache_hits"] == 0
+            code, final = _finish(proc)
+            assert code == 0 and final["clean"]
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+
+
+# =====================================================================
+# quantized serving: divergence measurement + bucket exclusion
+# (serve/batcher.py, jax-free) and the bf16 path (serve/predictor.py)
+# =====================================================================
+
+class TestQuantBatcher:
+    obs_shape = (3,)
+
+    @staticmethod
+    def _f32(arr):
+        return arr.sum(axis=1, keepdims=True).astype(np.float32)
+
+    def test_drifting_bucket_excluded_f32_fallback_answers(self):
+        """A quantized path that drifts at ONE bucket keeps serving:
+        that bucket is excluded (measured, counted) and dispatches the
+        exact f32 program at the same shape, while within-bound buckets
+        ride the quantized fast path."""
+        def quant(arr):
+            out = self._f32(arr) + 0.01  # inside the bound
+            if arr.shape[0] == 4:
+                out = out + 1e3  # engineered drift at bucket 4
+            return out
+
+        tel = Telemetry(enabled=True)
+        b = DynamicBatcher(self._f32, self.obs_shape, max_batch=8,
+                           max_wait_ms=40.0, telemetry=tel,
+                           quant_fn=quant, quant_bound=0.05)
+        try:
+            assert b.quant_buckets_excluded == (4,)
+            assert set(b.quant_buckets) == {2, 8}
+            assert b.quant_divergence[4] > 0.05
+            assert int(tel.counters.get("quant_buckets_excluded")) == 1
+            # one lone request pads to bucket 2 -> quantized value
+            got = b.predict([1.0, 2.0, 3.0], timeout=10.0)
+            assert got[0] == np.float32(6.0) + np.float32(0.01)
+            # three coalesced requests pad to bucket 4 -> EXCLUDED from
+            # the quant ladder -> exact f32 values
+            items = [b.submit([float(i), 1.0, 1.0]) for i in range(3)]
+            for i, it in enumerate(items):
+                assert it.event.wait(10.0)
+                assert it.result[0] == np.float32(i + 2.0)
+            stats = b.stats()
+            assert stats["quant"]["excluded"] == [4]
+            assert stats["quant"]["batches_total"] >= 1
+        finally:
+            b.close()
+
+    def test_anchor_drift_refused(self):
+        with pytest.raises(ValueError, match="anchor"):
+            DynamicBatcher(self._f32, self.obs_shape, max_batch=4,
+                           max_wait_ms=1.0,
+                           quant_fn=lambda a: self._f32(a) + 1e3,
+                           quant_bound=0.05)
+
+    def test_quant_needs_bound_and_verification(self):
+        with pytest.raises(ValueError, match="quant_bound"):
+            DynamicBatcher(self._f32, self.obs_shape, max_batch=4,
+                           quant_fn=self._f32)
+        with pytest.raises(ValueError, match="verification"):
+            DynamicBatcher(self._f32, self.obs_shape, max_batch=4,
+                           verify=False, quant_fn=self._f32,
+                           quant_bound=0.05)
+
+    def test_nonfinite_quant_output_is_infinite_divergence(self):
+        from estorch_tpu.serve.batcher import measure_quant_divergence
+
+        def quant(arr):
+            out = self._f32(arr)
+            out[0] = np.nan
+            return out
+
+        div = measure_quant_divergence(quant, self._f32, self.obs_shape,
+                                       [2, 4])
+        assert div[2] == float("inf") and div[4] == float("inf")
+
+    def test_batch1_ladder_measures_divergence_too(self):
+        """max_batch=1 (the GEMV baseline) still gets the accuracy
+        contract: divergence measured at bucket 1, refused past bound."""
+        b = DynamicBatcher(self._f32, self.obs_shape, max_batch=1,
+                           max_wait_ms=1.0,
+                           quant_fn=lambda a: self._f32(a) + 0.001,
+                           quant_bound=0.05)
+        try:
+            assert b.quant_buckets == (1,)
+            assert 1 in b.quant_divergence
+        finally:
+            b.close()
+        with pytest.raises(ValueError, match="anchor"):
+            DynamicBatcher(self._f32, self.obs_shape, max_batch=1,
+                           max_wait_ms=1.0,
+                           quant_fn=lambda a: self._f32(a) + 1e3,
+                           quant_bound=0.05)
+
+
+class DriftPolicy(flax_nn.Module):
+    """bf16-hostile by construction: the +4096/-4096 round trip keeps
+    the (tiny) signal in f32 but destroys it at bf16's 8 mantissa bits
+    — the policy-exceeds-the-bound refusal case."""
+
+    @flax_nn.compact
+    def __call__(self, x):
+        # weak-typed python literals follow the computation dtype: in
+        # bf16 the +4096 absorbs the whole signal (8 mantissa bits), in
+        # f32 it survives — a jnp.float32 constant would instead promote
+        # the bf16 activations back to f32 and defeat the engineering
+        h = flax_nn.Dense(1)(x) * 0.01
+        return (h + 4096.0) - 4096.0
+
+
+class TestBf16Serving:
+    def test_bf16_refused_without_opt_in(self, small_bundle):
+        b = load_bundle(small_bundle)
+        with pytest.raises(BundleError, match="did not opt into"):
+            b.batched_predict_fn(dtype="bf16")
+
+    def test_bf16_server_serves_within_measured_bound(self, small_es,
+                                                      warm_bundle_path):
+        """An opted-in policy serves bf16 with per-bucket divergence
+        MEASURED at load and every answer inside the documented bound of
+        the f32 reference."""
+        from estorch_tpu.serve import PolicyServer
+        from estorch_tpu.serve.warm import BF16_DIVERGENCE_BOUND
+
+        srv = PolicyServer(warm_bundle_path, port=0, max_batch=4,
+                           max_wait_ms=2.0, dtype="bf16",
+                           telemetry=Telemetry(enabled=True))
+        srv.start_background()
+        try:
+            obs = np.random.default_rng(12).standard_normal(3).astype(
+                np.float32)
+            with ServeClient(f"{srv.host}:{srv.port}") as c:
+                got = np.asarray(c.predict(obs), np.float32)
+                stats = c.stats()
+            quant = stats["quant"]
+            assert quant["dtype"] == "bf16"
+            assert quant["bound"] == BF16_DIVERGENCE_BOUND
+            for b_, d in quant["divergence"].items():
+                if int(b_) in quant["buckets"]:
+                    assert d <= BF16_DIVERGENCE_BOUND
+            assert stats["dtype"] == "bf16"
+            ref = _anchor_ref(small_es, obs, max(stats["buckets"]))
+            scale = max(abs(float(ref[0])), 1e-6)
+            assert abs(float(got[0]) - float(ref[0])) <= (
+                BF16_DIVERGENCE_BOUND * max(scale, 2.0))
+        finally:
+            srv.shutdown(drain=True)
+
+    def test_drift_policy_refused_as_bundle_error(self, tmp_path):
+        """A policy whose bf16 divergence exceeds the bound at the
+        anchor is REFUSED (the server's 409 / CLI exit 2), never served
+        quantized-but-wrong; the same bundle serves f32 fine."""
+        es = _make_small_es(policy=DriftPolicy, policy_kwargs={},
+                            obs_norm=False)
+        path = str(tmp_path / "drift")
+        es.export_bundle(path, serve_bf16=True)
+        from estorch_tpu.serve import PolicyServer
+        from estorch_tpu.serve.warm import build_serving_batcher
+
+        with pytest.raises(BundleError, match="divergence bound"):
+            build_serving_batcher(load_bundle(path), max_batch=4,
+                                  dtype="bf16")
+        # the exact path still answers: f32 serving of the same bundle
+        srv = PolicyServer(path, port=0, max_batch=4, dtype="f32")
+        srv.start_background()
+        try:
+            with ServeClient(f"{srv.host}:{srv.port}") as c:
+                out = c.predict([0.1, 0.2, 0.3])
+            assert np.isfinite(np.asarray(out, np.float32)).all()
+        finally:
+            srv.shutdown(drain=True)
+
+    def test_warm_export_fails_loudly_on_drift_policy(self, tmp_path):
+        """warm=True + serve_bf16=True REPLAYS the bf16 verification at
+        export: a drifting policy fails the export with the diagnosis
+        instead of shipping a bundle every server will 409."""
+        es = _make_small_es(policy=DriftPolicy, policy_kwargs={},
+                            obs_norm=False)
+        with pytest.raises(BundleError, match="divergence bound"):
+            es.export_bundle(str(tmp_path / "drift_warm"), warm=True,
+                             warm_max_batch=4, serve_bf16=True)
