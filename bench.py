@@ -1665,6 +1665,179 @@ def stage_coldstart(selfcheck=False):
     return 0 if ok else 1
 
 
+def measure_fleet_one(cfg):
+    """Child body for --stage-fleet-one: export a warm bundle, run a
+    2-replica fleet + front router (serve/fleet.py) with a declared
+    ``kill_replica`` chaos event mid-load, then a capacity sweep against
+    the router.  Returns one JSON row; stage_fleet gates it."""
+    from estorch_tpu.utils import force_cpu_backend
+
+    force_cpu_backend(1)
+    import jax
+    import optax
+
+    from estorch_tpu import ES, JaxAgent
+    from estorch_tpu.envs.pendulum import Pendulum
+    from estorch_tpu.models import MLPPolicy
+    from estorch_tpu.resilience.chaos import CHAOS_ENV
+    from estorch_tpu.serve.client import ServeClient
+    from estorch_tpu.serve.fleet import Fleet
+    from estorch_tpu.serve.loadgen import capacity_sweep, run_load
+
+    hidden = int(cfg.get("hidden", 64))
+    max_batch = int(cfg.get("max_batch", 4))
+    duration_s = float(cfg.get("duration_s", 6.0))
+    kill_at_s = float(cfg.get("kill_at_s", 2.0))
+    es = ES(
+        MLPPolicy, JaxAgent(Pendulum(), horizon=8), optax.adam,
+        population_size=4, sigma=0.05, seed=0,
+        policy_kwargs={"action_dim": 1, "hidden": (hidden, hidden),
+                       "discrete": False, "action_scale": 2.0},
+        optimizer_kwargs={"learning_rate": 0.01},
+        table_size=1 << 14, device=jax.devices()[0],
+    )
+    es.train(1, verbose=False)
+
+    import shutil
+
+    workdir = tempfile.mkdtemp(prefix="fleet_bench_")
+    fleet = None
+    try:
+        bundle = es.export_bundle(os.path.join(workdir, "bundle"),
+                                  warm=True, warm_max_batch=max_batch)
+        fleet = Fleet(
+            {"schema": 1, "bundle": bundle, "replicas": 2,
+             "serve": {"max_batch": max_batch, "cpu_devices": 1},
+             "router": {"retry_budget": 2, "breaker_open_s": 0.5},
+             "respawn": {"backoff_s": 0.2}},
+            os.path.join(workdir, "run"), port=0)
+        fleet.start()
+        if not fleet.wait_ready(180):
+            return {"error": "fleet did not come up",
+                    "status": fleet.status()}
+        # declare the chaos only once the fleet serves: kill_at_s means
+        # seconds into SERVING, not into the replicas' jax import
+        os.environ[CHAOS_ENV] = json.dumps({
+            "events": [{"kind": "kill_replica", "at_s": kill_at_s,
+                        "replica": 1}],
+            "ledger": os.path.join(workdir, "chaos_ledger")})
+        fleet.arm_chaos()
+        addr = f"{fleet.router.host}:{fleet.router.port}"
+        load = run_load(addr, conns=8, duration_s=duration_s,
+                        obs=[0.1, 0.2, 0.3])
+        # wait for the respawn to land so its warm proof is readable
+        t0 = time.monotonic()
+        respawned = False
+        while time.monotonic() - t0 < 120:
+            slot = fleet.slots[1]
+            breakers = {r.name: r.breaker.state
+                        for r in fleet.router.replicas()}
+            if (slot.restarts >= 1 and slot.state == "up"
+                    and breakers.get("r1") == "closed"):
+                respawned = True
+                break
+            time.sleep(0.2)
+        cold = None
+        if respawned:
+            with ServeClient(fleet.slots[1].address) as c:
+                cold = c.stats().get("cold_start")
+        sweep = capacity_sweep(addr, slo_ms=float(
+            cfg.get("slo_ms", 2000.0)),
+            rps_ladder=[float(r) for r in cfg.get("rps_ladder",
+                                                  [50, 100])],
+            conns=8, rung_duration_s=float(cfg.get("rung_s", 1.0)),
+            obs=[0.1, 0.2, 0.3])
+        st = fleet.router.stats()
+        return {
+            "load": {k: load[k] for k in ("requests", "errors", "shed",
+                                          "throughput_rps",
+                                          "latency_ms")},
+            "counters": st["counters"],
+            "respawned": respawned,
+            "respawn_cold_start": cold,
+            "events": [e["event"] for e in fleet.events],
+            "capacity": sweep,
+            "platform": "cpu", "cfg": cfg,
+        }
+    finally:
+        if fleet is not None:
+            fleet.shutdown()
+        os.environ.pop(CHAOS_ENV, None)
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+def stage_fleet(selfcheck=False):
+    """Fleet robustness gate (serve/router.py + serve/fleet.py,
+    docs/serving.md "Fleet"); the selfcheck form is the run_lint.sh
+    gate.  Gates: a replica SIGKILLed under concurrent load loses ZERO
+    client answers (failover retries within the budget), the breaker
+    opened and re-closed, the fleet respawned the corpse WARM
+    (compiles_at_load == 0 — PR-12 bundles make a respawn free), and
+    the capacity sweep reports a sane max-RPS-at-SLO ladder."""
+    cfg = ({"hidden": 48, "duration_s": 5.0, "kill_at_s": 2.0,
+            "rps_ladder": [40, 80], "rung_s": 0.8}
+           if selfcheck else
+           {"hidden": 512, "duration_s": 10.0, "kill_at_s": 3.0,
+            "rps_ladder": [50, 100, 200, 400], "rung_s": 2.0})
+    argv = [sys.executable, __file__, "--stage-fleet-one",
+            json.dumps(cfg)]
+    child_env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    try:
+        r = subprocess.run(argv, timeout=900, capture_output=True,
+                           text=True, env=child_env)
+    except subprocess.TimeoutExpired:
+        print(json.dumps({"label": "fleet", "error": "timeout after "
+                                                     "900s"}),
+              flush=True)
+        return 1
+    try:
+        last = [ln for ln in r.stdout.strip().splitlines()
+                if ln.startswith("{")][-1]
+        row = json.loads(last)
+    except (IndexError, ValueError):
+        print(json.dumps({"label": "fleet", "error":
+                          f"stage exited {r.returncode}",
+                          "stderr_tail": r.stderr[-800:]}), flush=True)
+        return 1
+    problems = []
+    if row.get("error"):
+        problems.append(row["error"])
+    else:
+        load = row["load"]
+        if load["errors"] or load["shed"]:
+            problems.append(
+                f"lost client answers under the kill: {load['errors']} "
+                f"errors, {load['shed']} shed of {load['requests']}")
+        if load["requests"] < 50:
+            problems.append(f"load too thin to prove anything: "
+                            f"{load['requests']} requests")
+        c = row["counters"]
+        if not c.get("router_breaker_opens_total"):
+            problems.append("breaker never opened for the killed "
+                            "replica")
+        if not row["respawned"]:
+            problems.append("fleet did not respawn the killed replica "
+                            "(or its breaker never re-closed)")
+        else:
+            # warmth is only measurable on a replica that DID respawn
+            cold = row.get("respawn_cold_start") or {}
+            if cold.get("compiles_at_load") != 0:
+                problems.append(
+                    f"respawn was not warm: compiles_at_load="
+                    f"{cold.get('compiles_at_load')} (want 0)")
+        cap = row["capacity"]
+        if cap.get("max_rps_at_slo") is None:
+            problems.append(f"capacity sweep found no passing rung: "
+                            f"{cap}")
+        if any(not rung["requests"] for rung in cap["rungs"]):
+            problems.append(f"capacity rung ran zero requests: "
+                            f"{cap['rungs']}")
+    ok = not problems
+    print(json.dumps({"label": "fleet", **row, "problems": problems,
+                      "pass": ok}), flush=True)
+    return 0 if ok else 1
+
+
 def _default_regress_baseline() -> str | None:
     """Newest committed BENCH_r*.json beside this file, by name."""
     import glob
@@ -2043,6 +2216,11 @@ no arguments        full headline benchmark (device probe decides the
                      gates the >=1.25x throughput win and the
                      zero-silent-drop accounting)
   --serve [--selfcheck]   dynamic-batching serving A/B
+  --fleet [--selfcheck]   serving-fleet robustness gate: replica SIGKILL
+                    under load (declared ESTORCH_CHAOS kill_replica)
+                    loses zero client answers, breaker opens/closes,
+                    warm respawn (compiles_at_load==0), capacity-sweep
+                    max-RPS-at-SLO ladder
   --coldstart [--selfcheck]  warm-bundle vs cold-start A/B + bf16
                     steady-state throughput (gates zero-fresh-builds
                     warm loads, warm-beats-cold TTFR beyond the learned
@@ -2059,7 +2237,7 @@ no arguments        full headline benchmark (device probe decides the
                     committed history
   --regress [BASELINE] [--repeats N] [--cpu]   gate vs newest BENCH_r*.json
 (--stage-one/--stage-chaos-one/--stage-async-one/--stage-serve-one/
- --stage-shard-ab-one are internal child modes)
+ --stage-fleet-one/--stage-shard-ab-one are internal child modes)
 """
 
 
@@ -2106,6 +2284,15 @@ if __name__ == "__main__":
     elif "--stage-serve-one" in sys.argv:
         cfg = json.loads(sys.argv[sys.argv.index("--stage-serve-one") + 1])
         print(json.dumps(measure_serve_one(cfg)))
+    elif "--stage-fleet-one" in sys.argv:
+        cfg = json.loads(sys.argv[sys.argv.index("--stage-fleet-one") + 1])
+        print(json.dumps(measure_fleet_one(cfg)))
+    elif "--fleet" in sys.argv:
+        # the selfcheck form runs inside run_lint.sh (tiny policy, CPU,
+        # loopback only): skip the evidence lock a full measurement takes
+        if "--selfcheck" not in sys.argv:
+            _lock_or_warn()
+        sys.exit(stage_fleet(selfcheck="--selfcheck" in sys.argv))
     elif "--stage-coldstart-one" in sys.argv:
         cfg = json.loads(
             sys.argv[sys.argv.index("--stage-coldstart-one") + 1])

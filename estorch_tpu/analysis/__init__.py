@@ -27,6 +27,12 @@ Rules (docs/analysis.md has the full rationale per rule):
 * R12 gauge-shaped-latency    — perf_counter/monotonic duration recorded
                                 via a last-write-wins gauge (tail erased;
                                 observe into a histogram instead)
+* R13 untimed-network-call    — urlopen/HTTPConnection/create_connection
+                                without timeout= (block-forever default)
+* R14 jit-in-request-path     — jit/pmap/shard_map constructed inside a
+                                request handler or non-load-time loop
+* R15 unbounded-retry         — network retry loop with no attempt bound
+                                or no backoff between attempts
 
 Nothing in this package imports jax or the analyzed modules — analysis
 is pure ``ast`` and safe to run where no accelerator exists.

@@ -1,6 +1,6 @@
 """Host-side robustness rules: R05 untimed-subprocess-wait,
 R06 signature-probe-default, R11 blocking-wait-in-scheduler,
-R13 untimed-network-call.
+R13 untimed-network-call, R15 unbounded-retry.
 
 R05 is the wedge class ``doctor.py`` exists to detect after the fact:
 a ``proc.wait()`` / ``proc.communicate()`` with no timeout turns a hung
@@ -29,6 +29,19 @@ global socket default (None: block forever), so one replica that
 accepts the TCP connection and then goes silent wedges the scraper,
 the client, or the doctor probe that called it.  CPython's own default
 timeouts are None throughout; the bound must be at the call site.
+
+R15 is the retry half of the same failure story: a loop that catches a
+network call's exception and tries again with NO attempt bound (``while
+True``) turns a dead peer into an infinite hammer, and one with no
+backoff/sleep between attempts turns a mass failover into a stampede
+that finishes off the survivors.  The front router's budgeted retry
+(serve/router.py: ``for attempt in range(1 + retry_budget)`` with
+exponential backoff + jitter) is the prescribed shape.  Scope is
+syntactic: the network call must be visible inside the loop's try body
+(a retry that delegates to a helper is judged where the helper makes
+its calls), and a handler that contains any ``raise`` is treated as
+escalating, not retrying — the single stale-keep-alive reconnect idiom
+(serve/client.py) raises on its second failure and stays clean.
 """
 
 from __future__ import annotations
@@ -310,6 +323,131 @@ def check_untimed_network(ctx: ModuleContext):
                 "TimeoutError/OSError (count it, retry, or mark the "
                 "peer down)",
                 symbol))
+    return out
+
+
+# ---------------------------------------------------------------------
+# R15 unbounded-retry
+# ---------------------------------------------------------------------
+
+def _is_net_call(ctx: ModuleContext, node: ast.Call) -> bool:
+    """The calls whose failure a retry loop plausibly retries: the R13
+    connect/request layer (urlopen / HTTP[S]Connection /
+    create_connection) plus ``.request()``/``.getresponse()`` on a
+    conn-ish receiver."""
+    resolved = ctx.resolve(node.func)
+    if resolved in _NET_CALLS or (resolved or "").endswith(".urlopen"):
+        return True
+    if isinstance(node.func, ast.Attribute) \
+            and node.func.attr in ("request", "getresponse"):
+        tail = _receiver_tail(node.func)
+        return tail is not None and bool(_CONNISH_NAME.search(tail))
+    return False
+
+
+def _loop_is_unbounded(loop: ast.While | ast.For,
+                       ctx: ModuleContext) -> bool:
+    if isinstance(loop, ast.While):
+        t = loop.test
+        return isinstance(t, ast.Constant) and bool(t.value)
+    resolved = (ctx.resolve(loop.iter.func)
+                if isinstance(loop.iter, ast.Call) else None)
+    return resolved == "itertools.count"
+
+
+def _has_backoff(loop: ast.While | ast.For, ctx: ModuleContext) -> bool:
+    """Any sleep-shaped call in the loop body: ``time.sleep``, a
+    ``.sleep()`` method, or an event-style ``.wait(timeout)`` — all
+    space attempts out."""
+    for node in ast.walk(loop):
+        if not isinstance(node, ast.Call):
+            continue
+        resolved = ctx.resolve(node.func)
+        if resolved == "time.sleep":
+            return True
+        if isinstance(node.func, ast.Attribute):
+            if node.func.attr == "sleep":
+                return True
+            if node.func.attr == "wait" and (node.args or node.keywords):
+                return True
+    return False
+
+
+def _retrying_handlers(try_node: ast.Try) -> list[ast.ExceptHandler]:
+    """Handlers that swallow the failure back into the loop: no
+    ``raise`` anywhere in the handler body.  A handler that re-raises
+    (even conditionally, like the client's second-attempt escalation)
+    is bounding its own patience."""
+    out = []
+    for handler in try_node.handlers:
+        if not any(isinstance(n, ast.Raise)
+                   for stmt in handler.body for n in ast.walk(stmt)):
+            out.append(handler)
+    return out
+
+
+def _walk_own_body(loop: ast.While | ast.For):
+    """Nodes of ``loop`` WITHOUT descending into nested loops: a
+    bounded, backed-off retry inside an outer ``while True`` dispatcher
+    must be judged as its own (innermost) loop, not pinned on the
+    outer one."""
+    stack = list(ast.iter_child_nodes(loop))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.While, ast.For, ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+@rule("R15", "unbounded-retry", "error",
+      "network retry loop with no attempt bound or no backoff between "
+      "attempts")
+def check_unbounded_retry(ctx: ModuleContext):
+    r = get_rule("R15")
+    out = []
+    for symbol, scope in iter_scopes(ctx):
+        for loop in scope_nodes(scope):
+            if not isinstance(loop, (ast.While, ast.For)):
+                continue
+            # the retry shape: a try in THIS loop's own body (nested
+            # loops are judged separately as their own retry loops)
+            # whose body makes a network call and whose handler
+            # swallows the failure into the next iteration
+            retries_net = False
+            for node in _walk_own_body(loop):
+                if not isinstance(node, ast.Try):
+                    continue
+                if not _retrying_handlers(node):
+                    continue
+                if any(_is_net_call(ctx, c)
+                       for stmt in node.body
+                       for c in ast.walk(stmt)
+                       if isinstance(c, ast.Call)):
+                    retries_net = True
+                    break
+            if not retries_net:
+                continue
+            if _loop_is_unbounded(loop, ctx):
+                out.append(make_finding(
+                    ctx, r, loop,
+                    "unbounded network retry: this loop catches the "
+                    "failure and tries again forever — a dead peer "
+                    "becomes an infinite hammer",
+                    "bound the attempts (`for attempt in range(1 + "
+                    "budget)`) and back off exponentially with jitter "
+                    "between them (serve/router.py is the shape)",
+                    symbol))
+            elif not _has_backoff(loop, ctx):
+                out.append(make_finding(
+                    ctx, r, loop,
+                    "network retry loop with no backoff: immediate "
+                    "re-attempts turn a mass failover into a stampede "
+                    "on the survivors",
+                    "sleep between attempts (exponential backoff + "
+                    "jitter, `time.sleep(base * 2**attempt * jitter)`) "
+                    "or escalate after the first failure",
+                    symbol))
     return out
 
 

@@ -746,6 +746,106 @@ def _probe_bundle_warmth(manifest: dict) -> dict:
     return out
 
 
+def check_router() -> dict:
+    """Can this host run the fleet front router?  (serve/router.py,
+    docs/serving.md "Fleet")
+
+    Loopback end-to-end probe, jax-free: spin a 2-replica TOY fleet
+    (stdlib HTTP servers answering the /predict //healthz //stats
+    shapes), route through a real :class:`Router`, then kill one
+    replica and assert the next requests still answer (failover within
+    the retry budget) and that the router's ``/metrics`` parses through
+    the validating parser.  Never crashes the report: any failure comes
+    back as ``{"ok": False, ...}``."""
+    import json as _json
+    import threading
+    import urllib.request
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    try:
+        from .obs.export.prometheus import parse_exposition
+        from .serve.router import Router
+
+        def make_replica():
+            class Toy(BaseHTTPRequestHandler):
+                protocol_version = "HTTP/1.1"
+
+                def log_message(self, *a):
+                    pass
+
+                def _j(self, obj):
+                    body = _json.dumps(obj).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+
+                def do_GET(self):
+                    if self.path == "/healthz":
+                        self._j({"ok": True, "draining": False,
+                                 "queue_depth": 0})
+                    else:
+                        self._j({"queue_depth": 0,
+                                 "request_ms": {"p99": 1.0}})
+
+                def do_POST(self):
+                    n = int(self.headers.get("Content-Length", 0))
+                    data = _json.loads(self.rfile.read(n))
+                    self._j({"action": [v * 2.0 for v in data["obs"]]})
+
+            srv = ThreadingHTTPServer(("127.0.0.1", 0), Toy)
+            threading.Thread(target=srv.serve_forever,
+                             daemon=True).start()
+            return srv
+
+        problems = []
+        a, b = make_replica(), make_replica()
+        router = Router(
+            [("ra", f"127.0.0.1:{a.server_address[1]}"),
+             ("rb", f"127.0.0.1:{b.server_address[1]}")],
+            port=0, poll_interval_s=30.0,  # stale health: exercise RETRY
+            upstream_timeout_s=5.0)
+        router.start_background()
+        try:
+            url = f"http://{router.host}:{router.port}"
+
+            def predict(obs):
+                req = urllib.request.Request(
+                    url + "/predict",
+                    _json.dumps({"obs": obs}).encode(),
+                    {"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    return _json.loads(r.read())
+
+            if predict([1.0])["action"] != [2.0]:
+                problems.append("routed predict answered wrong")
+            a.shutdown()
+            a.server_close()
+            for i in range(4):  # must fail over to rb, zero errors
+                got = predict([float(i)])["action"]
+                if got != [2.0 * i]:
+                    problems.append(f"failover answer wrong: {got}")
+            st = router.stats()
+            retries = st["counters"].get("router_retries_total", 0)
+            with urllib.request.urlopen(url + "/metrics",
+                                        timeout=10) as r:
+                body = r.read().decode()
+            parse_exposition(body)
+            if "estorch_router_breaker_state" not in body:
+                problems.append("per-replica breaker gauge missing "
+                                "from /metrics")
+            return {"ok": not problems, "retries": int(retries),
+                    "breakers": {x["name"]: x["breaker"]
+                                 for x in st["replicas"]},
+                    **({"problems": problems} if problems else {})}
+        finally:
+            router.shutdown(drain=False)
+            b.shutdown()
+            b.server_close()
+    except Exception as e:  # diagnostic tool: never crash the report
+        return {"ok": False, "error": repr(e)}
+
+
 def check_collector() -> dict:
     """Can this host run the fleet-aggregation plane?  (obs/agg/,
     docs/observability.md "Fleet aggregation")
@@ -883,6 +983,7 @@ def report(timeout_s: float = 45.0, run_dir: str | None = None,
         "collector": check_collector(),
         "resilience": check_resilience(probe=resilience_probe),
         "serve": check_serve(bundle=serve_bundle),
+        "router": check_router(),
     }
     cpu_recipe = (
         "run on the virtual CPU mesh instead — jax.config.update("

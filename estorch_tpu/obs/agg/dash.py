@@ -58,6 +58,7 @@ else:  # file-run: load siblings without any package init
 
 REQUEST_HIST = "estorch_serve_request_s"
 DISPATCH_HIST = "estorch_async_fold_latency_s"
+ROUTE_HIST = "estorch_router_route_s"
 
 
 def _fmt_ms(v: float | None) -> str:
@@ -105,6 +106,43 @@ def fleet_snapshot(store_root: str, *, window_s: float = 60.0,
             return max(got.values(), key=lambda x: x[0])[2]
 
         up = latest("estorch_up")
+        # front-router targets (serve/router.py) export per-replica
+        # labeled gauges; their presence IS the router detection, and
+        # the columns come from the store alone like everything else
+        replica_up = store.latest("estorch_router_replica_up", labels,
+                                  window_s, now)
+        router = None
+        if replica_up:
+            breaker = store.latest("estorch_router_breaker_state",
+                                   labels, window_s, now)
+            p99 = store.latest("estorch_router_upstream_p99_s", labels,
+                               window_s, now)
+            replicas = {}
+            for _ts, lab, v in replica_up.values():
+                replicas[str(lab.get("replica"))] = {"up": v == 1.0}
+            for _ts, lab, v in (breaker or {}).values():
+                replicas.setdefault(str(lab.get("replica")), {})[
+                    "breaker"] = int(v)
+            for _ts, lab, v in (p99 or {}).values():
+                name_r = str(lab.get("replica"))
+                if v == v:  # NaN = no samples yet
+                    replicas.setdefault(name_r, {})["p99_s"] = v
+            router = {
+                "replicas": replicas,
+                # only OPEN (2) alarms: half-open (1) is the normal
+                # readmission probe, not a down replica
+                "breakers_open": sum(
+                    1 for r in replicas.values()
+                    if r.get("breaker", 0) == 2),
+                "retries": store.increase("estorch_router_retries_total",
+                                          labels, window_s, now),
+                "hedge_wins": store.increase(
+                    "estorch_router_hedge_wins_total", labels, window_s,
+                    now),
+                "worst_p99_s": max(
+                    (r["p99_s"] for r in replicas.values()
+                     if "p99_s" in r), default=None),
+            }
         rows.append({
             "target": name,
             "up": bool(up == 1.0),
@@ -113,15 +151,20 @@ def fleet_snapshot(store_root: str, *, window_s: float = 60.0,
             # compiles_at_load gauges; a training run honestly has none)
             "startup_s": latest("estorch_startup_s"),
             "compiles_at_load": latest("estorch_compiles_at_load"),
-            "req_p50_s": store.quantile(REQUEST_HIST, 0.50, labels,
-                                        window_s, now),
-            "req_p99_s": store.quantile(REQUEST_HIST, 0.99, labels,
-                                        window_s, now),
+            # a router target's client-facing latency is its route_s
+            # histogram; a replica's is serve_request_s — same column
+            "req_p50_s": store.quantile(
+                ROUTE_HIST if router else REQUEST_HIST, 0.50, labels,
+                window_s, now),
+            "req_p99_s": store.quantile(
+                ROUTE_HIST if router else REQUEST_HIST, 0.99, labels,
+                window_s, now),
             "dispatch_p99_s": store.quantile(DISPATCH_HIST, 0.99, labels,
                                              window_s, now),
             "queue_depth": latest("estorch_queue_depth"),
             "recompiles": store.increase("estorch_recompiles", labels,
                                          window_s, now),
+            "router": router,
             "alerts": sorted(rule for (rule, tgt) in active
                              if tgt == name),
         })
@@ -139,7 +182,8 @@ def render(store_root: str, *, window_s: float = 60.0,
     snap = fleet_snapshot(store_root, window_s=window_s, now=now,
                           store=store)
     header = ("target", "up", "gen", "cold", "req p50/p99 ms",
-              "disp p99 ms", "queue", "recomp", "alerts")
+              "disp p99 ms", "queue", "recomp", "brk", "retry", "hedge",
+              "repl p99", "alerts")
     table = [header]
     for row in snap["targets"]:
         # cold: startup seconds, suffixed ! when the replica paid fresh
@@ -154,6 +198,21 @@ def render(store_root: str, *, window_s: float = 60.0,
                 cold += f"!{int(compiles)}"
             elif compiles is not None and compiles < 0:
                 cold += "?"
+        # router columns (serve/router.py targets): open-breaker count
+        # over replica total (suffixed ! when any is open), windowed
+        # retry / hedge-win increases, and the worst per-replica p99 —
+        # non-router targets honestly render '-'
+        ro = row.get("router")
+        if ro:
+            n_open = ro["breakers_open"]
+            brk = f"{n_open}/{len(ro['replicas'])}"
+            if n_open:
+                brk += "!"
+            retry = _fmt_num(ro["retries"])
+            hedge = _fmt_num(ro["hedge_wins"])
+            repl_p99 = _fmt_ms(ro["worst_p99_s"])
+        else:
+            brk = retry = hedge = repl_p99 = "-"
         table.append((
             row["target"],
             "UP" if row["up"] else "DOWN",
@@ -163,6 +222,7 @@ def render(store_root: str, *, window_s: float = 60.0,
             _fmt_ms(row["dispatch_p99_s"]),
             _fmt_num(row["queue_depth"]),
             _fmt_num(row["recompiles"]),
+            brk, retry, hedge, repl_p99,
             ",".join(row["alerts"]) or "-",
         ))
     widths = [max(len(str(r[i])) for r in table)

@@ -20,7 +20,9 @@ JSON, so forked/spawned children inherit the same plan):
         {"kind": "ckpt_crash",  "gen": 8},
         {"kind": "nan_update",  "gen": 2},
         {"kind": "die",         "gen": 12},
-        {"kind": "wedge",       "gen": 2, "sleep_s": 300.0}
+        {"kind": "wedge",       "gen": 2, "sleep_s": 300.0},
+        {"kind": "kill_replica",  "at_s": 2.0, "replica": 1},
+        {"kind": "wedge_replica", "at_s": 4.0, "replica": 0}
      ],
      "ledger": "/tmp/run/chaos_ledger"}
 
@@ -45,7 +47,18 @@ die             SIGKILL of the WHOLE process (resilience.run_resilient
                 loop head) — exercises the Supervisor restart path
 wedge           a long un-heartbeated sleep at the same point —
                 exercises the Supervisor's staleness watchdog
+kill_replica    SIGKILL of serving replica ``replica`` (fleet monitor,
+                serve/fleet.py) — exercises router failover + respawn
+wedge_replica   SIGSTOP of serving replica ``replica`` — alive process,
+                silent socket: exercises breaker-open-on-timeout and
+                the fleet's wedge-kill escalation
 ==============  =====================================================
+
+Training events key on ``gen`` (generation-granular determinism); the
+two serving events key on ``at_s`` — seconds since the fleet armed the
+plan — because a serving process has no generation clock.  Both share
+the same once-semantics ledger, so a respawned fleet does not replay
+the kill forever.
 
 Events fire **once**.  In-process that is an in-memory set; across
 process restarts (the Supervisor respawning a SIGKILLed child must not
@@ -74,7 +87,13 @@ KINDS = (
     "ckpt_crash",
     "die",
     "wedge",
+    "kill_replica",
+    "wedge_replica",
 )
+
+# serving-fleet events are wall-clock scheduled ("at_s" from plan arming)
+# instead of generation-keyed — a serving process has no generation clock
+SERVE_KINDS = ("kill_replica", "wedge_replica")
 
 
 class ChaosError(RuntimeError):
@@ -91,6 +110,7 @@ class ChaosPlan:
     def __init__(self, events, ledger: str | None = None):
         self._events: list[dict] = []
         self._by_gen: dict[int, list[dict]] = {}
+        self._serve_events: list[dict] = []
         for i, ev in enumerate(events):
             kind = ev.get("kind")
             if kind not in KINDS:
@@ -98,11 +118,19 @@ class ChaosPlan:
                     f"unknown chaos event kind {kind!r} (event {i}); "
                     f"known: {', '.join(KINDS)}"
                 )
-            if "gen" not in ev:
-                raise ValueError(f"chaos event {i} ({kind}) has no 'gen'")
             ev = dict(ev, id=i)
+            if kind in SERVE_KINDS:
+                if "at_s" not in ev:
+                    raise ValueError(
+                        f"chaos event {i} ({kind}) has no 'at_s' — serve "
+                        "events are wall-clock scheduled")
+                self._serve_events.append(ev)
+            else:
+                if "gen" not in ev:
+                    raise ValueError(
+                        f"chaos event {i} ({kind}) has no 'gen'")
+                self._by_gen.setdefault(int(ev["gen"]), []).append(ev)
             self._events.append(ev)
-            self._by_gen.setdefault(int(ev["gen"]), []).append(ev)
         self.ledger = ledger
         self._fired: set[int] = set()
         self._lock = threading.Lock()
@@ -190,6 +218,18 @@ class ChaosPlan:
     def events_at(self, generation: int, kind: str | None = None) -> list[dict]:
         evs = self._by_gen.get(int(generation), [])
         return [ev for ev in evs if kind is None or ev["kind"] == kind]
+
+    def serve_events_due(self, elapsed_s: float) -> list[dict]:
+        """Serve events (``kill_replica``/``wedge_replica``) whose
+        ``at_s`` has passed, each CLAIMED through :meth:`fire` (once per
+        event id across every process sharing the ledger).  The caller
+        (the fleet monitor) owns the actual kill/SIGSTOP — this module
+        holds no process table."""
+        due = []
+        for ev in self._serve_events:
+            if float(ev["at_s"]) <= float(elapsed_s) and self.fire(ev):
+                due.append(dict(ev))
+        return due
 
     # ---------------------------------------------------------------- fire
 
@@ -364,6 +404,18 @@ def process_kill(generation) -> None:
     for ev in plan.events_at(int(generation), "die"):
         if plan.fire(ev):
             os.kill(os.getpid(), signal.SIGKILL)
+
+
+def serve_faults(elapsed_s: float) -> list[dict]:
+    """Due serving-fleet faults (``ESTORCH_CHAOS`` hook, one env lookup
+    when unset).  Returns the claimed events; the fleet monitor maps
+    ``replica`` indices to live processes and delivers the SIGKILL /
+    SIGSTOP itself — declaring serving chaos in the same plan (and the
+    same once-semantics ledger) as training chaos."""
+    plan = active_plan()
+    if plan is None:
+        return []
+    return plan.serve_events_due(elapsed_s)
 
 
 def process_wedge(generation) -> None:

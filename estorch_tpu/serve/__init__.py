@@ -19,6 +19,12 @@ from .batcher import (BatchError, BatcherClosed, BatcherSaturated,
 from .client import ServeClient, ServeError
 
 _LAZY = {
+    # fleet front door (stdlib, jax-free — lazy only for symmetry)
+    "Router": "router",
+    "CircuitBreaker": "router",
+    "Fleet": "fleet",
+    "FleetError": "fleet",
+    "load_fleet_config": "fleet",
     "Bundle": "bundle",
     "BundleError": "bundle",
     "export_bundle": "bundle",

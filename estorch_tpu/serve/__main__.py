@@ -24,7 +24,9 @@ import sys
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="python -m estorch_tpu.serve",
-        description="serve a policy bundle over HTTP (docs/serving.md)")
+        description="serve a policy bundle over HTTP (docs/serving.md); "
+                    "`route --fleet fleet.json` runs the fleet front "
+                    "router instead (docs/serving.md, 'Fleet')")
     p.add_argument("--bundle", required=True, metavar="DIR",
                    help="bundle directory written by export_bundle")
     p.add_argument("--host", default="127.0.0.1")
@@ -75,6 +77,13 @@ def main(argv=None) -> int:
 
     t0 = time.monotonic()  # startup_s covers the jax import + load
     argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "route":
+        # the fleet front door (docs/serving.md "Fleet"): router +
+        # optional fleet supervisor — deliberately jax-free, so the
+        # dispatch happens before the bundle-serving machinery loads
+        from .router import main as route_main
+
+        return route_main(argv[1:])
     args = build_parser().parse_args(argv)
     args._t0_monotonic = t0
     # config validation BEFORE anything heavy (and before --supervised

@@ -315,6 +315,72 @@ def coldstart_probe(
     }
 
 
+def capacity_sweep(
+    address: str,
+    *,
+    slo_ms: float = 50.0,
+    rps_ladder: list[float] | None = None,
+    start_rps: float = 25.0,
+    growth: float = 2.0,
+    max_rungs: int = 8,
+    rung_duration_s: float = 2.0,
+    conns: int = 16,
+    obs: list | None = None,
+    quantile: float = 0.99,
+    max_error_frac: float = 0.0,
+    timeout_s: float = 60.0,
+) -> dict:
+    """The ROADMAP capacity model: an OPEN-LOOP offered-load ladder —
+    each rung fires requests on a fixed schedule regardless of
+    completions, with latency measured from the SCHEDULED send time
+    (``run_load``'s schedule-authoritative rule), so queueing delay past
+    saturation lands in the percentiles instead of being coordinated
+    away.  Reports per-rung rows and ``max_rps_at_slo``: the highest
+    offered rate whose ``quantile`` latency stayed <= ``slo_ms`` with
+    error+shed fraction <= ``max_error_frac``.
+
+    ``rps_ladder`` pins the rungs explicitly; otherwise a geometric
+    ladder (``start_rps`` × ``growth``^k) runs until the SLO breaks or
+    ``max_rungs`` is exhausted (the early stop keeps a saturated server
+    from being hammered through rungs that can only fail).
+    """
+    ladder = ([float(r) for r in rps_ladder] if rps_ladder
+              else [start_rps * (growth ** k) for k in range(max_rungs)])
+    qkey = f"p{quantile * 100:g}"
+    rungs: list[dict] = []
+    max_ok: float | None = None
+    for rps in ladder:
+        res = run_load(address, mode="open", target_rps=rps, conns=conns,
+                       duration_s=rung_duration_s, obs=obs,
+                       collect_latencies=True, timeout_s=timeout_s)
+        lat_sorted = sorted(res.pop("latencies_s", []))
+        q_ms = _percentile(lat_sorted, quantile) * 1e3
+        bad = res["errors"] + res["shed"]
+        bad_frac = bad / res["requests"] if res["requests"] else 1.0
+        ok = (bool(lat_sorted) and q_ms <= slo_ms
+              and bad_frac <= max_error_frac)
+        rungs.append({
+            "offered_rps": rps,
+            "achieved_rps": res["throughput_rps"],
+            qkey + "_ms": round(q_ms, 3),
+            "errors": res["errors"],
+            "shed": res["shed"],
+            "requests": res["requests"],
+            "ok": ok,
+        })
+        if ok:
+            max_ok = rps
+        elif rps_ladder is None:
+            break  # saturated: further geometric rungs can only fail
+    return {
+        "slo_ms": float(slo_ms),
+        "quantile": qkey,
+        "rungs": rungs,
+        "max_rps_at_slo": max_ok,
+        "saturated": any(not r["ok"] for r in rungs),
+    }
+
+
 def write_latency_rows(latencies_s: list, path: str,
                        endpoint: str = "/predict") -> str:
     """Per-request latency rows as JSONL (``{"endpoint", "latency_s"}``)
@@ -382,6 +448,21 @@ def _selfcheck() -> int:
             problems.append(
                 f"open loop missed its schedule: {open_['requests']} "
                 "requests for target 200 rps x 0.5s")
+        # capacity ladder: the echo server answers in microseconds, so a
+        # generous SLO must pass every rung and report the top one
+        sweep = capacity_sweep(addr, slo_ms=1000.0,
+                               rps_ladder=[50, 100], conns=4,
+                               rung_duration_s=0.4)
+        if sweep["max_rps_at_slo"] != 100.0 or sweep["saturated"]:
+            problems.append(f"capacity sweep missed the trivially-"
+                            f"passing ladder: {sweep}")
+        if [r["offered_rps"] for r in sweep["rungs"]] != [50.0, 100.0]:
+            problems.append(f"capacity rungs wrong: {sweep['rungs']}")
+        # an impossible SLO must read as saturation, not success
+        tight = capacity_sweep(addr, slo_ms=1e-6, rps_ladder=[50],
+                               conns=4, rung_duration_s=0.3)
+        if tight["max_rps_at_slo"] is not None or not tight["saturated"]:
+            problems.append(f"impossible SLO not flagged: {tight}")
     finally:
         srv.shutdown()
         srv.server_close()
@@ -389,7 +470,7 @@ def _selfcheck() -> int:
         print(f"loadgen selfcheck: {p}", file=sys.stderr)
     if not problems:
         print("loadgen selfcheck: OK (closed+open loop, percentiles, "
-              "response indexing)")
+              "response indexing, capacity sweep)")
     return 1 if problems else 0
 
 
@@ -407,6 +488,18 @@ def main(argv=None) -> int:
                    help="cold-start probe instead of a load run: first "
                         "request alone (time-to-first-response), then the "
                         "first N requests' p50/p99")
+    p.add_argument("--capacity-sweep", action="store_true",
+                   help="open-loop offered-load ladder: max sustainable "
+                        "RPS at the --slo-ms p99 SLO (schedule-"
+                        "authoritative latencies, so saturation is "
+                        "honest)")
+    p.add_argument("--slo-ms", type=float, default=50.0,
+                   help="p99 latency SLO for --capacity-sweep")
+    p.add_argument("--rps-ladder", default=None, metavar="R1,R2,...",
+                   help="explicit offered-load rungs (default: geometric "
+                        "from --start-rps)")
+    p.add_argument("--start-rps", type=float, default=25.0)
+    p.add_argument("--rung-duration", type=float, default=2.0)
     p.add_argument("--latencies-out", default=None, metavar="PATH",
                    help="also write per-request latency rows as JSONL "
                         "({'endpoint', 'latency_s'}) — the obs regress "
@@ -419,6 +512,16 @@ def main(argv=None) -> int:
         return _selfcheck()
     if not args.address:
         p.error("--address is required (or --selfcheck)")
+    if args.capacity_sweep:
+        ladder = ([float(x) for x in args.rps_ladder.split(",")]
+                  if args.rps_ladder else None)
+        res = capacity_sweep(
+            args.address, slo_ms=args.slo_ms, rps_ladder=ladder,
+            start_rps=args.start_rps, rung_duration_s=args.rung_duration,
+            conns=args.conns,
+            obs=json.loads(args.obs) if args.obs else None)
+        print(json.dumps(res))
+        return 0
     if args.coldstart:
         res = coldstart_probe(
             args.address, total=args.coldstart, conns=args.conns,

@@ -451,8 +451,12 @@ def _make_handler(server: PolicyServer):
             # a request counts as in flight until its RESPONSE is written:
             # untracking before the reply would let a SIGTERM drain declare
             # victory (inflight==0) while this thread still holds an
-            # unwritten answer — and the process exit would drop it
-            trace = f"r{next(server._req_seq)}"
+            # unwritten answer — and the process exit would drop it.
+            # An incoming X-Trace-Id (the fleet router forwards the id it
+            # minted) is honored so one slow answer traces end to end;
+            # direct clients still get a locally-minted r<N>
+            trace = (self.headers.get("X-Trace-Id")
+                     or f"r{next(server._req_seq)}")
             headers = {"X-Trace-Id": trace}
             server.track_request()
             try:
@@ -545,11 +549,9 @@ def run_server(args) -> int:
     }
     print(json.dumps(ready), flush=True)
     if args.port_file:
-        tmp = args.port_file + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump({"host": server.host, "port": server.port,
-                       "pid": os.getpid()}, f)
-        os.replace(tmp, args.port_file)
+        from .router import write_port_file
+
+        write_port_file(args.port_file, server.host, server.port)
 
     server.start_background()
     beat_s = max(0.2, float(args.beat_interval))

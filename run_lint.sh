@@ -105,6 +105,15 @@ echo "== serve selfcheck =="
 # the full `bench.py --serve` form and the tier-1 serving demo.
 python bench.py --serve --selfcheck
 
+echo "== fleet selfcheck =="
+# serving-fleet gate (serve/router.py + serve/fleet.py, docs/serving.md
+# "Fleet"): a 2-replica fleet under concurrent load with a DECLARED
+# kill_replica chaos event must lose zero client answers (failover
+# retries within the budget), open and re-close the breaker, respawn
+# the corpse WARM (compiles_at_load == 0), and report a sane
+# capacity-sweep ladder (max RPS at a p99 SLO).  CPU only, ~60s.
+python bench.py --fleet --selfcheck
+
 echo "== coldstart selfcheck =="
 # warm-bundle + quantized-serving gate (serve/warm.py, docs/serving.md
 # "Cold start & quantized serving"): a warm bundle must load with ZERO

@@ -1436,6 +1436,186 @@ class TestR14:
             assert not hits, [h.message for h in hits]
 
 
+class TestR15:
+    def test_unbounded_while_true_retry_flagged(self):
+        """The motivating hazard: a `while True` that swallows the
+        connect error and tries again turns one dead replica into an
+        infinite hammer — no attempt bound, no escalation, ever."""
+        found = findings("""
+            import urllib.request
+
+            def fetch(url):
+                while True:
+                    try:
+                        return urllib.request.urlopen(url,
+                                                      timeout=5).read()
+                    except OSError:
+                        continue
+        """, "R15")
+        assert len(found) == 1
+        assert "forever" in found[0].message
+        assert "budget" in found[0].hint
+
+    def test_bounded_retry_without_backoff_flagged(self):
+        found = findings("""
+            import urllib.request
+
+            def fetch(url):
+                for attempt in range(5):
+                    try:
+                        return urllib.request.urlopen(url,
+                                                      timeout=5).read()
+                    except OSError:
+                        continue
+        """, "R15")
+        assert len(found) == 1
+        assert "backoff" in found[0].message
+
+    def test_itertools_count_is_unbounded(self):
+        found = findings("""
+            import itertools
+            import socket
+            import time
+
+            def connect(addr):
+                for attempt in itertools.count():
+                    try:
+                        return socket.create_connection(addr, 5)
+                    except OSError:
+                        time.sleep(1)
+        """, "R15")
+        assert len(found) == 1
+        assert "forever" in found[0].message
+
+    def test_conn_request_retry_loop_flagged(self):
+        found = findings("""
+            def fetch(conn_pool):
+                while True:
+                    try:
+                        conn = conn_pool.take()
+                        conn.request("GET", "/x")
+                        return conn.getresponse().read()
+                    except OSError:
+                        continue
+        """, "R15")
+        assert len(found) == 1
+
+    def test_budgeted_retry_with_backoff_clean(self):
+        """The router's prescribed shape (serve/router.py): bounded
+        attempts, exponential backoff + jitter between them."""
+        assert not findings("""
+            import random
+            import time
+            import urllib.request
+
+            def fetch(url, budget=2):
+                for attempt in range(1 + budget):
+                    if attempt:
+                        time.sleep(0.05 * 2 ** attempt
+                                   * (0.5 + random.random()))
+                    try:
+                        return urllib.request.urlopen(url,
+                                                      timeout=5).read()
+                    except OSError:
+                        continue
+                raise TimeoutError(url)
+        """, "R15")
+
+    def test_reraising_handler_clean(self):
+        """A handler that escalates (even conditionally) bounds its own
+        patience — the stale-keep-alive reconnect idiom
+        (serve/client.py) raises on its second failure."""
+        assert not findings("""
+            import http.client
+
+            def request(self, method, path):
+                for attempt in (0, 1):
+                    try:
+                        self.conn.request(method, path)
+                        return self.conn.getresponse().read()
+                    except OSError:
+                        self.close()
+                        if attempt:
+                            raise
+        """, "R15")
+
+    def test_loop_without_net_call_clean(self):
+        assert not findings("""
+            def drain(q):
+                while True:
+                    try:
+                        q.process_one()
+                    except ValueError:
+                        continue
+        """, "R15")
+
+    def test_outer_dispatcher_with_inner_bounded_retry_clean(self):
+        """An unbounded WORKER loop wrapping a correctly budgeted inner
+        retry is judged at the innermost loop — pinning the retry on
+        the outer `while True` would flag every dispatcher."""
+        assert not findings("""
+            import time
+            import urllib.request
+
+            def worker(q):
+                while True:
+                    url = q.get()
+                    for attempt in range(3):
+                        try:
+                            urllib.request.urlopen(url, timeout=5)
+                            break
+                        except OSError:
+                            time.sleep(0.1 * 2 ** attempt)
+        """, "R15")
+
+    def test_outer_retry_of_inner_batch_still_flagged(self):
+        """The try itself living on the outer loop (retrying a whole
+        inner batch forever) is still the outer loop's finding."""
+        found = findings("""
+            import urllib.request
+
+            def push_all(urls):
+                while True:
+                    try:
+                        for u in urls:
+                            urllib.request.urlopen(u, timeout=5)
+                        return
+                    except OSError:
+                        continue
+        """, "R15")
+        assert len(found) == 1
+        assert "forever" in found[0].message
+
+    def test_net_call_without_retry_shape_clean(self):
+        """A loop OVER network calls (one per item, failure escapes) is
+        iteration, not retry."""
+        assert not findings("""
+            import urllib.request
+
+            def scrape_all(urls):
+                out = []
+                for url in urls:
+                    out.append(urllib.request.urlopen(url,
+                                                      timeout=5).read())
+                return out
+        """, "R15")
+
+    def test_router_and_client_self_clean(self):
+        """Self-application: the front router's budgeted retry is THE
+        negative exemplar, and the keep-alive client's single reconnect
+        stays clean via its escalating handler."""
+        import estorch_tpu.serve.client as client
+        import estorch_tpu.serve.fleet as fleet
+        import estorch_tpu.serve.router as router
+
+        for mod in (router, fleet, client):
+            with open(mod.__file__) as f:
+                src = f.read()
+            hits = [x for x in analyze_source(mod.__file__, src)
+                    if x.rule == "R15"]
+            assert not hits, [h.message for h in hits]
+
+
 # ---------------------------------------------------------------------
 # engine / CLI / config / baseline mechanics
 # ---------------------------------------------------------------------
@@ -1461,7 +1641,7 @@ class TestEngine:
     def test_every_rule_registered(self):
         ids = [r.id for r in all_rules()]
         assert ids == ["R01", "R02", "R03", "R04", "R05", "R06", "R07",
-                       "R08", "R09", "R10", "R11", "R12", "R13", "R14"]
+                       "R08", "R09", "R10", "R11", "R12", "R13", "R14", "R15"]
 
     def test_syntax_error_becomes_finding(self):
         found = analyze_source("bad.py", "def broken(:\n")
@@ -1595,7 +1775,7 @@ class TestConfig:
         assert cfg.baseline == "esguard_baseline.json"
         assert cfg.rule_ids([r.id for r in all_rules()]) == [
             "R01", "R02", "R03", "R04", "R05", "R06", "R07", "R08", "R09",
-            "R10", "R11", "R12", "R13", "R14"]
+            "R10", "R11", "R12", "R13", "R14", "R15"]
 
 
 class TestCLI:

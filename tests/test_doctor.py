@@ -314,6 +314,46 @@ class TestCollectorCheck:
         assert rep["collector"] == {"ok": True}
 
 
+class TestRouterCheck:
+    def test_router_probe_failover_end_to_end(self):
+        """check_router: a 2-replica toy fleet behind a real Router —
+        kill one replica, the next requests must still answer (retry on
+        the survivor) and /metrics must parse with the per-replica
+        breaker gauges."""
+        out = doctor.check_router()
+        assert out["ok"] is True, out
+        assert out["retries"] >= 1  # the probe's health is STALE by
+        # design, so failover HAD to go through the retry budget
+        assert out["breakers"]["ra"] == "open"
+        assert out["breakers"]["rb"] == "closed"
+
+    def test_router_probe_never_crashes_the_report(self, monkeypatch):
+        from estorch_tpu.serve import router as router_mod
+
+        def boom(*a, **k):
+            raise OSError("no loopback")
+
+        monkeypatch.setattr(router_mod.Router, "__init__", boom)
+        out = doctor.check_router()
+        assert out["ok"] is False
+        assert "no loopback" in out["error"]
+
+    def test_report_gains_router_row(self, monkeypatch):
+        monkeypatch.setattr(doctor, "check_mesh",
+                            lambda **kw: {"status": "ok"})
+        monkeypatch.setattr(doctor, "check_device",
+                            lambda timeout_s=20.0, platform=None: {
+                                "status": "ok", "platform": "cpu",
+                                "n_devices": 8, "elapsed_s": 0.1,
+                                "timeout_s": timeout_s})
+        monkeypatch.setattr(doctor, "check_collector",
+                            lambda: {"ok": True})
+        monkeypatch.setattr(doctor, "check_router",
+                            lambda: {"ok": True, "retries": 1})
+        rep = doctor.report(timeout_s=5.0)
+        assert rep["router"] == {"ok": True, "retries": 1}
+
+
 class TestResilienceCheck:
     def test_config_checks_without_probe(self, tmp_path, monkeypatch):
         monkeypatch.setenv("ESTORCH_CKPT_ROOT", str(tmp_path))

@@ -1,0 +1,780 @@
+"""Serving fleet: front router + fleet supervisor (docs/serving.md
+"Fleet").
+
+THE acceptance demo is chaos-driven: a 2-replica fleet under concurrent
+load takes a DECLARED ``kill_replica`` SIGKILL (ESTORCH_CHAOS — the
+same once-semantics ledger as training chaos) and loses ZERO client
+answers: in-flight and follow-on requests retry onto the survivor
+within the budget, the dead replica's breaker opens and re-closes, the
+fleet respawns the corpse WARM (PR-12 bundles: ``compiles_at_load ==
+0``), and a canary rollout carrying a deliberately-different bundle is
+auto-rolled-back with the bit-parity (or tail-band) evidence in the
+structured abort reason — while a same-params re-export promotes
+fleet-wide.
+
+Around the demo: router unit mechanics over stdlib toy replicas
+(failover, budgeted retry, breaker state machine, hedging, trace
+headers, drain), fleet.json validation, the chaos plan's wall-clock
+serve events, the loadgen capacity sweep, and the jax-free file-run
+probes (the sidecar/collector discipline).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from estorch_tpu.resilience.chaos import CHAOS_ENV, ChaosPlan
+from estorch_tpu.serve.fleet import (Fleet, FleetError, load_fleet_config,
+                                     validate_fleet_config)
+from estorch_tpu.serve.loadgen import capacity_sweep, run_load
+from estorch_tpu.serve.router import (BREAKER_CLOSED, BREAKER_HALF_OPEN,
+                                      BREAKER_OPEN, CircuitBreaker,
+                                      Router, parse_replica_spec)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# =====================================================================
+# toy replicas (stdlib): the /predict //healthz //stats shapes
+# =====================================================================
+
+def make_toy_replica(*, delay_s: float = 0.0, fail: bool = False,
+                     scale: float = 2.0):
+    state = {"delay_s": delay_s, "fail": fail, "scale": scale,
+             "requests": 0, "traces": []}
+
+    class Toy(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def _j(self, code, obj):
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._j(200, {"ok": True, "draining": False,
+                              "queue_depth": 0})
+            else:
+                self._j(200, {"queue_depth": 0,
+                              "request_ms": {"p99": 1.0}})
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            data = json.loads(self.rfile.read(n))
+            state["requests"] += 1
+            trace = self.headers.get("X-Trace-Id")
+            if trace:
+                state["traces"].append(trace)
+            if state["delay_s"]:
+                time.sleep(state["delay_s"])
+            if state["fail"]:
+                self._j(500, {"error": "injected"})
+                return
+            self._j(200, {"action": [v * state["scale"]
+                                     for v in data["obs"]]})
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Toy)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, state
+
+
+def _post(url, payload, timeout=15):
+    req = urllib.request.Request(url, json.dumps(payload).encode(),
+                                 {"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read()), dict(r.headers)
+
+
+# =====================================================================
+# circuit breaker state machine
+# =====================================================================
+
+class TestCircuitBreaker:
+    def test_opens_after_consecutive_failures(self):
+        b = CircuitBreaker(fail_threshold=3, open_s=60.0)
+        assert b.allow() and b.state == BREAKER_CLOSED
+        assert not b.record_failure()
+        assert not b.record_failure()
+        assert b.record_failure()  # third opens
+        assert b.state == BREAKER_OPEN
+        assert not b.allow()
+
+    def test_success_resets_failure_streak(self):
+        b = CircuitBreaker(fail_threshold=2, open_s=60.0)
+        b.record_failure()
+        b.record_success()
+        assert not b.record_failure()  # streak restarted
+        assert b.state == BREAKER_CLOSED
+
+    def test_half_open_admits_one_probe(self):
+        b = CircuitBreaker(fail_threshold=1, open_s=0.05)
+        b.record_failure()
+        assert b.state == BREAKER_OPEN and not b.allow()
+        time.sleep(0.08)
+        assert b.allow()  # the probe
+        assert b.state == BREAKER_HALF_OPEN
+        assert not b.allow()  # only one in flight
+        b.record_success()
+        assert b.state == BREAKER_CLOSED and b.allow()
+
+    def test_half_open_failure_reopens(self):
+        b = CircuitBreaker(fail_threshold=1, open_s=0.05)
+        b.record_failure()
+        time.sleep(0.08)
+        assert b.allow()
+        assert b.record_failure()  # the probe failed: re-open
+        assert b.state == BREAKER_OPEN
+        assert b.opens_total == 2
+
+
+# =====================================================================
+# chaos plan: wall-clock serve events
+# =====================================================================
+
+class TestChaosServeEvents:
+    def test_serve_events_need_at_s(self):
+        with pytest.raises(ValueError, match="at_s"):
+            ChaosPlan([{"kind": "kill_replica", "replica": 0}])
+
+    def test_gen_events_still_need_gen(self):
+        with pytest.raises(ValueError, match="gen"):
+            ChaosPlan([{"kind": "die"}])
+
+    def test_due_and_once_semantics(self):
+        plan = ChaosPlan([
+            {"kind": "kill_replica", "at_s": 1.0, "replica": 1},
+            {"kind": "wedge_replica", "at_s": 5.0, "replica": 0},
+            {"kind": "die", "gen": 3},
+        ])
+        assert plan.serve_events_due(0.5) == []
+        due = plan.serve_events_due(2.0)
+        assert [e["kind"] for e in due] == ["kill_replica"]
+        assert plan.serve_events_due(2.0) == []  # fired once
+        due = plan.serve_events_due(9.0)
+        assert [e["kind"] for e in due] == ["wedge_replica"]
+        # generation-keyed events are untouched by the serve clock
+        assert [e["kind"] for e in plan.events_at(3)] == ["die"]
+
+    def test_ledger_shared_across_plans(self, tmp_path):
+        ledger = str(tmp_path / "ledger")
+        spec = [{"kind": "kill_replica", "at_s": 0.1, "replica": 0}]
+        p1 = ChaosPlan(spec, ledger=ledger)
+        assert len(p1.serve_events_due(1.0)) == 1
+        # a restarted fleet parsing the same plan skips the fired event
+        p2 = ChaosPlan(spec, ledger=ledger)
+        assert p2.serve_events_due(1.0) == []
+
+    def test_to_json_round_trip(self):
+        plan = ChaosPlan([{"kind": "wedge_replica", "at_s": 2.5,
+                           "replica": 1}])
+        again = ChaosPlan.parse(plan.to_json())
+        assert [e["kind"] for e in again.serve_events_due(3.0)] == \
+            ["wedge_replica"]
+
+
+# =====================================================================
+# router mechanics over toy replicas
+# =====================================================================
+
+class TestRouterUnit:
+    def _router(self, replicas, **kw):
+        kw.setdefault("port", 0)
+        kw.setdefault("poll_interval_s", 0.1)
+        r = Router(replicas, **kw)
+        r.start_background()
+        return r
+
+    def test_routes_and_traces(self):
+        srv, state = make_toy_replica()
+        router = self._router([("ra",
+                                f"127.0.0.1:{srv.server_address[1]}")])
+        try:
+            time.sleep(0.25)
+            url = f"http://{router.host}:{router.port}"
+            out, hdrs = _post(url + "/predict", {"obs": [1.0, 2.0]})
+            assert out["action"] == [2.0, 4.0]
+            assert hdrs["X-Upstream"] == "ra"
+            # the router's trace id reached the replica
+            assert hdrs["X-Trace-Id"] in state["traces"]
+            # a client-supplied id is honored, not replaced
+            req = urllib.request.Request(
+                url + "/predict", json.dumps({"obs": [1.0]}).encode(),
+                {"Content-Type": "application/json",
+                 "X-Trace-Id": "r-mine"})
+            with urllib.request.urlopen(req, timeout=10) as r:
+                assert r.headers["X-Trace-Id"] == "r-mine"
+            assert "r-mine" in state["traces"]
+        finally:
+            router.shutdown(drain=False)
+            srv.shutdown(), srv.server_close()
+
+    def test_retry_on_different_replica_and_breaker(self):
+        a, _ = make_toy_replica()
+        b, bstate = make_toy_replica(scale=2.0)
+        # poll slowly: health is STALE when a dies, so requests must hit
+        # the corpse and fail over via the retry budget
+        router = self._router(
+            [("ra", f"127.0.0.1:{a.server_address[1]}"),
+             ("rb", f"127.0.0.1:{b.server_address[1]}")],
+            poll_interval_s=30.0)
+        try:
+            time.sleep(0.4)  # one poll: both healthy
+            a.shutdown(), a.server_close()
+            url = f"http://{router.host}:{router.port}"
+            for i in range(8):
+                out, _h = _post(url + "/predict", {"obs": [float(i)]})
+                assert out["action"] == [2.0 * i]
+            st = router.stats()
+            assert st["counters"]["router_retries_total"] >= 1
+            assert st["counters"]["router_breaker_opens_total"] >= 1
+            breakers = {r["name"]: r["breaker"]
+                        for r in st["replicas"]}
+            assert breakers["ra"] == BREAKER_OPEN
+            assert breakers["rb"] == BREAKER_CLOSED
+        finally:
+            router.shutdown(drain=False)
+            b.shutdown(), b.server_close()
+
+    def test_5xx_retries_and_no_healthy_is_503(self):
+        a, _ = make_toy_replica(fail=True)
+        b, _ = make_toy_replica(fail=True)
+        router = self._router(
+            [("ra", f"127.0.0.1:{a.server_address[1]}"),
+             ("rb", f"127.0.0.1:{b.server_address[1]}")],
+            poll_interval_s=30.0, retry_budget=1)
+        try:
+            time.sleep(0.4)
+            url = f"http://{router.host}:{router.port}"
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(url + "/predict", {"obs": [1.0]})
+            assert ei.value.code == 503
+            body = json.loads(ei.value.read())
+            assert "no healthy upstream" in body["error"]
+            assert router.counters.get("router_no_upstream_total") >= 1
+        finally:
+            router.shutdown(drain=False)
+            for s in (a, b):
+                s.shutdown(), s.server_close()
+
+    def test_hedge_cuts_the_tail(self):
+        slow, _ = make_toy_replica(delay_s=0.4)
+        fast, _ = make_toy_replica()
+        router = self._router(
+            [("slow", f"127.0.0.1:{slow.server_address[1]}"),
+             ("fast", f"127.0.0.1:{fast.server_address[1]}")],
+            poll_interval_s=30.0, hedge=True, hedge_min_ms=60.0)
+        try:
+            time.sleep(0.4)
+            url = f"http://{router.host}:{router.port}"
+            hedged_upstreams = []
+            for i in range(8):  # rr tiebreak: some land on the stall
+                out, hdrs = _post(url + "/predict", {"obs": [float(i)]})
+                assert out["action"] == [2.0 * i]
+                hedged_upstreams.append(hdrs.get("X-Upstream"))
+            c = router.counters
+            assert c.get("router_hedged_total") >= 1
+            assert c.get("router_hedge_wins_total") >= 1
+            # the winner is attributed: a hedge win answers from 'fast'
+            # even though the attempt STARTED on 'slow'
+            assert hedged_upstreams.count("fast") > \
+                hedged_upstreams.count("slow"), hedged_upstreams
+            # a cancelled hedge loser is healthy-but-slow, NOT a death:
+            # its breaker stays closed and it is charged no failures
+            reps = {r.name: r for r in router.replicas()}
+            assert reps["slow"].breaker.state == BREAKER_CLOSED
+            assert reps["slow"].failures == 0, reps["slow"].snapshot()
+        finally:
+            router.shutdown(drain=False)
+            for s in (slow, fast):
+                s.shutdown(), s.server_close()
+
+    def test_metrics_exposition_parses_with_replica_gauges(self):
+        from estorch_tpu.obs.export.prometheus import parse_exposition
+
+        srv, _ = make_toy_replica()
+        router = self._router([("ra",
+                                f"127.0.0.1:{srv.server_address[1]}")])
+        try:
+            time.sleep(0.25)
+            url = f"http://{router.host}:{router.port}"
+            _post(url + "/predict", {"obs": [1.0]})
+            with urllib.request.urlopen(url + "/metrics",
+                                        timeout=10) as r:
+                body = r.read().decode()
+            parse_exposition(body)
+            assert 'estorch_router_replica_up{replica="ra"} 1' in body
+            assert 'estorch_router_breaker_state{replica="ra"} 0' in body
+            assert "estorch_router_route_s_bucket" in body
+            # the /stats collector-discovery stanza, like the server's
+            with urllib.request.urlopen(url + "/stats", timeout=10) as r:
+                st = json.loads(r.read())
+            assert st["collector_target"]["url"].endswith("/metrics")
+            assert str(router.port) in st["collector_target"]["url"]
+        finally:
+            router.shutdown(drain=False)
+            srv.shutdown(), srv.server_close()
+
+    def test_rollout_without_fleet_is_409(self):
+        srv, _ = make_toy_replica()
+        router = self._router([("ra",
+                                f"127.0.0.1:{srv.server_address[1]}")])
+        try:
+            url = f"http://{router.host}:{router.port}"
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(url + "/rollout", {"path": "/x"})
+            assert ei.value.code == 409
+        finally:
+            router.shutdown(drain=False)
+            srv.shutdown(), srv.server_close()
+
+    def test_replica_spec_parsing(self):
+        assert parse_replica_spec("a=h:1,b=h:2") == [("a", "h:1"),
+                                                     ("b", "h:2")]
+        with pytest.raises(ValueError):
+            parse_replica_spec("nonsense")
+        with pytest.raises(ValueError):
+            parse_replica_spec("")
+
+
+# =====================================================================
+# fleet config
+# =====================================================================
+
+class TestFleetConfig:
+    def test_validate_catches_junk(self):
+        assert validate_fleet_config([]) != []
+        assert validate_fleet_config({"schema": 99}) != []
+        p = validate_fleet_config({"schema": 1, "replicas": 0})
+        assert any("bundle" in x for x in p)
+        assert any("replicas" in x for x in p)
+        p = validate_fleet_config(
+            {"schema": 1, "bundle": "b", "replicas": 2,
+             "rollout": {"shadow_fraction": 2.0}})
+        assert any("shadow_fraction" in x for x in p)
+        assert validate_fleet_config(
+            {"schema": 1, "bundle": "b", "replicas": 2}) == []
+
+    def test_load_resolves_relative_bundle(self, tmp_path):
+        cfg = tmp_path / "fleet.json"
+        cfg.write_text(json.dumps(
+            {"schema": 1, "bundle": "bundle_dir", "replicas": 1}))
+        loaded = load_fleet_config(str(cfg))
+        assert loaded["bundle"] == str(tmp_path / "bundle_dir")
+        with pytest.raises(FleetError):
+            load_fleet_config(str(tmp_path / "missing.json"))
+
+
+# =====================================================================
+# capacity sweep (loadgen)
+# =====================================================================
+
+class TestCapacitySweep:
+    def _echo(self):
+        class Echo(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                data = json.loads(self.rfile.read(n))
+                body = json.dumps({"action": data["obs"]}).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), Echo)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        return srv
+
+    def test_ladder_reports_max_rps_at_slo(self):
+        srv = self._echo()
+        try:
+            addr = f"127.0.0.1:{srv.server_address[1]}"
+            res = capacity_sweep(addr, slo_ms=1000.0,
+                                 rps_ladder=[50, 100], conns=4,
+                                 rung_duration_s=0.4)
+            assert res["max_rps_at_slo"] == 100.0
+            assert not res["saturated"]
+            assert [r["ok"] for r in res["rungs"]] == [True, True]
+        finally:
+            srv.shutdown(), srv.server_close()
+
+    def test_impossible_slo_reads_as_saturation(self):
+        srv = self._echo()
+        try:
+            addr = f"127.0.0.1:{srv.server_address[1]}"
+            res = capacity_sweep(addr, slo_ms=1e-6, rps_ladder=[50],
+                                 conns=4, rung_duration_s=0.3)
+            assert res["max_rps_at_slo"] is None
+            assert res["saturated"]
+        finally:
+            srv.shutdown(), srv.server_close()
+
+    def test_geometric_ladder_stops_at_saturation(self):
+        srv = self._echo()
+        try:
+            addr = f"127.0.0.1:{srv.server_address[1]}"
+            res = capacity_sweep(addr, slo_ms=1e-6, start_rps=10,
+                                 growth=2.0, max_rungs=5, conns=2,
+                                 rung_duration_s=0.3)
+            # the first failing rung ends the auto ladder
+            assert len(res["rungs"]) == 1
+        finally:
+            srv.shutdown(), srv.server_close()
+
+
+# =====================================================================
+# jax-free file-run probes (the sidecar/collector discipline)
+# =====================================================================
+
+class TestFileRun:
+    def test_router_file_run_never_imports_package_or_jax(self):
+        path = os.path.join(REPO, "estorch_tpu", "serve", "router.py")
+        probe = (
+            "import importlib.util, sys, json, threading, time\n"
+            "import urllib.request\n"
+            "from http.server import BaseHTTPRequestHandler, "
+            "ThreadingHTTPServer\n"
+            f"spec = importlib.util.spec_from_file_location('r', "
+            f"{path!r})\n"
+            "m = importlib.util.module_from_spec(spec)\n"
+            "spec.loader.exec_module(m)\n"
+            "assert 'jax' not in sys.modules, 'router imported jax'\n"
+            "assert 'estorch_tpu' not in sys.modules, 'package init "
+            "ran'\n"
+            "class Toy(BaseHTTPRequestHandler):\n"
+            "    protocol_version = 'HTTP/1.1'\n"
+            "    def log_message(self, *a): pass\n"
+            "    def do_GET(self):\n"
+            "        b = json.dumps({'ok': True, 'draining': False,"
+            " 'queue_depth': 0}).encode()\n"
+            "        self.send_response(200)\n"
+            "        self.send_header('Content-Length', str(len(b)))\n"
+            "        self.end_headers(); self.wfile.write(b)\n"
+            "    def do_POST(self):\n"
+            "        n = int(self.headers.get('Content-Length', 0))\n"
+            "        d = json.loads(self.rfile.read(n))\n"
+            "        b = json.dumps({'action': d['obs']}).encode()\n"
+            "        self.send_response(200)\n"
+            "        self.send_header('Content-Length', str(len(b)))\n"
+            "        self.end_headers(); self.wfile.write(b)\n"
+            "srv = ThreadingHTTPServer(('127.0.0.1', 0), Toy)\n"
+            "threading.Thread(target=srv.serve_forever, "
+            "daemon=True).start()\n"
+            "router = m.Router([('ra', f'127.0.0.1:"
+            "{srv.server_address[1]}')], port=0)\n"
+            "router.start_background(); time.sleep(0.3)\n"
+            "req = urllib.request.Request("
+            "f'http://{router.host}:{router.port}/predict', "
+            "json.dumps({'obs': [3.0]}).encode(), "
+            "{'Content-Type': 'application/json'})\n"
+            "out = json.loads(urllib.request.urlopen(req, "
+            "timeout=10).read())\n"
+            "assert out['action'] == [3.0], out\n"
+            "assert 'jax' not in sys.modules\n"
+            "router.shutdown(drain=False)\n"
+            "print('ROUTER_FILE_RUN_OK')\n"
+        )
+        r = subprocess.run([sys.executable, "-c", probe],
+                           capture_output=True, text=True, timeout=120)
+        assert r.returncode == 0, r.stderr
+        assert "ROUTER_FILE_RUN_OK" in r.stdout
+
+    def test_fleet_file_run_never_imports_package_or_jax(self, tmp_path):
+        path = os.path.join(REPO, "estorch_tpu", "serve", "fleet.py")
+        cfg = tmp_path / "fleet.json"
+        cfg.write_text(json.dumps(
+            {"schema": 1, "bundle": "b", "replicas": 2,
+             "rollout": {"shadow_fraction": 0.5}}))
+        probe = (
+            "import importlib.util, sys\n"
+            f"spec = importlib.util.spec_from_file_location('f', "
+            f"{path!r})\n"
+            "m = importlib.util.module_from_spec(spec)\n"
+            "spec.loader.exec_module(m)\n"
+            "assert 'jax' not in sys.modules, 'fleet imported jax'\n"
+            "assert 'estorch_tpu' not in sys.modules, 'package init "
+            "ran'\n"
+            f"cfg = m.load_fleet_config({str(cfg)!r})\n"
+            "assert cfg['replicas'] == 2\n"
+            "assert m.validate_fleet_config({'schema': 1}) != []\n"
+            "assert 'jax' not in sys.modules\n"
+            "print('FLEET_FILE_RUN_OK')\n"
+        )
+        r = subprocess.run([sys.executable, "-c", probe],
+                           capture_output=True, text=True, timeout=120)
+        assert r.returncode == 0, r.stderr
+        assert "FLEET_FILE_RUN_OK" in r.stdout
+
+
+# =====================================================================
+# THE acceptance demo: chaos kill + warm respawn + canary rollback
+# =====================================================================
+
+SMALL_PK = {"action_dim": 1, "hidden": (24, 24), "discrete": False,
+            "action_scale": 2.0}
+
+
+def _make_es(seed):
+    import jax
+    import optax
+
+    from estorch_tpu import ES, JaxAgent, MLPPolicy
+    from estorch_tpu.envs.pendulum import Pendulum
+
+    return ES(MLPPolicy, JaxAgent(Pendulum(), horizon=10), optax.adam,
+              population_size=8, sigma=0.05, seed=seed,
+              policy_kwargs=dict(SMALL_PK),
+              optimizer_kwargs={"learning_rate": 1e-2},
+              table_size=1 << 14, device=jax.devices()[0])
+
+
+@pytest.fixture(scope="module")
+def fleet_bundles(tmp_path_factory):
+    """One warm incumbent bundle + a same-params re-export (good canary)
+    + a different-seed bundle (bad canary: valid artifact, different
+    parameters — the parity gate's target)."""
+    root = tmp_path_factory.mktemp("fleet_bundles")
+    es = _make_es(0)
+    es.train(1, verbose=False)
+    incumbent = es.export_bundle(str(root / "incumbent"), warm=True,
+                                 warm_max_batch=4)
+    good = es.export_bundle(str(root / "good"))
+    es_bad = _make_es(1)
+    es_bad.train(1, verbose=False)
+    bad = es_bad.export_bundle(str(root / "bad"))
+    ref = np.asarray(es.predict(
+        np.array([0.1, 0.2, 0.3], np.float32))).tolist()
+    return {"incumbent": incumbent, "good": good, "bad": bad,
+            "ref": ref}
+
+
+class TestFleetChaosDemo:
+    def test_kill_under_load_then_bad_canary_rollback(
+            self, fleet_bundles, tmp_path, monkeypatch):
+        ledger = str(tmp_path / "chaos_ledger")
+        fleet = Fleet(
+            {"schema": 1, "bundle": fleet_bundles["incumbent"],
+             "replicas": 2,
+             "serve": {"max_batch": 4, "cpu_devices": 8},
+             "router": {"retry_budget": 2, "breaker_open_s": 0.5},
+             "respawn": {"backoff_s": 0.2},
+             "rollout": {"shadow_fraction": 0.9, "min_shadow": 12,
+                         "parity_samples": 4, "window_s": 30}},
+            str(tmp_path / "run"), port=0)
+        try:
+            fleet.start()
+            assert fleet.wait_ready(180), fleet.status()
+            # declare the chaos once the fleet SERVES (at_s counts from
+            # arm_chaos): a kill scheduled into the replicas' jax-import
+            # window would murder a replica the router never met
+            monkeypatch.setenv(CHAOS_ENV, json.dumps({
+                "events": [{"kind": "kill_replica", "at_s": 1.5,
+                            "replica": 1}],
+                "ledger": ledger}))
+            fleet.arm_chaos()  # kill_replica@1.5s of SERVING
+            addr = f"{fleet.router.host}:{fleet.router.port}"
+
+            # --- concurrent load across the declared SIGKILL: every
+            # client request answers (retried to the survivor within
+            # the budget), nothing shed
+            load = run_load(addr, conns=6, duration_s=4.5,
+                            obs=[0.1, 0.2, 0.3])
+            assert load["errors"] == 0 and load["shed"] == 0, load
+            assert load["requests"] > 100, load
+            events = [e["event"] for e in fleet.events]
+            assert "chaos_kill_replica" in events, events
+            c = fleet.router.counters
+            assert c.get("router_breaker_opens_total") >= 1
+            assert c.get("router_retries_total") >= 1
+
+            # --- the fleet respawns the corpse and the breaker closes
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                slot = fleet.slots[1]
+                breakers = {r.name: r.breaker.state
+                            for r in fleet.router.replicas()}
+                if (slot.restarts >= 1 and slot.state == "up"
+                        and breakers["r1"] == BREAKER_CLOSED):
+                    break
+                time.sleep(0.2)
+            assert fleet.slots[1].restarts >= 1
+            assert fleet.slots[1].state == "up", fleet.status()
+            assert breakers["r1"] == BREAKER_CLOSED, breakers
+
+            # --- warm respawn: zero fresh XLA builds (PR-12 warmth)
+            with urllib.request.urlopen(
+                    f"http://{fleet.slots[1].address}/stats",
+                    timeout=15) as r:
+                cold = json.loads(r.read())["cold_start"]
+            assert cold["compiles_at_load"] == 0, cold
+            assert cold["warm_cache_hits"] > 0, cold
+
+            # --- bad-canary rollout auto-rolls-back with evidence
+            bg: dict = {}
+
+            def bg_load():
+                bg["res"] = run_load(addr, conns=4, duration_s=18.0,
+                                     obs=[0.1, 0.2, 0.3])
+
+            th = threading.Thread(target=bg_load, daemon=True)
+            th.start()
+            time.sleep(0.5)
+            out, _h = _post(f"http://{addr}/rollout",
+                            {"path": fleet_bundles["bad"]})
+            assert out["ok"] and out["state"] == "canary", out
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                ro = fleet.status()["rollout"]
+                if ro["state"] == "idle" and ro["last"] is not None:
+                    break
+                time.sleep(0.2)
+            last = ro["last"]
+            assert last is not None and last["aborted"], ro
+            # the structured abort cites the parity or tail evidence
+            assert last["reason"] in ("parity", "tail_band"), last
+            if last["reason"] == "parity":
+                assert last["evidence"]["mismatched"] >= 1
+                assert "example" in last["evidence"]
+            else:
+                assert "groups" in last["evidence"]
+
+            # clients kept getting INCUMBENT answers bit-equal to the
+            # exporting run throughout
+            out, _h = _post(f"http://{addr}/predict",
+                            {"obs": [0.1, 0.2, 0.3]})
+            assert out["action"] == fleet_bundles["ref"], out
+
+            # --- a same-params re-export PROMOTES fleet-wide
+            out, _h = _post(f"http://{addr}/rollout",
+                            {"path": fleet_bundles["good"],
+                             "min_shadow": 12, "min_band_pct": 40.0})
+            assert out["ok"], out
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                ro = fleet.status()["rollout"]
+                if (ro["state"] == "idle" and ro["last"]
+                        and ro["last"].get("path")
+                        != fleet_bundles["bad"]):
+                    break
+                time.sleep(0.2)
+            th.join(timeout=30)
+            last = ro["last"]
+            assert last and last.get("promoted"), last
+            assert last["evidence"]["parity_samples"] >= 4
+            assert fleet.bundle == fleet_bundles["good"]
+            # the background load saw zero errors through BOTH rollouts
+            assert bg["res"]["errors"] == 0 and bg["res"]["shed"] == 0, \
+                bg["res"]
+            # answers unchanged (same params, new artifact)
+            out, _h = _post(f"http://{addr}/predict",
+                            {"obs": [0.1, 0.2, 0.3]})
+            assert out["action"] == fleet_bundles["ref"], out
+        finally:
+            final = fleet.shutdown()
+        assert final["clean"], final
+
+
+class TestFleetCLI:
+    def test_route_fleet_end_to_end(self, fleet_bundles, tmp_path):
+        """`python -m estorch_tpu.serve route --fleet fleet.json`: the
+        whole stack from the operator's seat — ready line, routed
+        predict, clean SIGTERM drain (exit 0)."""
+        import signal as _signal
+
+        cfg = tmp_path / "fleet.json"
+        cfg.write_text(json.dumps({
+            "schema": 1, "bundle": fleet_bundles["incumbent"],
+            "replicas": 2,
+            "serve": {"max_batch": 4, "cpu_devices": 8}}))
+        env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        p = subprocess.Popen(
+            [sys.executable, "-m", "estorch_tpu.serve", "route",
+             "--fleet", str(cfg), "--port", "0",
+             "--workdir", str(tmp_path / "run")],
+            stdout=subprocess.PIPE, text=True, env=env, cwd=REPO)
+        try:
+            ready = json.loads(p.stdout.readline())
+            assert ready["role"] == "fleet"
+            assert ready["replicas"] == ["r0", "r1"]
+            url = ready["url"]
+            # wait for at least one replica to come up, then predict
+            deadline = time.monotonic() + 180
+            while time.monotonic() < deadline:
+                try:
+                    out, hdrs = _post(url + "/predict",
+                                      {"obs": [0.1, 0.2, 0.3]},
+                                      timeout=10)
+                    break
+                except (urllib.error.URLError, OSError):
+                    time.sleep(0.3)
+            assert out["action"] == fleet_bundles["ref"], out
+            assert hdrs["X-Upstream"] in ("r0", "r1")
+            p.send_signal(_signal.SIGTERM)
+            rest, _ = p.communicate(timeout=60)
+            final = json.loads(rest.strip().splitlines()[-1])
+            assert final["clean"] and p.returncode == 0, (final,
+                                                          p.returncode)
+        finally:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=30)
+
+
+@pytest.mark.slow
+class TestFleetWedge:
+    def test_wedge_replica_is_escalated_and_respawned(
+            self, fleet_bundles, tmp_path, monkeypatch):
+        """SIGSTOP (declared wedge_replica): alive process, silent
+        socket — the breaker opens on poll timeouts and the fleet
+        escalates to SIGKILL + warm respawn."""
+        fleet = Fleet(
+            {"schema": 1, "bundle": fleet_bundles["incumbent"],
+             "replicas": 2,
+             "serve": {"max_batch": 4, "cpu_devices": 8},
+             "router": {"breaker_open_s": 0.5, "poll_timeout_s": 0.5,
+                        "upstream_timeout_s": 3.0},
+             "respawn": {"backoff_s": 0.2, "wedge_kill_s": 2.0}},
+            str(tmp_path / "run"), port=0)
+        try:
+            fleet.start()
+            assert fleet.wait_ready(180)
+            monkeypatch.setenv(CHAOS_ENV, json.dumps({
+                "events": [{"kind": "wedge_replica", "at_s": 0.5,
+                            "replica": 0}],
+                "ledger": str(tmp_path / "ledger")}))
+            fleet.arm_chaos()
+            addr = f"{fleet.router.host}:{fleet.router.port}"
+            load = run_load(addr, conns=4, duration_s=5.0,
+                            obs=[0.1, 0.2, 0.3])
+            assert load["errors"] == 0 and load["shed"] == 0, load
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                if (fleet.router.counters.get("fleet_wedge_kills_total")
+                        and fleet.slots[0].state == "up"):
+                    break
+                time.sleep(0.2)
+            assert fleet.router.counters.get(
+                "fleet_wedge_kills_total") >= 1
+            assert fleet.slots[0].state == "up", fleet.status()
+            events = [e["event"] for e in fleet.events]
+            assert "chaos_wedge_replica" in events
+        finally:
+            fleet.shutdown()

@@ -487,6 +487,67 @@ class TestDashUnits:
         assert "7.2s!41" in text  # fresh builds called out
         assert "1.5s?" in text  # unproven warmth never reads as clean
 
+    def test_router_columns_from_store_alone(self, tmp_path):
+        """A front-router target (serve/router.py) renders breaker
+        state, windowed retry/hedge increases, and the worst per-replica
+        p99 — all from the stored per-replica labeled gauges; non-router
+        targets honestly render '-'."""
+        from estorch_tpu.obs.agg.dash import fleet_snapshot, render
+
+        root = str(tmp_path / "store")
+        s = SeriesStore(root)
+        now = time.time()
+
+        def batch(retries):
+            return [
+                {"name": "estorch_up", "labels": {"target": "router-1"},
+                 "value": 1},
+                {"name": "estorch_router_replica_up",
+                 "labels": {"target": "router-1", "replica": "r0"},
+                 "value": 1},
+                {"name": "estorch_router_replica_up",
+                 "labels": {"target": "router-1", "replica": "r1"},
+                 "value": 0},
+                {"name": "estorch_router_breaker_state",
+                 "labels": {"target": "router-1", "replica": "r0"},
+                 "value": 0},
+                {"name": "estorch_router_breaker_state",
+                 "labels": {"target": "router-1", "replica": "r1"},
+                 "value": 2},
+                {"name": "estorch_router_upstream_p99_s",
+                 "labels": {"target": "router-1", "replica": "r0"},
+                 "value": 0.004},
+                {"name": "estorch_router_retries_total",
+                 "labels": {"target": "router-1"}, "value": retries},
+                {"name": "estorch_router_hedge_wins_total",
+                 "labels": {"target": "router-1"}, "value": 1},
+                {"name": "estorch_up", "labels": {"target": "serve-a"},
+                 "value": 1},
+            ]
+
+        s.append(batch(3), ts=now - 5)
+        s.append(batch(7), ts=now)  # retries grew by 4 in the window
+        snap = fleet_snapshot(root, window_s=60, now=now)
+        rows = {r["target"]: r for r in snap["targets"]}
+        ro = rows["router-1"]["router"]
+        assert ro["breakers_open"] == 1
+        assert set(ro["replicas"]) == {"r0", "r1"}
+        assert ro["replicas"]["r1"]["breaker"] == 2
+        assert ro["retries"] == 4.0
+        assert ro["worst_p99_s"] == 0.004
+        assert rows["serve-a"]["router"] is None
+        text = render(root, window_s=60, now=now)
+        header = text.splitlines()[1]
+        for col in ("brk", "retry", "hedge", "repl p99"):
+            assert col in header, header
+        router_line = [ln for ln in text.splitlines()
+                       if ln.startswith("router-1")][0]
+        assert "1/2!" in router_line  # one of two breakers open
+        assert "4.0" in router_line or " 4 " in router_line
+        serve_line = [ln for ln in text.splitlines()
+                      if ln.startswith("serve-a")][0]
+        assert serve_line.count("-") >= 4  # honest dashes
+
     def test_resolved_alert_leaves_the_dash(self, tmp_path):
         from estorch_tpu.obs.agg.dash import fleet_snapshot
 

@@ -63,11 +63,16 @@ def test_extended_surface_imports():
         BatcherSaturated,
         Bundle,
         BundleError,
+        CircuitBreaker,
         DynamicBatcher,
+        Fleet,
+        FleetError,
         PolicyServer,
+        Router,
         ServeClient,
         export_bundle,
         load_bundle,
+        load_fleet_config,
         validate_bundle,
     )
     from estorch_tpu.utils import latest_checkpoint  # noqa: F401
