@@ -1238,6 +1238,166 @@ def stage_shard_ab(selfcheck=False):
     return 0 if verdict["pass"] else 1
 
 
+def measure_scenario_one(cfg):
+    """Child body for --stage-scenario-one: the scenario suite's two
+    claims, measured (estorch_tpu/scenarios, docs/scenarios.md):
+
+    1. wall-clock — ONE domain-randomized run (N variants drawn
+       in-program, traced operands) vs the old way to cover N scenarios:
+       N sequential single-scenario runs, each compiling its own
+       closed-over constants;
+    2. compile ledger — the randomized run's program count must be
+       independent of variant count (the traced-operand contract): an
+       N-variant run and an N//3-variant run build the SAME number of
+       XLA programs.
+
+    The persistent compilation cache is deliberately NOT enabled here:
+    the sequential leg's per-variant recompiles are the phenomenon being
+    measured, and a warm cache on the second lint run would fake the
+    win away.
+    """
+    from estorch_tpu.utils import force_cpu_backend
+
+    force_cpu_backend(1)
+    import dataclasses
+    import time
+
+    import optax
+
+    from estorch_tpu import ES, JaxAgent, MLPPolicy
+    from estorch_tpu.envs.pendulum import Pendulum
+    from estorch_tpu.scenarios import ScenarioDistribution
+
+    variants = int(cfg.get("variants", 10))
+    gens = int(cfg.get("gens", 3))
+    horizon = int(cfg.get("horizon", 30))
+    pop = int(cfg.get("population", 32))
+    hidden = tuple(cfg.get("hidden", [16]))
+    base_env = Pendulum()
+    # absolute ranges (not the ±spread helper): the sequential leg
+    # instantiates concrete Pendulum(**draw) envs from the same draws
+    ranges = {"g": (7.0, 13.0), "m": (0.7, 1.3), "l": (0.7, 1.3)}
+
+    def build(env=None, dist=None):
+        return ES(
+            MLPPolicy, JaxAgent(env or base_env, horizon=horizon),
+            optax.adam, population_size=pop, sigma=0.05, seed=0,
+            policy_kwargs={"action_dim": 1, "hidden": hidden,
+                           "discrete": False, "action_scale": 2.0},
+            optimizer_kwargs={"learning_rate": 0.01},
+            table_size=1 << 15, scenarios=dist, telemetry=True)
+
+    def n_compiles(es):
+        return sum(len(r.get("compile_events", [])) for r in es.history)
+
+    def run_randomized(n):
+        dist = ScenarioDistribution(ranges, n_variants=n, seed=0)
+        t0 = time.perf_counter()
+        es = build(dist=dist)
+        es.train(gens, verbose=False)
+        wall = time.perf_counter() - t0
+        seen: set = set()
+        for r in es.history:
+            seen |= {v for v, c in enumerate(r["scenarios"]["counts"])
+                     if c}
+        return {"wall_s": round(wall, 3), "compiles": n_compiles(es),
+                "variants_seen": len(seen),
+                "block": es.history[-1]["scenarios"]}
+
+    # untimed process warm-up: the first ES build in a process pays
+    # one-off eager-dispatch/op-cache costs that would otherwise land
+    # entirely on whichever timed leg runs first
+    warm = build(env=base_env)
+    warm.train(1, verbose=False)
+
+    out = {"cfg": cfg}
+    out["randomized"] = run_randomized(variants)
+    # the O(1)-programs control: far fewer variants, same program count
+    out["randomized_small"] = run_randomized(max(2, variants // 3))
+    dist = ScenarioDistribution(ranges, n_variants=variants, seed=0)
+    t0 = time.perf_counter()
+    seq_compiles = 0
+    for v in range(variants):
+        env_v = dataclasses.replace(base_env, **dist.draw_concrete(v))
+        es_v = build(env=env_v)
+        es_v.train(gens, verbose=False)
+        seq_compiles += n_compiles(es_v)
+    out["sequential"] = {
+        "wall_s": round(time.perf_counter() - t0, 3),
+        "compiles": seq_compiles,
+        "runs": variants,
+    }
+    out["speedup"] = round(
+        out["sequential"]["wall_s"] / max(out["randomized"]["wall_s"],
+                                          1e-9), 2)
+    return out
+
+
+SCENARIO_SPEEDUP_GATE = 3.0  # one randomized run vs N sequential runs
+SCENARIO_COVERAGE_GATE = 0.9  # fraction of variants a run must visit
+
+
+def stage_scenario_ab(selfcheck=False):
+    """Scenario-suite A/B via the stage protocol; the selfcheck form is
+    the run_lint.sh gate.  Exit 0 only when (1) the N-variant randomized
+    run beats N sequential single-scenario runs >= 3x wall-clock, (2)
+    the compile-ledger program count is O(1) in variant count (N-variant
+    == N//3-variant), and (3) per-variant fitness is surfaced with >=90%
+    of variants visited."""
+    cfg = ({"variants": 10, "gens": 3, "population": 48,
+            "horizon": 60, "hidden": [48, 48]}
+           if selfcheck else
+           {"variants": 10, "gens": 3, "population": 64,
+            "horizon": 100, "hidden": [32, 32]})
+    argv = [sys.executable, __file__, "--stage-scenario-one",
+            json.dumps(cfg)]
+    try:
+        r = subprocess.run(
+            argv, timeout=900, capture_output=True, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    except subprocess.TimeoutExpired:
+        print(json.dumps({"label": "scenario/ab",
+                          "error": "timeout after 900s"}), flush=True)
+        return 1
+    try:
+        last = [ln for ln in r.stdout.strip().splitlines()
+                if ln.startswith("{")][-1]
+        row = json.loads(last)
+    except (IndexError, ValueError):
+        print(json.dumps({"label": "scenario/ab",
+                          "error": f"stage exited {r.returncode}",
+                          "stderr_tail": r.stderr[-800:]}), flush=True)
+        return 1
+    rand = row.get("randomized") or {}
+    small = row.get("randomized_small") or {}
+    seq = row.get("sequential") or {}
+    block = rand.get("block") or {}
+    variants = int(cfg["variants"])
+    coverage = rand.get("variants_seen", 0) / variants
+    verdict = {
+        "label": "scenario/ab",
+        "speedup": row.get("speedup"),
+        "speedup_gate": SCENARIO_SPEEDUP_GATE,
+        "randomized_compiles": rand.get("compiles"),
+        "small_variant_compiles": small.get("compiles"),
+        "sequential_compiles": seq.get("compiles"),
+        "programs_o1": rand.get("compiles") == small.get("compiles"),
+        "variants_seen": rand.get("variants_seen"),
+        "coverage": round(coverage, 3),
+        "fitness_block_ok": (
+            block.get("n_variants") == variants
+            and sum(block.get("counts", [])) == int(cfg["population"])),
+        "pass": (
+            (row.get("speedup") or 0) >= SCENARIO_SPEEDUP_GATE
+            and rand.get("compiles") == small.get("compiles")
+            and coverage >= SCENARIO_COVERAGE_GATE
+            and block.get("n_variants") == variants
+            and sum(block.get("counts", [])) == int(cfg["population"])),
+    }
+    print(json.dumps(verdict), flush=True)
+    return 0 if verdict["pass"] else 1
+
+
 def measure_serve_one(cfg):
     """Child body for --stage-serve-one: export a trained pendulum bundle,
     then run the dynamic-batching vs batch-size-1 serving A/B against it
@@ -2228,6 +2388,11 @@ no arguments        full headline benchmark (device probe decides the
                     throughput on native-bf16 hardware)
   --shard-ab [--selfcheck]  replicated vs param-sharded same-seed A/B
                     (numerical match + per-device peak bytes + MFU row)
+  --scenario-ab [--selfcheck]  scenario-suite A/B: one 10-variant
+                    domain-randomized run vs 10 sequential
+                    single-scenario runs (gates the >=3x wall-clock win,
+                    compile-ledger programs O(1) in variant count, and
+                    per-variant fitness coverage)
   --capture-baseline [--out PATH] [--repeats N] [--gens N] [--skip N] [--cpu]
                     produce a committed-baseline BENCH_r*.json carrying
                     the headline median PLUS embedded STEADY-STATE
@@ -2237,7 +2402,8 @@ no arguments        full headline benchmark (device probe decides the
                     committed history
   --regress [BASELINE] [--repeats N] [--cpu]   gate vs newest BENCH_r*.json
 (--stage-one/--stage-chaos-one/--stage-async-one/--stage-serve-one/
- --stage-fleet-one/--stage-shard-ab-one are internal child modes)
+ --stage-fleet-one/--stage-shard-ab-one/--stage-scenario-one are
+ internal child modes)
 """
 
 
@@ -2281,6 +2447,16 @@ if __name__ == "__main__":
         if "--selfcheck" not in sys.argv:
             _lock_or_warn()
         sys.exit(stage_shard_ab(selfcheck="--selfcheck" in sys.argv))
+    elif "--stage-scenario-one" in sys.argv:
+        cfg = json.loads(
+            sys.argv[sys.argv.index("--stage-scenario-one") + 1])
+        print(json.dumps(measure_scenario_one(cfg)))
+    elif "--scenario-ab" in sys.argv:
+        # the selfcheck form runs inside run_lint.sh (tiny config, CPU
+        # child): skip the evidence lock a full measurement takes
+        if "--selfcheck" not in sys.argv:
+            _lock_or_warn()
+        sys.exit(stage_scenario_ab(selfcheck="--selfcheck" in sys.argv))
     elif "--stage-serve-one" in sys.argv:
         cfg = json.loads(sys.argv[sys.argv.index("--stage-serve-one") + 1])
         print(json.dumps(measure_serve_one(cfg)))

@@ -14,7 +14,8 @@ classes are re-exported here as they land:
 
 __version__ = "0.3.0"
 
-from . import envs, models, obs, ops, parallel, resilience, serve, utils  # noqa: F401
+from . import (envs, models, obs, ops, parallel, resilience,  # noqa: F401
+               scenarios, serve, utils)
 from .algo import ES, IW_ES, NS_ES, NSR_ES, NSRA_ES, NoveltyArchive
 from .envs.agent import JaxAgent, PooledAgent
 from .models import (MLPPolicy, NatureCNN, RecurrentNatureCNN,
@@ -40,6 +41,7 @@ __all__ = [
     "ops",
     "parallel",
     "resilience",
+    "scenarios",
     "serve",
     "utils",
     "__version__",

@@ -119,6 +119,7 @@ class ES:
         model_shards: int | None = None,
         partition_rules=None,
         noise_mode: str = "auto",
+        scenarios=None,
     ):
         # telemetry first: every backend-init path below runs with spans/
         # counters available.  None → default-on honoring ESTORCH_OBS /
@@ -172,6 +173,22 @@ class ES:
                 "param-sharded engine; pass shard_params=True"
             )
 
+        # scenario suite (estorch_tpu/scenarios, docs/scenarios.md):
+        # domain randomization over the native env families — the env is
+        # wrapped in a ScenarioEnv below, device paths only (host/pooled
+        # agents step their envs host-side, where per-episode traced
+        # physics constants have no representation)
+        self._scenarios = scenarios
+        if scenarios is not None:
+            from ..scenarios import ScenarioDistribution
+
+            if not isinstance(scenarios, ScenarioDistribution):
+                raise TypeError(
+                    "scenarios must be a ScenarioDistribution "
+                    "(estorch_tpu.scenarios; e.g. "
+                    "default_distribution(env, n_variants=10)), got "
+                    f"{scenarios!r}")
+
         self._policy_arg = policy
         self._policy_kwargs = dict(policy_kwargs or {})
         self._agent_arg = agent
@@ -221,6 +238,13 @@ class ES:
                     "ride the training state); host agents own their "
                     "rollouts — use models.TorchRunningObsNorm there"
                 )
+            if scenarios is not None:
+                raise ValueError(
+                    "scenarios is a device-path option: randomized physics "
+                    "constants enter the jitted rollout as traced operands "
+                    "(estorch_tpu/scenarios); host agents step their envs "
+                    "in Python"
+                )
             self.backend = "host"
             self._init_host(
                 optimizer, dict(optimizer_kwargs or {}), table_size, device,
@@ -250,6 +274,13 @@ class ES:
                     "observations from generation 0, so its init "
                     "transient is one generation long already"
                 )
+            if scenarios is not None:
+                raise ValueError(
+                    "scenarios needs device-native rollouts (traced "
+                    "physics constants); the pooled path steps C++ envs "
+                    "host-side with compiled-in constants "
+                    "(estorch_tpu/scenarios, docs/scenarios.md)"
+                )
             self.backend = "pooled"
             self._init_pooled(
                 policy, dict(policy_kwargs or {}), optimizer,
@@ -265,6 +296,16 @@ class ES:
                 "reference-style agent exposing rollout(policy) (host path)"
             )
         self.env = self.agent.env
+        if scenarios is not None:
+            # ONE wrapper serves every device engine (replicated fused,
+            # split-path, sharded): ScenarioEnv implements the JaxEnv
+            # protocol with the drawn params riding the env state as
+            # traced operands, so engines compile exactly one program
+            # regardless of variant count (compile-ledger proof in
+            # bench.py --scenario-ab)
+            from ..scenarios import ScenarioEnv
+
+            self.env = ScenarioEnv(self.env, scenarios)
         _, obs0 = self.env.reset(jax.random.PRNGKey(0))
 
         def vbn_ref(vbn_key):
@@ -743,9 +784,24 @@ class ES:
                 float(np.asarray(metrics["grad_norm"])), dt,
                 metrics=metrics if self._shard_params else None,
             )
+            self._attach_scenarios(record, fitness, metrics)
             self._emit_record(record, log_fn, verbose)
             done += 1
         return self
+
+    def _attach_scenarios(self, record: dict, fitness, metrics) -> None:
+        """Per-variant fitness block onto a generation record (and thus
+        the obs hub) — the variant id is the BC's last column, the
+        ScenarioEnv.behavior contract (docs/scenarios.md).  ONE
+        definition shared by the sync loop and the overlap scheduler
+        (algo/scheduler.py) so async records carry the same block."""
+        if self._scenarios is None or "bc" not in (metrics or {}):
+            return
+        from ..scenarios import scenario_fitness_block, variant_of_bc
+
+        record["scenarios"] = scenario_fitness_block(
+            fitness, variant_of_bc(metrics["bc"]),
+            self._scenarios.n_variants)
 
     def _update_anomaly(self, metrics) -> str | None:
         """The ONE definition of a rejectable generation (shared by
@@ -1011,6 +1067,11 @@ class ES:
             "streamed": self._streamed,
             "shard_params": self._shard_params,
         }
+        if self._scenarios is not None:
+            # scenario provenance: the distribution spec + draw seed ARE
+            # the scenarios (draws are deterministic in them), so the
+            # manifest names exactly what this run trained under
+            cfg["scenarios"] = self._scenarios.spec_json()
         if self._shard_params:
             from ..parallel.mesh import partition_rules_to_json
 

@@ -47,6 +47,13 @@ class NS_ES(ES):
         archive_max_size: int = 0,
         **kwargs,
     ):
+        if kwargs.get("scenarios") is not None:
+            raise ValueError(
+                "scenarios is not wired into the novelty family: the "
+                "ScenarioEnv appends the variant id to the BC vector, "
+                "which would silently distort archive k-NN novelty "
+                "(estorch_tpu/scenarios; use plain ES or PBTController)"
+            )
         super().__init__(policy, agent, optimizer, **kwargs)
         self.k = k
         self.meta_population_size = int(meta_population_size)

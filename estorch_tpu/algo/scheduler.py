@@ -1067,6 +1067,7 @@ def train_overlap(es, n_steps: int, log_fn=None, verbose: bool = True,
                 float(np.asarray(metrics["grad_norm"])), dt,
                 metrics=metrics if es._shard_params else None,
             )
+            es._attach_scenarios(record, fitness, metrics)
             es._emit_record(record, log_fn, verbose)
             done += 1
             prev_state = new_state

@@ -33,6 +33,10 @@ Rules (docs/analysis.md has the full rationale per rule):
                                 request handler or non-load-time loop
 * R15 unbounded-retry         — network retry loop with no attempt bound
                                 or no backoff between attempts
+* R16 scenario-constant-closure — per-scenario constant closed over by
+                                a jitted rollout/step construction
+                                (recompile-per-variant; traced-operand
+                                contract of estorch_tpu/scenarios)
 
 Nothing in this package imports jax or the analyzed modules — analysis
 is pure ``ast`` and safe to run where no accelerator exists.

@@ -15,7 +15,8 @@ from __future__ import annotations
 import ast
 
 from .context import ModuleContext
-from .engine import get_rule, make_finding, rule, scope_nodes
+from .engine import (enclosing_defs, get_rule, iter_scopes, make_finding,
+                     rule, scope_nodes)
 
 _FN_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
 
@@ -506,4 +507,154 @@ def check_unsharded_capture(ctx: ModuleContext):
                 "NamedSharding, listed in in_shardings) or generate it "
                 "in-program (jax.random)",
                 qualname))
+    return out
+
+
+# ---------------------------------------------------------------------
+# R16 scenario-constant-closure
+# ---------------------------------------------------------------------
+#
+# The scenario suite's one-program contract (estorch_tpu/scenarios,
+# docs/scenarios.md): per-variant physics constants must enter the
+# jitted rollout as TRACED OPERANDS (riding the env state / function
+# arguments), never as Python closures — a closed-over per-scenario
+# scalar/array bakes into the HLO as a constant, so N variants lower N
+# distinct programs and the compile ledger fills with near-identical
+# builds (the recompile-per-variant smell).  Unlike R14 (which exempts
+# load-time builder scopes, where a ladder of programs is legitimate),
+# this rule fires in EVERY scope: building one program per scenario is
+# the thing the suite exists to avoid, even at load time.
+#
+# Shape detected: a loop (or comprehension) whose target/iterable names
+# read scenario-ish ("scenario"/"variant"/"domain"), whose per-iteration
+# subtree constructs a traced program — jit/pmap/shard_map, or one of
+# the envs/rollout.py builders — with the loop variable (or a value
+# derived from it inside the loop) referenced anywhere in the
+# construction.  Calling an ALREADY-jitted program with per-variant
+# arguments is the fix, and stays silent.
+
+_SCENARIO_TOKENS = ("scenario", "variant", "domain")
+_ROLLOUT_BUILDERS = {"make_rollout", "make_population_rollout",
+                     "make_batched_rollout"}
+
+
+def _scenarioish_names(*nodes: ast.AST) -> bool:
+    for node in nodes:
+        if node is None:
+            continue
+        for sub in ast.walk(node):
+            name = None
+            if isinstance(sub, ast.Name):
+                name = sub.id
+            elif isinstance(sub, ast.Attribute):
+                name = sub.attr
+            if name and any(t in name.lower() for t in _SCENARIO_TOKENS):
+                return True
+    return False
+
+
+def _target_names(target: ast.AST) -> set[str]:
+    return {n.id for n in ast.walk(target) if isinstance(n, ast.Name)}
+
+
+def _is_program_ctor(ctx: ModuleContext, node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    resolved = ctx.resolve(node.func)
+    if resolved is None:
+        return False
+    tail = resolved.rsplit(".", 1)[-1]
+    return tail in ("jit", "pmap", "shard_map") or tail in _ROLLOUT_BUILDERS
+
+
+def _derived_names(body: list[ast.AST], seeds: set[str]) -> set[str]:
+    """Seeds plus names bound (one straight-line pass, iterated to a
+    fixpoint) from expressions referencing a seed — `p = scenario.g`
+    makes `p` per-scenario too."""
+    names = set(seeds)
+    changed = True
+    while changed:
+        changed = False
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if not isinstance(node, ast.Assign):
+                    continue
+                refs = {n.id for n in ast.walk(node.value)
+                        if isinstance(n, ast.Name)}
+                if refs & names:
+                    for t in node.targets:
+                        new = _target_names(t) - names
+                        if new:
+                            names |= new
+                            changed = True
+    return names
+
+
+def _loop_sites(scope: ast.AST):
+    """(per-iteration body nodes, target names, scenario-ish?) for every
+    for-loop and comprehension in one scope."""
+    for node in scope_nodes(scope):
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            yield (list(node.body) + list(node.orelse),
+                   _target_names(node.target),
+                   _scenarioish_names(node.target, node.iter))
+        elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                               ast.GeneratorExp)):
+            parts = ([node.key, node.value]
+                     if isinstance(node, ast.DictComp) else [node.elt])
+            targets: set[str] = set()
+            scenarioish = False
+            for gen in node.generators:
+                targets |= _target_names(gen.target)
+                scenarioish = scenarioish or _scenarioish_names(
+                    gen.target, gen.iter)
+            yield [p for p in parts if p is not None], targets, scenarioish
+
+
+@rule("R16", "scenario-constant-closure", "warning",
+      "per-scenario constant closed over by a jitted rollout/step program "
+      "— one XLA program per variant instead of one traced operand")
+def check_scenario_constant_closure(ctx: ModuleContext):
+    r = get_rule("R16")
+    out = []
+    seen: set[int] = set()
+    enclosing = enclosing_defs(ctx.tree)  # once per module, not per finding
+    for _symbol, scope in iter_scopes(ctx):
+        for body, targets, scenarioish in _loop_sites(scope):
+            if not scenarioish or not targets:
+                continue
+            per_variant = _derived_names(body, targets)
+            for stmt in body:
+                ctors = [n for n in ast.walk(stmt)
+                         if _is_program_ctor(ctx, n)]
+                # one finding per construction SITE: jit(make_rollout(..,
+                # variant)) is one smell, not two — drop ctors nested
+                # inside another ctor's subtree
+                nested = {id(inner) for outer in ctors
+                          for inner in ast.walk(outer)
+                          if inner is not outer
+                          and _is_program_ctor(ctx, inner)}
+                for node in ctors:
+                    if id(node) in nested:
+                        continue
+                    refs = {n.id for n in ast.walk(node)
+                            if isinstance(n, ast.Name)
+                            and isinstance(n.ctx, ast.Load)}
+                    if not (refs & per_variant) or id(node) in seen:
+                        continue
+                    seen.add(id(node))
+                    names = sorted(refs & per_variant)
+                    qualname = ctx.qualnames.get(
+                        enclosing.get(node) or ctx.tree, "<module>")
+                    out.append(make_finding(
+                        ctx, r, node,
+                        f"per-scenario value(s) {names} are closed over "
+                        "by a traced-program construction inside a "
+                        "scenario loop — every variant lowers its own "
+                        "XLA program (recompile-per-variant)",
+                        "make the scenario constants traced operands: a "
+                        "ScenarioParams pytree riding the env state "
+                        "(estorch_tpu/scenarios) or an explicit argument "
+                        "of ONE jitted program called per variant",
+                        qualname))
     return out

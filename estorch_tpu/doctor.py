@@ -296,6 +296,89 @@ def check_mesh(timeout_s: float = 90.0) -> dict:
     return result
 
 
+# scenario probe: proves the scenario suite (estorch_tpu/scenarios,
+# docs/scenarios.md) works here — (1) the distribution draw is
+# deterministic in (seed, variant) and stacks host-side, (2) one tiny
+# jitted rollout evaluates episodes across 3 variants with the drawn
+# constants as TRACED OPERANDS (finite fitness, variant ids in range).
+# Forced onto the CPU backend in the child so the probe cannot touch
+# (or wedge on) a real device runtime.
+_SCENARIO_PROBE = """
+import sys
+print("SCEN_START", flush=True)
+from estorch_tpu.utils import force_cpu_backend
+force_cpu_backend(2)
+import jax
+import jax.numpy as jnp
+import numpy as np
+from estorch_tpu.envs.pendulum import Pendulum
+from estorch_tpu.envs.rollout import make_rollout
+from estorch_tpu.scenarios import ScenarioEnv, default_distribution
+dist = default_distribution(Pendulum(), n_variants=3, spread=0.2, seed=0)
+a = dist.draw_concrete(1)
+b = dist.draw_concrete(1)
+assert a == b, ("non-deterministic draw", a, b)
+stacked = dist.draw_all()
+for name in dist.names:
+    assert np.asarray(stacked[name]).shape == (3,), name
+print("SCEN_DRAW_OK", flush=True)
+env = ScenarioEnv(Pendulum(), dist)
+rollout = jax.jit(jax.vmap(
+    make_rollout(env, lambda p, obs: jnp.tanh(obs @ p), 5),
+    in_axes=(None, 0)))
+res = rollout(jnp.zeros((3, 1)),
+              jax.random.split(jax.random.PRNGKey(0), 6))
+f = np.asarray(res.total_reward)
+v = np.rint(np.asarray(res.bc)[:, -1]).astype(int)
+assert np.isfinite(f).all(), f
+assert set(v) <= {0, 1, 2}, v
+print("SCEN_ROLLOUT_OK", flush=True)
+"""
+
+_SCENARIO_STAGES = (
+    ("SCEN_DRAW_OK", "draw-determinism"),
+    ("SCEN_ROLLOUT_OK", "traced-rollout"),
+)
+
+
+def classify_scenario_probe(out: str, timed_out: bool, returncode
+                            ) -> tuple[str, str | None]:
+    """(status, failed-stage) from the scenario probe's markers — pure,
+    so the taxonomy is unit-testable without running the probe."""
+    markers = {ln.split()[0] for ln in out.splitlines() if ln.strip()}
+    if "SCEN_ROLLOUT_OK" in markers and not timed_out and returncode == 0:
+        return "ok", None
+    for marker, stage in _SCENARIO_STAGES:
+        if marker not in markers:
+            return "failed", stage
+    return "failed", "traced-rollout"
+
+
+def check_scenarios(timeout_s: float = 90.0) -> dict:
+    """Can the scenario suite run here?  Findings, never tracebacks: a
+    failure names the stage (draw-determinism vs traced-rollout) with a
+    stderr tail, and a hung child is killed at the timeout."""
+    import os
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    run = _run_staged_probe(_SCENARIO_PROBE, timeout_s, env)
+    status, stage = classify_scenario_probe(run["out"], run["timed_out"],
+                                            run["returncode"])
+    result: dict = {
+        "status": status,
+        "elapsed_s": run["elapsed_s"],
+        "timeout_s": timeout_s,
+    }
+    if status != "ok":
+        result["failed_stage"] = stage
+        result["timed_out"] = run["timed_out"]
+        result["stderr_tail"] = run["err"][-500:]
+    if run["unreapable"]:
+        result["unreapable_child"] = True
+    return result
+
+
 def check_native_pool() -> dict:
     """Is the C++ env pool built/loadable, or will pools fall back to NumPy?"""
     try:
@@ -977,6 +1060,7 @@ def report(timeout_s: float = 45.0, run_dir: str | None = None,
         "device_probe": probe,
         "native": check_native_pool(),
         "mesh": check_mesh(),
+        "scenarios": check_scenarios(),
         "optional": check_optional_deps(),
         "host": check_host(),
         "obs": check_obs(run_dir),

@@ -37,6 +37,14 @@ class Acrobot:
     default_horizon: int = 500
     bc_dim: int = 2
 
+    # physics constants liftable into a traced ScenarioParams operand
+    # (estorch_tpu/scenarios, docs/scenarios.md)
+    SCENARIO_FIELDS = ("link_mass_1", "link_mass_2", "link_length_1",
+                       "link_com_1", "link_com_2", "g")
+
+    def scenario_defaults(self) -> dict:
+        return {n: float(getattr(self, n)) for n in self.SCENARIO_FIELDS}
+
     def _obs(self, s):
         t1, t2, dt1, dt2 = s[0], s[1], s[2], s[3]
         return jnp.stack([jnp.cos(t1), jnp.sin(t1), jnp.cos(t2), jnp.sin(t2), dt1, dt2])
@@ -45,12 +53,16 @@ class Acrobot:
         s = jax.random.uniform(key, (4,), minval=-0.1, maxval=0.1)
         return s, self._obs(s)
 
-    def _dsdt(self, s, torque):
-        m1, m2 = self.link_mass_1, self.link_mass_2
-        l1 = self.link_length_1
-        lc1, lc2 = self.link_com_1, self.link_com_2
+    def _dsdt(self, s, torque, params=None):
+        from .base import scenario_value as sv
+
+        m1 = sv(params, "link_mass_1", self.link_mass_1)
+        m2 = sv(params, "link_mass_2", self.link_mass_2)
+        l1 = sv(params, "link_length_1", self.link_length_1)
+        lc1 = sv(params, "link_com_1", self.link_com_1)
+        lc2 = sv(params, "link_com_2", self.link_com_2)
         I1 = I2 = self.link_moi
-        g = self.g
+        g = sv(params, "g", self.g)
         t1, t2, dt1, dt2 = s[0], s[1], s[2], s[3]
 
         d1 = (
@@ -75,15 +87,19 @@ class Acrobot:
         return jnp.stack([dt1, dt2, ddt1, ddt2])
 
     def step(self, state, action):
+        return self.step_p(None, state, action)
+
+    def step_p(self, params, state, action):
+        """ONE dynamics definition for both forms (see Pendulum.step_p)."""
         torque = (action - 1).astype(jnp.float32)  # {0,1,2} -> {-1,0,+1}
 
         # RK4 over one dt with constant torque (gymnasium's rk4)
         s = state
         h = self.dt
-        k1 = self._dsdt(s, torque)
-        k2 = self._dsdt(s + h / 2.0 * k1, torque)
-        k3 = self._dsdt(s + h / 2.0 * k2, torque)
-        k4 = self._dsdt(s + h * k3, torque)
+        k1 = self._dsdt(s, torque, params)
+        k2 = self._dsdt(s + h / 2.0 * k1, torque, params)
+        k3 = self._dsdt(s + h / 2.0 * k2, torque, params)
+        k4 = self._dsdt(s + h * k3, torque, params)
         ns = s + h / 6.0 * (k1 + 2 * k2 + 2 * k3 + k4)
 
         t1 = _wrap(ns[0], -jnp.pi, jnp.pi)

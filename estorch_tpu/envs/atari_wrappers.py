@@ -79,7 +79,11 @@ class AtariPreprocessPool:
         self._pending_refill = np.zeros(self.n_envs, bool)
 
     def is_native(self) -> bool:
-        return self._pool.is_native()
+        # the pool families disagree on the spelling (NativeEnvPool:
+        # property; GymVecPool: method) — accept both, so wrapping a
+        # real C++ pool doesn't crash on a bool() call
+        probe = self._pool.is_native
+        return bool(probe() if callable(probe) else probe)
 
     # ------------------------------------------------------------ internals
 

@@ -30,6 +30,23 @@ import jax
 EnvState = Any  # pytree of arrays
 
 
+def scenario_value(params, name: str, default):
+    """THE lookup rule of every parameterized env family: the scenario
+    pytree's traced value when the draw includes ``name``, else the env's
+    static dataclass constant.
+
+    ``params is None`` (the plain ``step`` path) short-circuits to the
+    Python-float default, so the un-randomized graph is IDENTICAL to the
+    pre-scenario one — goldens and parity tests see no change.  Presence
+    of a name in ``params`` is a Python-level (static) fact, so variant
+    count never shows up in program structure: N variants differ only in
+    traced VALUES, one XLA program total (estorch_tpu/scenarios,
+    docs/scenarios.md)."""
+    if params is None:
+        return default
+    return params.get(name, default)
+
+
 class JaxEnv(Protocol):
     """Structural type for device-native envs."""
 

@@ -34,21 +34,40 @@ class CartPole:
     default_horizon: int = 500
     bc_dim: int = 2
 
+    # physics constants liftable into a traced ScenarioParams operand
+    # (estorch_tpu/scenarios, docs/scenarios.md)
+    SCENARIO_FIELDS = ("gravity", "masscart", "masspole", "length",
+                       "force_mag")
+
+    def scenario_defaults(self) -> dict:
+        return {n: float(getattr(self, n)) for n in self.SCENARIO_FIELDS}
+
     def reset(self, key: jax.Array) -> Tuple[jax.Array, jax.Array]:
         state = jax.random.uniform(key, (4,), minval=-0.05, maxval=0.05)
         return state, state
 
     def step(self, state, action):
+        return self.step_p(None, state, action)
+
+    def step_p(self, params, state, action):
+        """ONE dynamics definition for both forms (see Pendulum.step_p)."""
+        from .base import scenario_value as sv
+
+        gravity = sv(params, "gravity", self.gravity)
+        masscart = sv(params, "masscart", self.masscart)
+        masspole = sv(params, "masspole", self.masspole)
+        length = sv(params, "length", self.length)
+        force_mag = sv(params, "force_mag", self.force_mag)
         x, x_dot, theta, theta_dot = state[0], state[1], state[2], state[3]
-        force = jnp.where(action == 1, self.force_mag, -self.force_mag)
+        force = jnp.where(action == 1, force_mag, -force_mag)
         costheta = jnp.cos(theta)
         sintheta = jnp.sin(theta)
-        total_mass = self.masscart + self.masspole
-        polemass_length = self.masspole * self.length
+        total_mass = masscart + masspole
+        polemass_length = masspole * length
 
         temp = (force + polemass_length * theta_dot**2 * sintheta) / total_mass
-        thetaacc = (self.gravity * sintheta - costheta * temp) / (
-            self.length * (4.0 / 3.0 - self.masspole * costheta**2 / total_mass)
+        thetaacc = (gravity * sintheta - costheta * temp) / (
+            length * (4.0 / 3.0 - masspole * costheta**2 / total_mass)
         )
         xacc = temp - polemass_length * thetaacc * costheta / total_mass
 

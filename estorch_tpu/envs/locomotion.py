@@ -247,6 +247,41 @@ class _PlanarBase:
     min_height = None
     max_lean = None
 
+    # chain constants liftable into a traced ScenarioParams operand
+    # (estorch_tpu/scenarios, docs/scenarios.md).  The chain's absolute
+    # constants are per-body/per-joint TUPLES tuned jointly for
+    # integrator stability, so the family randomizes multiplicative
+    # SCALES (default 1.0) rather than absolute values — a ±30% mass or
+    # gravity scale preserves the dt·√k stability margins the class
+    # docstring above derives.
+    SCENARIO_FIELDS = ("gravity_scale", "mass_scale", "friction_scale",
+                       "gear_scale")
+
+    def scenario_defaults(self) -> dict:
+        return {n: 1.0 for n in self.SCENARIO_FIELDS}
+
+    def _scenario_chain(self, params) -> _Chain:
+        """The chain with any drawn scales applied.  ``params is None``
+        returns ``self.chain`` itself — no replace, identical graph.
+        Traced scales live INSIDE the rebuilt chain's fields (tuples of
+        traced scalars stack fine under ``jnp.asarray``), so the physics
+        step needs no second code path."""
+        if params is None:
+            return self.chain
+        ch = self.chain
+        kw = {}
+        if "gravity_scale" in params:
+            kw["gravity"] = ch.gravity * params["gravity_scale"]
+        if "mass_scale" in params:
+            s = params["mass_scale"]
+            kw["mass"] = tuple(m * s for m in ch.mass)
+        if "friction_scale" in params:
+            kw["friction"] = ch.friction * params["friction_scale"]
+        if "gear_scale" in params:
+            s = params["gear_scale"]
+            kw["gear"] = tuple(g * s for g in ch.gear)
+        return dataclasses.replace(ch, **kw) if kw else ch
+
     def _obs(self, state):
         """Standard runner observation: torso height + lean, joint angles,
         torso velocity/spin, joint rates (the MuJoCo runner layout)."""
@@ -278,13 +313,18 @@ class _PlanarBase:
         return state, self._obs(state)
 
     def step(self, state, action):
+        return self.step_p(None, state, action)
+
+    def step_p(self, params, state, action):
+        """ONE dynamics definition for both forms (see Pendulum.step_p)."""
+        chain = self._scenario_chain(params)
         act = jnp.clip(jnp.atleast_1d(action), -1.0, 1.0)
 
         def body(s, _):
-            return _physics_step(self.chain, s, act), None
+            return _physics_step(chain, s, act), None
 
         new_state, _ = jax.lax.scan(body, state, None,
-                                    length=self.chain.frame_skip)
+                                    length=chain.frame_skip)
         new_state = dict(new_state, t=state["t"] + 1)
         reward, done = self._reward_done(state, new_state, act)
         return new_state, self._obs(new_state), reward, done

@@ -29,17 +29,32 @@ class MountainCarContinuous:
     bc_dim: int = 1
     action_bound: float = 1.0  # force clipped to ±1
 
+    # physics constants liftable into a traced ScenarioParams operand
+    # (estorch_tpu/scenarios, docs/scenarios.md)
+    SCENARIO_FIELDS = ("power", "max_speed")
+
+    def scenario_defaults(self) -> dict:
+        return {n: float(getattr(self, n)) for n in self.SCENARIO_FIELDS}
+
     def reset(self, key: jax.Array) -> Tuple[jax.Array, jax.Array]:
         pos = jax.random.uniform(key, (), minval=-0.6, maxval=-0.4)
         state = jnp.stack([pos, jnp.float32(0.0)])
         return state, state
 
     def step(self, state, action):
+        return self.step_p(None, state, action)
+
+    def step_p(self, params, state, action):
+        """ONE dynamics definition for both forms (see Pendulum.step_p)."""
+        from .base import scenario_value as sv
+
+        power = sv(params, "power", self.power)
+        max_speed = sv(params, "max_speed", self.max_speed)
         position, velocity = state[0], state[1]
         force = jnp.clip(action.reshape(()), -1.0, 1.0)
 
-        velocity = velocity + force * self.power - 0.0025 * jnp.cos(3 * position)
-        velocity = jnp.clip(velocity, -self.max_speed, self.max_speed)
+        velocity = velocity + force * power - 0.0025 * jnp.cos(3 * position)
+        velocity = jnp.clip(velocity, -max_speed, max_speed)
         position = position + velocity
         position = jnp.clip(position, self.min_position, self.max_position)
         velocity = jnp.where(

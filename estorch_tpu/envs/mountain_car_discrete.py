@@ -30,17 +30,33 @@ class MountainCar:
     default_horizon: int = 200
     bc_dim: int = 1
 
+    # physics constants liftable into a traced ScenarioParams operand
+    # (estorch_tpu/scenarios, docs/scenarios.md)
+    SCENARIO_FIELDS = ("force", "gravity", "max_speed")
+
+    def scenario_defaults(self) -> dict:
+        return {n: float(getattr(self, n)) for n in self.SCENARIO_FIELDS}
+
     def reset(self, key: jax.Array) -> Tuple[jax.Array, jax.Array]:
         pos = jax.random.uniform(key, (), minval=-0.6, maxval=-0.4)
         state = jnp.stack([pos, jnp.float32(0.0)])
         return state, state
 
     def step(self, state, action):
+        return self.step_p(None, state, action)
+
+    def step_p(self, params, state, action):
+        """ONE dynamics definition for both forms (see Pendulum.step_p)."""
+        from .base import scenario_value as sv
+
+        force_c = sv(params, "force", self.force)
+        gravity = sv(params, "gravity", self.gravity)
+        max_speed = sv(params, "max_speed", self.max_speed)
         position, velocity = state[0], state[1]
-        velocity = velocity + (action - 1) * self.force + jnp.cos(
+        velocity = velocity + (action - 1) * force_c + jnp.cos(
             3 * position
-        ) * (-self.gravity)
-        velocity = jnp.clip(velocity, -self.max_speed, self.max_speed)
+        ) * (-gravity)
+        velocity = jnp.clip(velocity, -max_speed, max_speed)
         position = jnp.clip(position + velocity, self.min_position, self.max_position)
         velocity = jnp.where(
             (position == self.min_position) & (velocity < 0), 0.0, velocity

@@ -33,6 +33,13 @@ class Pendulum:
     bc_dim: int = 2
     action_bound: float = 2.0  # |torque| ≤ max_torque
 
+    # physics constants liftable into a traced ScenarioParams operand
+    # (estorch_tpu/scenarios, docs/scenarios.md)
+    SCENARIO_FIELDS = ("g", "m", "l", "max_torque")
+
+    def scenario_defaults(self) -> dict:
+        return {n: float(getattr(self, n)) for n in self.SCENARIO_FIELDS}
+
     def _obs(self, state):
         th, thdot = state[0], state[1]
         return jnp.stack([jnp.cos(th), jnp.sin(th), thdot])
@@ -43,12 +50,24 @@ class Pendulum:
         return state, self._obs(state)
 
     def step(self, state, action):
+        return self.step_p(None, state, action)
+
+    def step_p(self, params, state, action):
+        """ONE dynamics definition for both forms: ``params`` is None
+        (plain path — constants stay Python floats, graph unchanged) or a
+        ScenarioParams pytree whose values enter as traced operands."""
+        from .base import scenario_value as sv
+
+        g = sv(params, "g", self.g)
+        m = sv(params, "m", self.m)
+        l = sv(params, "l", self.l)
+        max_torque = sv(params, "max_torque", self.max_torque)
         th, thdot = state[0], state[1]
-        u = jnp.clip(action.reshape(()), -self.max_torque, self.max_torque)
+        u = jnp.clip(action.reshape(()), -max_torque, max_torque)
         cost = _angle_normalize(th) ** 2 + 0.1 * thdot**2 + 0.001 * u**2
 
         newthdot = thdot + (
-            3 * self.g / (2 * self.l) * jnp.sin(th) + 3.0 / (self.m * self.l**2) * u
+            3 * g / (2 * l) * jnp.sin(th) + 3.0 / (m * l**2) * u
         ) * self.dt
         newthdot = jnp.clip(newthdot, -self.max_speed, self.max_speed)
         newth = th + newthdot * self.dt

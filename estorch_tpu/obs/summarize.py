@@ -47,6 +47,9 @@ RECORD_SCHEMA: dict[str, tuple[tuple[type, ...], bool]] = {
     # async scheduler accounting (algo/scheduler.py, docs/async.md):
     # consumed/fresh/folded/stale_discarded per update + overlap facts
     "async": ((dict,), False),
+    # scenario suite (estorch_tpu/scenarios, docs/scenarios.md):
+    # per-variant fitness block — n_variants + per-variant counts/mean/best
+    "scenarios": ((dict,), False),
 }
 
 # integer accounting keys an ``async`` block must carry (the zero-drop
@@ -130,6 +133,29 @@ def validate_record(rec: dict) -> list[str]:
             problems.append(
                 f"async accounting broken: consumed {a['consumed']} != "
                 f"fresh {a['fresh']} + folded {a['folded']}")
+    sc = rec.get("scenarios")
+    if isinstance(sc, dict):
+        nv = sc.get("n_variants")
+        if not isinstance(nv, int) or isinstance(nv, bool) or nv < 1:
+            problems.append(f"scenarios.n_variants {nv!r} is not a "
+                            "positive int")
+        else:
+            for key in ("counts", "mean", "best"):
+                v = sc.get(key)
+                if not isinstance(v, list) or len(v) != nv:
+                    problems.append(
+                        f"scenarios.{key} is not a length-{nv} list")
+                elif key == "counts" and any(
+                        not isinstance(c, int) or isinstance(c, bool)
+                        or c < 0 for c in v):
+                    problems.append("scenarios.counts has a negative "
+                                    "or non-int entry")
+                elif key != "counts" and any(
+                        not (x is None or (isinstance(x, (int, float))
+                                           and not isinstance(x, bool)))
+                        for x in v):
+                    problems.append(f"scenarios.{key} has a non-numeric "
+                                    "entry")
     for i, e in enumerate(rec.get("compile_events") or []):
         if not isinstance(e, dict) or not isinstance(e.get("program"), str):
             problems.append(f"compile_events[{i}] lacks a program name")
@@ -189,6 +215,83 @@ STALL_FACTOR = 5.0  # a generation this many × the median wall time stalls
 # which is not a diagnosis worth shouting about
 TAIL_RATIO_THRESHOLD = 10.0
 TAIL_P99_FLOOR_S = 0.05
+
+# WORST-VARIANT callout (scenario suite): a variant whose aggregated
+# mean fitness lags the cross-variant family median by more than this
+# many cross-variant MADs is called out — one systematically-losing
+# scenario hiding inside a healthy-looking family mean is exactly what
+# per-variant accounting exists to surface (docs/scenarios.md)
+SCENARIO_MAD_FACTOR = 2.0
+
+
+def _scenarios_section(records: list[dict]) -> tuple[dict | None,
+                                                     str | None]:
+    """(scenarios summary, diagnosis clause) aggregated over the run's
+    per-generation blocks, or (None, None) for un-randomized runs.
+    Count-weighted per-variant means, run-best bests, summed counts —
+    the stdlib twin of scenarios/fitness.py's numpy aggregation (this
+    module stays stdlib-only)."""
+    blocks = [r["scenarios"] for r in records
+              if isinstance(r.get("scenarios"), dict)
+              and isinstance(r["scenarios"].get("n_variants"), int)]
+    if not blocks:
+        return None, None
+    width = max(int(b["n_variants"]) for b in blocks)
+    counts = [0] * width
+    wsum = [0.0] * width
+    wcnt = [0.0] * width
+    best: list[float | None] = [None] * width
+
+    def num(x):
+        return (float(x) if isinstance(x, (int, float))
+                and not isinstance(x, bool) and math.isfinite(x) else None)
+
+    for b in blocks:
+        cs = b.get("counts") or []
+        ms = b.get("mean") or []
+        bs = b.get("best") or []
+        for v in range(min(width, len(cs))):
+            c = int(cs[v]) if isinstance(cs[v], int) else 0
+            counts[v] += c
+            m = num(ms[v]) if v < len(ms) else None
+            if m is not None and c > 0:
+                wsum[v] += m * c
+                wcnt[v] += c
+            bb = num(bs[v]) if v < len(bs) else None
+            if bb is not None:
+                best[v] = bb if best[v] is None else max(best[v], bb)
+    means = [wsum[v] / wcnt[v] if wcnt[v] else None for v in range(width)]
+    section = {
+        "n_variants": width,
+        "coverage": round(sum(1 for c in counts if c) / width, 4),
+        "counts": counts,
+        "mean": [round(m, 4) if m is not None else None for m in means],
+        "best": [round(b, 4) if b is not None else None for b in best],
+    }
+    clause = None
+    finite = [m for m in means if m is not None]
+    if len(finite) >= 3:
+        med = _median(finite)
+        mad = _median([abs(m - med) for m in finite])
+        worst_v = min((v for v in range(width) if means[v] is not None),
+                      key=lambda v: means[v])
+        lag = med - means[worst_v]
+        if mad > 0 and lag > SCENARIO_MAD_FACTOR * mad:
+            section["worst_variant"] = {
+                "variant": worst_v,
+                "mean": round(means[worst_v], 4),
+                "family_median": round(med, 4),
+                "cross_variant_mad": round(mad, 4),
+                "lag_in_mads": round(lag / mad, 2),
+            }
+            clause = (
+                f"WORST-VARIANT: scenario variant {worst_v} mean "
+                f"{means[worst_v]:.4g} lags the family median {med:.4g} "
+                f"by {lag / mad:.1f}x the cross-variant MAD — one "
+                "scenario is systematically losing; inspect its drawn "
+                "constants (manifest config.scenarios)")
+    return section, clause
+
 
 # counters surfaced in the summary/diagnosis when nonzero — the
 # resilience layer's evidence that a run survived faults rather than
@@ -391,6 +494,8 @@ def summarize(records: list[dict], heartbeat_path: str | None = None,
             async_block["queue_wait_tail_ratio"] = round(
                 qw["p99"] / qw["p50"], 2)
 
+    scenarios_section, scenario_clause = _scenarios_section(records)
+
     diagnosis = []
     if stalls:
         worst = max(stalls, key=lambda s: s["x_median"])
@@ -480,6 +585,12 @@ def summarize(records: list[dict], heartbeat_path: str | None = None,
                     f"{ratio}x p50 {qw['p50']}s — a few results wait far "
                     "longer than typical (stragglers or a starved fold "
                     "loop); check async/eval_s and stale discards")
+    if scenarios_section is not None:
+        diagnosis.append(
+            f"scenarios: {scenarios_section['n_variants']} variants, "
+            f"{scenarios_section['coverage']:.0%} covered")
+        if scenario_clause:
+            diagnosis.append(scenario_clause)
     if not diagnosis:
         diagnosis.append("steady: no stalls, no throughput decay")
 
@@ -506,6 +617,8 @@ def summarize(records: list[dict], heartbeat_path: str | None = None,
         out["restarts"] = restarts
     if async_block is not None:
         out["async"] = async_block
+    if scenarios_section is not None:
+        out["scenarios"] = scenarios_section
     return out
 
 
@@ -577,6 +690,20 @@ def format_summary(s: dict) -> str:
                 tail += (f"  staleness p50={st['p50']} "
                          f"p99={st['p99']}")
             lines.append(tail)
+    sc = s.get("scenarios")
+    if sc:
+        means = [m for m in sc["mean"] if m is not None]
+        line = (f"scenarios        {sc['n_variants']} variants  "
+                f"coverage {sc['coverage']:.0%}")
+        if means:
+            line += (f"  mean {min(means):.4g}..{max(means):.4g}")
+        lines.append(line)
+        wv = sc.get("worst_variant")
+        if wv:
+            lines.append(
+                f"  └ worst v{wv['variant']:<3} mean {wv['mean']:.4g}  "
+                f"({wv['lag_in_mads']}x MAD below median "
+                f"{wv['family_median']:.4g})")
     lines.extend(_format_serving(s))
     if s.get("restarts") and s["restarts"]["count"]:
         lines.append(f"restarts         {s['restarts']['count']} "
@@ -693,6 +820,49 @@ def selfcheck() -> list[str]:
     # a synchronous run must not grow an async section
     if summarize(recs).get("async"):
         problems.append("sync run grew an async section")
+
+    # scenario suite (estorch_tpu/scenarios, docs/scenarios.md): records
+    # carrying a per-variant fitness block must validate, aggregate into
+    # the scenarios section count-weighted, and surface a worst-variant
+    # callout when one variant lags the family by >2x the cross-variant
+    # MAD — while a balanced family stays quiet
+    def scen_rec(gen, means):
+        return dict(GOLDEN_RECORD, generation=gen, scenarios={
+            "n_variants": len(means), "counts": [4] * len(means),
+            "mean": means, "best": [m + 5.0 for m in means]})
+
+    lag = [-100.0, -102.0, -98.0, -101.0, -99.0, -400.0]
+    sr = [json.loads(json.dumps(scen_rec(g, lag))) for g in range(3)]
+    problems += [f"scenario golden: {p}" for p in validate_record(sr[0])]
+    broken_sc = dict(GOLDEN_RECORD, scenarios={
+        "n_variants": 4, "counts": [1, 2], "mean": [0.0], "best": "big"})
+    if not validate_record(broken_sc):
+        problems.append("validator accepted a malformed scenarios block")
+    ssc = summarize(recs + sr)
+    blk = ssc.get("scenarios")
+    if not blk or blk.get("n_variants") != 6:
+        problems.append("summary missed the scenarios section")
+    if blk and blk.get("coverage") != 1.0:
+        problems.append("scenario coverage mis-derived")
+    if blk and blk.get("mean", [None])[0] != -100.0:
+        problems.append("per-variant mean not count-weighted across "
+                        "generations")
+    if blk and blk.get("best", [None])[0] != -95.0:
+        problems.append("per-variant best not aggregated as run max")
+    if not blk or blk.get("worst_variant", {}).get("variant") != 5:
+        problems.append("worst-variant callout missed a 2x-MAD laggard")
+    if "WORST-VARIANT" not in ssc.get("diagnosis", ""):
+        problems.append("diagnosis missed the worst-variant callout")
+    if "scenarios" not in format_summary(ssc):
+        problems.append("format_summary dropped the scenarios block")
+    balanced = [json.loads(json.dumps(
+        scen_rec(g, [-100.0, -102.0, -98.0, -101.0, -99.0, -103.0])))
+        for g in range(3)]
+    sb = summarize(recs + balanced)
+    if "WORST-VARIANT" in sb.get("diagnosis", ""):
+        problems.append("worst-variant callout fired on a balanced family")
+    if summarize(recs).get("scenarios"):
+        problems.append("un-randomized run grew a scenarios section")
 
     # resilience surfacing: a chaos run's rejected-generation counters and
     # the supervisor's restart provenance must show up in the summary —

@@ -149,6 +149,31 @@ def program_noise(leaf_key: jax.Array, row: jax.Array, shape) -> jax.Array:
     return jax.random.normal(row_noise_key(leaf_key, row), shape, jnp.float32)
 
 
+# ---------------------------------------------------------------------------
+# scenario parameter streams (estorch_tpu/scenarios, docs/scenarios.md)
+# ---------------------------------------------------------------------------
+
+SCENARIO_STREAM_SALT = 0x5CE7A2  # disjoint from every training stream: the
+# engine folds the STATE key with (generation, 0|1) and the rollout key
+# with member/center/probe indices; scenario draws fold a FRESH key built
+# from the distribution's own integer seed, salted so a user reusing one
+# seed integer for both ES and the distribution still gets disjoint streams
+
+
+def scenario_variant_key(seed: int, variant) -> jax.Array:
+    """THE ``(seed, variant)`` key for scenario-parameter draws.
+
+    ``variant`` may be traced (the in-program assignment path draws it
+    from the member's rollout key) or a Python int (host-side concrete
+    draws for manifests and the sequential bench leg) — threefry is
+    counter-based, so both spellings produce identical parameters.
+    Deterministic in ``(seed, variant)`` alone: the same variant draws
+    the same physics constants in every generation, member, process, and
+    mesh shape, which is what makes a scenario REPLAYABLE."""
+    base = jax.random.fold_in(jax.random.PRNGKey(seed), SCENARIO_STREAM_SALT)
+    return jax.random.fold_in(base, variant)
+
+
 @partial(jax.jit, static_argnames=("dim",))
 def member_noise(table: NoiseTable, offsets: jax.Array, signs: jax.Array, dim: int) -> jax.Array:
     """Materialize signed noise rows for a batch of members: (n, dim).

@@ -1,0 +1,45 @@
+"""estorch_tpu.scenarios — in-program domain randomization + PBT.
+
+The scenario suite makes diversity first-class (docs/scenarios.md):
+
+* :class:`ScenarioParams` — physics constants as a typed pytree of
+  traced scalars (params.py);
+* :class:`ScenarioDistribution` / :func:`default_distribution` — seeded
+  procedural randomization, deterministic in ``(seed, variant)``
+  (distribution.py);
+* :class:`ScenarioEnv` — any parameterized native env family rolled out
+  under a per-episode drawn variant, params entering the jitted rollout
+  as traced operands (env.py);
+* per-variant fitness accounting for ``record["scenarios"]`` and
+  ``obs summarize`` (fitness.py);
+* :class:`PBTController` / :func:`tunable_optimizer` — population-based
+  self-tuning of sigma / learning rate with a deterministic,
+  bit-exactly-replayable event log (pbt.py).
+
+Wiring: ``ES(scenarios=<distribution>)`` (algo/es.py).
+"""
+
+from .distribution import (LogRange, Range, ScenarioDistribution,
+                           default_distribution)
+from .env import ScenarioEnv, variant_of_bc
+from .fitness import (merge_scenario_blocks, scenario_fitness_block,
+                      worst_variant_callout)
+from .params import OBS_NOISE, ScenarioParams, scenario_field_names
+from .pbt import PBTController, tunable_optimizer
+
+__all__ = [
+    "LogRange",
+    "OBS_NOISE",
+    "PBTController",
+    "Range",
+    "ScenarioDistribution",
+    "ScenarioEnv",
+    "ScenarioParams",
+    "default_distribution",
+    "merge_scenario_blocks",
+    "scenario_field_names",
+    "scenario_fitness_block",
+    "tunable_optimizer",
+    "variant_of_bc",
+    "worst_variant_callout",
+]

@@ -1,0 +1,175 @@
+"""ScenarioDistribution — seeded, declarative domain randomization.
+
+A distribution is a dict of per-parameter ranges (uniform or log-uniform)
+plus ``(n_variants, seed)``.  Variant ``v``'s parameters are drawn from
+the ``(seed, variant)`` stream (ops/noise.py ``scenario_variant_key``) —
+deterministic across generations, members, processes, and mesh shapes,
+so a scenario is a NAME a run's manifest can carry and a replay can
+reproduce, not an ephemeral sample.
+
+``draw(variant)`` is trace-safe (``variant`` may be a traced int32):
+the in-program assignment path draws each member's scenario inside the
+jitted rollout — N variants never become N programs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.noise import scenario_variant_key
+from .params import OBS_NOISE, ScenarioParams, scenario_field_names
+
+SPEC_SCHEMA = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class Range:
+    """Uniform (or, with ``log=True``, log-uniform) draw in [lo, hi]."""
+
+    lo: float
+    hi: float
+    log: bool = False
+
+    def __post_init__(self):
+        if not (math.isfinite(self.lo) and math.isfinite(self.hi)):
+            raise ValueError(f"range bounds must be finite, got {self}")
+        if self.lo > self.hi:
+            raise ValueError(f"need lo <= hi, got {self}")
+        if self.log and self.lo <= 0:
+            raise ValueError(
+                f"log-uniform needs lo > 0, got {self} — use a linear "
+                "Range for parameters that may reach zero")
+
+    def draw(self, key: jax.Array) -> jax.Array:
+        u = jax.random.uniform(key, (), jnp.float32)
+        if self.log:
+            llo, lhi = math.log(self.lo), math.log(self.hi)
+            return jnp.exp(llo + u * (lhi - llo))
+        return self.lo + u * (self.hi - self.lo)
+
+
+def LogRange(lo: float, hi: float) -> Range:
+    """Log-uniform range — the right prior for scale-like constants
+    (masses, gains) whose plausible values span octaves."""
+    return Range(lo, hi, log=True)
+
+
+def _as_range(name: str, r) -> Range:
+    if isinstance(r, Range):
+        return r
+    if isinstance(r, (tuple, list)) and len(r) == 2:
+        return Range(float(r[0]), float(r[1]))
+    raise TypeError(
+        f"range for {name!r} must be a Range/LogRange or a (lo, hi) "
+        f"pair, got {r!r}")
+
+
+class ScenarioDistribution:
+    """≥1 procedurally-drawn variants of one env family's constants."""
+
+    def __init__(self, ranges: dict, n_variants: int = 10, seed: int = 0):
+        if not ranges:
+            raise ValueError("a ScenarioDistribution needs at least one "
+                             "parameter range")
+        if int(n_variants) < 1:
+            raise ValueError(f"n_variants must be >= 1, got {n_variants}")
+        self.ranges: dict[str, Range] = {
+            str(k): _as_range(str(k), v) for k, v in ranges.items()}
+        self.n_variants = int(n_variants)
+        self.seed = int(seed)
+        self.names: tuple[str, ...] = tuple(sorted(self.ranges))
+
+    # ---- validation ------------------------------------------------------
+
+    def validate_for(self, env) -> None:
+        """Every randomized name must be one the env family declared (or
+        the generic ``obs_noise``) — a typo'd constant silently drawing
+        into nowhere would be a scenario that never happens."""
+        allowed = set(scenario_field_names(env))
+        unknown = [n for n in self.names if n not in allowed]
+        if unknown:
+            raise ValueError(
+                f"{type(env).__name__} has no scenario parameter(s) "
+                f"{unknown}; it declares {sorted(allowed)}")
+
+    # ---- draws -----------------------------------------------------------
+
+    def draw(self, variant) -> ScenarioParams:
+        """Variant ``variant``'s parameters — trace-safe, deterministic
+        in ``(seed, variant)`` only."""
+        base = scenario_variant_key(self.seed, variant)
+        values = {
+            name: self.ranges[name].draw(jax.random.fold_in(base, i))
+            for i, name in enumerate(self.names)
+        }
+        return ScenarioParams(values)
+
+    def draw_all(self) -> ScenarioParams:
+        """All variants stacked: each leaf gains a leading
+        ``(n_variants,)`` axis (host-side inspection / tests)."""
+        return jax.vmap(self.draw)(jnp.arange(self.n_variants))
+
+    def draw_concrete(self, variant: int) -> dict[str, float]:
+        """Host-side Python floats for one variant — the sequential
+        bench leg and manifests instantiate concrete envs from these."""
+        import numpy as np
+
+        p = self.draw(int(variant))
+        return {n: float(np.asarray(p[n])) for n in self.names}
+
+    # ---- provenance ------------------------------------------------------
+
+    def spec_json(self) -> dict:
+        """The manifest-ready spec: distribution schema + draw seed — a
+        bundle carrying this names the scenarios it was trained under,
+        exactly (the draw is deterministic in this spec alone)."""
+        return {
+            "schema": SPEC_SCHEMA,
+            "n_variants": self.n_variants,
+            "seed": self.seed,
+            "ranges": {
+                n: {"lo": r.lo, "hi": r.hi, "log": r.log}
+                for n, r in self.ranges.items()
+            },
+        }
+
+    @classmethod
+    def from_json(cls, spec: dict) -> "ScenarioDistribution":
+        if spec.get("schema") != SPEC_SCHEMA:
+            raise ValueError(
+                f"unknown scenario spec schema {spec.get('schema')!r}")
+        ranges = {
+            n: Range(float(r["lo"]), float(r["hi"]), bool(r.get("log")))
+            for n, r in spec["ranges"].items()
+        }
+        return cls(ranges, n_variants=int(spec["n_variants"]),
+                   seed=int(spec["seed"]))
+
+    def __repr__(self) -> str:
+        return (f"ScenarioDistribution(n_variants={self.n_variants}, "
+                f"seed={self.seed}, names={list(self.names)})")
+
+
+def default_distribution(env, n_variants: int = 10, spread: float = 0.3,
+                         obs_noise: float = 0.0, seed: int = 0
+                         ) -> ScenarioDistribution:
+    """±``spread`` uniform ranges around every declared constant of
+    ``env`` (scale families randomize around 1.0), plus an optional
+    additive observation-noise scale in [0, ``obs_noise``]."""
+    if not 0.0 < spread < 1.0:
+        raise ValueError(f"spread must be in (0, 1), got {spread}")
+    scenario_field_names(env)  # the families-without-SCENARIO_FIELDS error
+    defaults = env.scenario_defaults()
+    ranges: dict[str, Range] = {}
+    for name, d in defaults.items():
+        lo, hi = d * (1.0 - spread), d * (1.0 + spread)
+        ranges[name] = Range(min(lo, hi), max(lo, hi))
+    if obs_noise > 0.0:
+        ranges[OBS_NOISE] = Range(0.0, float(obs_noise))
+    dist = ScenarioDistribution(ranges, n_variants=n_variants, seed=seed)
+    dist.validate_for(env)
+    return dist

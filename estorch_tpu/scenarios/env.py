@@ -1,0 +1,124 @@
+"""ScenarioEnv — a JaxEnv whose physics are a traced per-episode draw.
+
+Wraps any parameterized native family (an env exposing ``step_p(params,
+state, action)`` + ``SCENARIO_FIELDS``) so that EVERY episode runs under
+a procedurally-drawn variant of the physics, with zero engine changes:
+
+- the variant id and its ScenarioParams ride the env STATE pytree, so
+  they enter the jitted rollout as traced operands — never a Python
+  closure (esguard R16's contract).  N variants, one XLA program.
+- the variant is derived in-program from the episode's reset key: the
+  assignment is therefore vmapped across the population axis for free,
+  antithetic pairs (which share a rollout key — common random numbers)
+  land on the SAME variant so the mirrored gradient fold compares ±ε
+  under identical physics, and per-scenario fitness folds into the
+  rank-based update through the existing ghost-pad/weighting machinery
+  untouched.
+- ``behavior`` appends the variant id as one extra BC column — the
+  channel through which per-variant fitness reaches the host
+  (``record["scenarios"]``, ``obs summarize``) without new engine
+  plumbing.
+- observation noise (the generic ``obs_noise`` parameter) is applied
+  HERE, on reset and every step, from a noise key threaded through the
+  state — env dynamics never see it.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import ScenarioDistribution
+from .params import OBS_NOISE
+
+# passthrough static facts; bc_dim is NOT here (it grows by one)
+_STATIC_ATTRS = ("obs_dim", "action_dim", "discrete", "default_horizon")
+# optional protocol attrs copied when the base env has them
+_OPTIONAL_ATTRS = ("action_bound",)
+
+
+class ScenarioEnv:
+    """JaxEnv over ``(base_state, params, variant, noise_key)`` state."""
+
+    def __init__(self, env, distribution: ScenarioDistribution):
+        if not hasattr(env, "step_p"):
+            raise ValueError(
+                f"{type(env).__name__} has no step_p(params, state, "
+                "action) form — only the parameterized native families "
+                "support scenario randomization (docs/scenarios.md)")
+        distribution.validate_for(env)
+        self.base = env
+        self.distribution = distribution
+        for a in _STATIC_ATTRS:
+            setattr(self, a, getattr(env, a))
+        for a in _OPTIONAL_ATTRS:
+            if hasattr(env, a):
+                setattr(self, a, getattr(env, a))
+        self.bc_dim = int(env.bc_dim) + 1  # +1: the variant-id column
+        self._noisy = OBS_NOISE in distribution.ranges
+        if hasattr(env, "step_metrics"):
+            self._install_gait()
+
+    @property
+    def n_variants(self) -> int:
+        return self.distribution.n_variants
+
+    # ---- JaxEnv protocol -------------------------------------------------
+
+    def reset(self, key: jax.Array):
+        kv, kb, kn = jax.random.split(key, 3)
+        variant = jax.random.randint(
+            kv, (), 0, self.distribution.n_variants, jnp.int32)
+        params = self.distribution.draw(variant)
+        state, obs = self.base.reset(kb)
+        if self._noisy:
+            kn, sub = jax.random.split(kn)
+            obs = obs + params[OBS_NOISE] * jax.random.normal(
+                sub, jnp.shape(obs))
+        return (state, params, variant, kn), obs
+
+    def step(self, sstate, action):
+        state, params, variant, kn = sstate
+        nstate, obs, reward, done = self.base.step_p(params, state, action)
+        if self._noisy:
+            kn, sub = jax.random.split(kn)
+            obs = obs + params[OBS_NOISE] * jax.random.normal(
+                sub, jnp.shape(obs))
+        return (nstate, params, variant, kn), obs, reward, done
+
+    def behavior(self, sstate, obs) -> jax.Array:
+        state, _, variant, _ = sstate
+        base_bc = jnp.atleast_1d(
+            self.base.behavior(state, obs)).astype(jnp.float32)
+        return jnp.concatenate(
+            [base_bc, variant.astype(jnp.float32)[None]])
+
+    # gait-metrics passthrough (locomotion family) is installed per
+    # INSTANCE in _install_gait so ``hasattr(env, "step_metrics")`` — the
+    # protocol probe evaluate_policy uses — stays honest for base envs
+    # without the protocol (a class-level method would always answer yes)
+
+    def _install_gait(self) -> None:
+        base = self.base
+
+        def step_metrics(sstate):
+            return base.step_metrics(sstate[0])
+
+        def episode_metrics(bc, steps, sums):
+            # the base conversion expects its OWN bc layout; strip the
+            # appended variant column before delegating
+            import numpy as np
+
+            return base.episode_metrics(np.asarray(bc)[:-1], steps, sums)
+
+        self.metric_names = base.metric_names
+        self.step_metrics = step_metrics
+        self.episode_metrics = episode_metrics
+
+
+def variant_of_bc(bc) -> "jnp.ndarray":
+    """The variant-id column of a (n, bc_dim) batch of ScenarioEnv BCs
+    (the last column, by the ``behavior`` contract above)."""
+    import numpy as np
+
+    return np.asarray(bc)[:, -1]

@@ -1,0 +1,91 @@
+"""ScenarioParams — the typed pytree of per-scenario physics constants.
+
+The native envs (envs/pendulum.py …) are frozen dataclasses of static
+Python floats closed over at trace time — which is exactly right for ONE
+scenario and exactly wrong for N: a per-variant closure means a
+per-variant XLA program (the recompile smell esguard R16 hunts).  This
+module lifts those constants into a pytree whose LEAVES are traced
+scalars, so variant count changes values, never program structure: the
+whole randomized family costs O(1) compiled programs (the compile ledger
+is the proof, ``bench.py --scenario-ab``).
+
+Structure (which names exist) is static aux data; values are leaves.
+Two ScenarioParams with the same names are the same pytree type — the
+vmap/scan machinery and the done-freeze ``tree_map`` in envs/rollout.py
+handle them like any other state leaf.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Mapping
+
+import jax
+
+# every env family accepts this name on top of its own SCENARIO_FIELDS:
+# additive observation-noise scale, applied generically by ScenarioEnv
+# (the env's dynamics never see it)
+OBS_NOISE = "obs_noise"
+
+
+@jax.tree_util.register_pytree_node_class
+class ScenarioParams(Mapping):
+    """Immutable name → traced-scalar mapping, registered as a pytree.
+
+    Keys are the static structure (sorted, hashable aux data — two
+    params objects with equal names unify under ``jnp.where``/``vmap``);
+    values are the leaves, in sorted-key order.
+    """
+
+    __slots__ = ("_values",)
+
+    def __init__(self, values: Mapping):
+        self._values = {str(k): values[k] for k in sorted(values)}
+
+    # ---- Mapping protocol ------------------------------------------------
+
+    def __getitem__(self, name: str):
+        return self._values[name]
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self._values)
+
+    def __len__(self) -> int:
+        return len(self._values)
+
+    def get(self, name: str, default=None):
+        return self._values.get(name, default)
+
+    @property
+    def names(self) -> tuple[str, ...]:
+        return tuple(self._values)
+
+    def __repr__(self) -> str:
+        inner = ", ".join(f"{k}={v!r}" for k, v in self._values.items())
+        return f"ScenarioParams({inner})"
+
+    # ---- pytree protocol -------------------------------------------------
+
+    def tree_flatten(self):
+        names = tuple(self._values)
+        return tuple(self._values[n] for n in names), names
+
+    @classmethod
+    def tree_unflatten(cls, names, leaves):
+        obj = object.__new__(cls)
+        obj._values = dict(zip(names, leaves))
+        return obj
+
+
+def scenario_field_names(env) -> tuple[str, ...]:
+    """The names a distribution may randomize for ``env``: the family's
+    declared ``SCENARIO_FIELDS`` plus the generic ``obs_noise``.  Raises
+    with a pointer when the env family was never parameterized."""
+    fields = getattr(env, "SCENARIO_FIELDS", None)
+    if fields is None:
+        raise ValueError(
+            f"{type(env).__name__} declares no SCENARIO_FIELDS — only the "
+            "parameterized native families (Pendulum, CartPole, Acrobot, "
+            "MountainCar[Continuous], the locomotion chains) support "
+            "scenario randomization (docs/scenarios.md)"
+        )
+    return tuple(fields) + (OBS_NOISE,)

@@ -297,6 +297,11 @@ def export_bundle(
         "runtime": runtime,
         "sha256": {ARRAYS_NAME: _sha256_file(arrays_path)},
     }
+    if getattr(es, "_scenarios", None) is not None:
+        # the bundle names the scenarios its policy was trained under:
+        # the distribution spec + draw seed reproduce every variant's
+        # constants exactly (estorch_tpu/scenarios, docs/scenarios.md)
+        manifest["source"]["scenarios"] = es._scenarios.spec_json()
     if extra:
         manifest["extra"] = extra
     _commit_manifest(path, manifest)

@@ -89,6 +89,16 @@ echo "== shard-ab selfcheck =="
 # model.  Virtual CPU mesh in a child process, tiny config.
 python bench.py --shard-ab --selfcheck
 
+echo "== scenario-ab selfcheck =="
+# scenario-suite gate (estorch_tpu/scenarios, docs/scenarios.md): one
+# 10-variant domain-randomized run must beat 10 sequential
+# single-scenario runs >=3x wall-clock, the compile ledger must show
+# the program count independent of variant count (traced-operand
+# contract — the recompile-per-variant smell esguard R16 hunts), and
+# per-variant fitness must surface with full variant coverage.  CPU
+# child, ~40s.
+python bench.py --scenario-ab --selfcheck
+
 echo "== loadgen smoke =="
 # the load generator validated against an in-process stdlib echo server
 # (closed+open loop, latency percentiles, response indexing).  Run as a

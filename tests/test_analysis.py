@@ -1617,6 +1617,124 @@ class TestR15:
 
 
 # ---------------------------------------------------------------------
+# R16 scenario-constant-closure
+# ---------------------------------------------------------------------
+
+class TestR16:
+    def test_jit_closure_over_loop_constant_flagged(self):
+        found = findings("""
+            import jax
+
+            def build(scenario_gravities, dyn):
+                steps = []
+                for variant, g in enumerate(scenario_gravities):
+                    steps.append(jax.jit(lambda s, a: dyn(s, a, g)))
+                return steps
+        """, "R16")
+        assert len(found) == 1
+        assert "'g'" in found[0].message
+
+    def test_rollout_builder_comprehension_flagged_once(self):
+        """jit(make_rollout(.., v)) is ONE construction site, not two —
+        and comprehensions count as scenario loops."""
+        found = findings("""
+            import jax
+            from estorch_tpu.envs.rollout import make_rollout
+
+            def rollouts(scenarios, apply_fn, envs):
+                return [jax.jit(make_rollout(envs[v], apply_fn, 100))
+                        for v in scenarios]
+        """, "R16")
+        assert len(found) == 1
+
+    def test_derived_per_scenario_name_flagged(self):
+        """`gc = scenario.g` keeps the value per-scenario: the closure
+        smell survives one straight-line rename."""
+        found = findings("""
+            import jax
+
+            def per_scenario(scenario_list, step):
+                fns = {}
+                for scenario in scenario_list:
+                    gc = scenario.g
+                    fns[scenario.name] = jax.jit(
+                        lambda s, a: step(s, a, gc))
+                return fns
+        """, "R16")
+        assert len(found) == 1
+        assert "'gc'" in found[0].message
+
+    def test_fires_even_in_builder_scope(self):
+        """Unlike R14, load-time builder scopes are NOT exempt: one
+        program per scenario at load time is still O(N) programs."""
+        found = findings("""
+            import jax
+
+            def build_engine(scenario_params, dyn):
+                progs = []
+                for sp in scenario_params:
+                    progs.append(jax.jit(lambda s, a: dyn(s, a, sp)))
+                return progs
+        """, "R16")
+        assert len(found) == 1
+
+    def test_traced_operand_call_clean(self):
+        """THE fix: one jitted program, the variant's params an
+        argument — per-variant values as traced operands."""
+        found = findings("""
+            import jax
+
+            def evaluate(jitted_rollout, dist, params, keys):
+                outs = []
+                for variant in range(10):
+                    outs.append(jitted_rollout(params, dist.draw(variant),
+                                               keys))
+                return outs
+        """, "R16")
+        assert found == []
+
+    def test_non_scenario_loop_clean(self):
+        """A bucket-ladder build is R14's jurisdiction (and exempt
+        there in builder scopes); R16 keys on scenario-ish names."""
+        found = findings("""
+            import jax
+
+            def build_ladder(buckets, fwd):
+                fns = {}
+                for b in buckets:
+                    fns[b] = jax.jit(fwd)
+                return fns
+        """, "R16")
+        assert found == []
+
+    def test_variant_independent_jit_in_scenario_loop_clean(self):
+        found = findings("""
+            import jax
+
+            def shared(scenarios, step):
+                f = None
+                for scenario in scenarios:
+                    f = jax.jit(step)
+                return f
+        """, "R16")
+        assert found == []
+
+    def test_scenarios_package_self_clean(self):
+        """Self-application: the scenario suite itself must honor its
+        own traced-operand contract."""
+        import estorch_tpu.scenarios.distribution as dist
+        import estorch_tpu.scenarios.env as senv
+        import estorch_tpu.scenarios.pbt as pbt
+
+        for mod in (dist, senv, pbt):
+            with open(mod.__file__) as f:
+                src = f.read()
+            hits = [x for x in analyze_source(mod.__file__, src)
+                    if x.rule == "R16"]
+            assert not hits, [h.message for h in hits]
+
+
+# ---------------------------------------------------------------------
 # engine / CLI / config / baseline mechanics
 # ---------------------------------------------------------------------
 
@@ -1641,7 +1759,8 @@ class TestEngine:
     def test_every_rule_registered(self):
         ids = [r.id for r in all_rules()]
         assert ids == ["R01", "R02", "R03", "R04", "R05", "R06", "R07",
-                       "R08", "R09", "R10", "R11", "R12", "R13", "R14", "R15"]
+                       "R08", "R09", "R10", "R11", "R12", "R13", "R14",
+                       "R15", "R16"]
 
     def test_syntax_error_becomes_finding(self):
         found = analyze_source("bad.py", "def broken(:\n")
@@ -1775,7 +1894,7 @@ class TestConfig:
         assert cfg.baseline == "esguard_baseline.json"
         assert cfg.rule_ids([r.id for r in all_rules()]) == [
             "R01", "R02", "R03", "R04", "R05", "R06", "R07", "R08", "R09",
-            "R10", "R11", "R12", "R13", "R14", "R15"]
+            "R10", "R11", "R12", "R13", "R14", "R15", "R16"]
 
 
 class TestCLI:

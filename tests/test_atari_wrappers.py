@@ -130,6 +130,50 @@ class TestSpecAdjustment:
         assert out["n_actions"] == 3  # untouched fields preserved
 
 
+class TestRealPoolIntegration:
+    """Smoke-scale tier-1 coverage over a REAL pool (the in-tree pong84
+    native env, NumPy fallback inside): the FakePool tests above pin the
+    transform semantics, these pin that wrapping actual pool machinery
+    constructs and steps — the path the @slow end-to-end test exercises
+    at training scale.  (Found real: wrapping NativeEnvPool crashed on
+    `is_native` — a property there, a method on GymVecPool.)"""
+
+    def _wrapped(self, **kw):
+        from estorch_tpu.envs.gym_vec_pool import make_pool
+
+        return AtariPreprocessPool(make_pool("pong84", 2, seed=0),
+                                   seed=0, **kw)
+
+    def test_construct_reset_and_step_shapes(self):
+        w = self._wrapped(frame_stack=4, action_repeat=2)
+        assert w.obs_shape == (84, 84, 4)
+        obs = w.reset()
+        assert obs.shape == (2, 84 * 84 * 4) and obs.dtype == np.float32
+        for _ in range(3):
+            obs, rew, done = w.step(np.zeros((2, 1), np.float32))
+        assert obs.shape == (2, 84 * 84 * 4)
+        assert np.isfinite(obs).all() and np.isfinite(rew).all()
+        assert done.shape == (2,)
+        w.close()
+
+    def test_is_native_accepts_property_and_method_pools(self):
+        w = self._wrapped(frame_stack=2)
+        assert isinstance(w.is_native(), bool)  # crashed before the fix
+        # the FakePool (method spelling) keeps working too
+        assert AtariPreprocessPool(FakePool(), frame_stack=2).is_native() \
+            is True
+        w.close()
+
+    def test_sticky_and_maxpool_over_real_pool(self):
+        w = self._wrapped(frame_stack=2, action_repeat=2,
+                          sticky_prob=0.25, max_pool2=True)
+        w.reset()
+        obs, rew, done = w.step(np.ones((2, 1), np.float32))
+        assert obs.shape == (2, 84 * 84 * 2)
+        assert np.isfinite(rew).all()
+        w.close()
+
+
 class TestPooledIntegration:
     @pytest.mark.slow
     def test_pong84_naturecnn_designed_input_end_to_end(self):

@@ -192,6 +192,53 @@ class TestMeshCheck:
         assert rep["mesh"]["status"] == "ok"
 
 
+class TestScenariosCheck:
+    """The scenario-suite probe (check_scenarios): deterministic
+    distribution draws + one tiny traced-operand rollout across 3
+    variants (docs/scenarios.md), findings-not-tracebacks on failure."""
+
+    def test_classifier_taxonomy(self):
+        c = doctor.classify_scenario_probe
+        ok = "SCEN_START\nSCEN_DRAW_OK\nSCEN_ROLLOUT_OK\n"
+        assert c(ok, False, 0) == ("ok", None)
+        assert c("SCEN_START\n", True, None) == \
+            ("failed", "draw-determinism")
+        assert c("SCEN_START\nSCEN_DRAW_OK\n", False, 1) == \
+            ("failed", "traced-rollout")
+        # all markers but a dirty exit: the last stage takes the blame
+        assert c(ok, False, 1) == ("failed", "traced-rollout")
+
+    def test_healthy_scenario_probe(self):
+        out = doctor.check_scenarios(timeout_s=120.0)
+        assert out["status"] == "ok", out
+        assert "failed_stage" not in out
+
+    def test_failing_stage_named_not_raised(self, monkeypatch):
+        monkeypatch.setattr(doctor, "_SCENARIO_PROBE", (
+            'print("SCEN_START", flush=True)\n'
+            'print("SCEN_DRAW_OK", flush=True)\n'
+            'raise RuntimeError("variant rollout exploded")\n'))
+        out = doctor.check_scenarios(timeout_s=30.0)
+        assert out["status"] == "failed"
+        assert out["failed_stage"] == "traced-rollout"
+        assert "variant rollout exploded" in out["stderr_tail"]
+
+    def test_report_gains_scenarios_row(self, monkeypatch):
+        monkeypatch.setattr(doctor, "check_scenarios",
+                            lambda **kw: {"status": "ok", "elapsed_s": 0.1,
+                                          "timeout_s": 90.0})
+        monkeypatch.setattr(doctor, "check_mesh",
+                            lambda **kw: {"status": "ok", "elapsed_s": 0.1,
+                                          "timeout_s": 90.0})
+        monkeypatch.setattr(doctor, "check_device",
+                            lambda timeout_s=20.0, platform=None: {
+                                "status": "ok", "platform": "cpu",
+                                "n_devices": 8, "elapsed_s": 0.1,
+                                "timeout_s": timeout_s})
+        rep = doctor.report(timeout_s=5.0)
+        assert rep["scenarios"]["status"] == "ok"
+
+
 class TestOptionalDeps:
     def test_missing_parent_package_never_crashes(self, monkeypatch):
         """find_spec('pkg.sub') raises ModuleNotFoundError when pkg itself

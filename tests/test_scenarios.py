@@ -1,0 +1,448 @@
+"""Scenario suite (estorch_tpu/scenarios, docs/scenarios.md): params
+pytree + distribution determinism, step_p default-path bit-equality for
+every parameterized family, ScenarioEnv semantics, the device/sharded
+E2E acceptance (≥10 variants, one XLA program, per-variant fitness
+surfaced), PBT exploit/explore with bit-exact event-log replay, and the
+per-variant fitness helpers."""
+
+import dataclasses
+import json
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from estorch_tpu import ES, JaxAgent, MLPPolicy, NS_ES
+from estorch_tpu.envs import (Acrobot, CartPole, Hopper2D, MountainCar,
+                              MountainCarContinuous, Pendulum)
+from estorch_tpu.scenarios import (LogRange, PBTController, Range,
+                                   ScenarioDistribution, ScenarioEnv,
+                                   ScenarioParams, default_distribution,
+                                   merge_scenario_blocks,
+                                   scenario_fitness_block,
+                                   tunable_optimizer, variant_of_bc,
+                                   worst_variant_callout)
+
+ALL_FAMILIES = [Pendulum(), CartPole(), Acrobot(), MountainCar(),
+                MountainCarContinuous(), Hopper2D()]
+
+
+def small_es(dist=None, optimizer=None, **over):
+    kw = dict(
+        population_size=16, sigma=0.05, seed=0,
+        policy_kwargs={"action_dim": 1, "hidden": (8,),
+                       "discrete": False, "action_scale": 2.0},
+        table_size=1 << 14, telemetry=True, scenarios=dist,
+    )
+    if optimizer is None:
+        optimizer = optax.adam
+        kw["optimizer_kwargs"] = {"learning_rate": 0.01}
+    kw.update(over)
+    return ES(MLPPolicy, JaxAgent(Pendulum(), horizon=20), optimizer, **kw)
+
+
+# ---------------------------------------------------------------------
+# params + distribution
+# ---------------------------------------------------------------------
+
+class TestParamsAndDistribution:
+    def test_params_pytree_round_trip(self):
+        p = ScenarioParams({"g": jnp.float32(9.8), "m": jnp.float32(1.0)})
+        leaves, treedef = jax.tree_util.tree_flatten(p)
+        assert len(leaves) == 2
+        q = jax.tree_util.tree_unflatten(treedef, leaves)
+        assert q.names == ("g", "m") and float(q["g"]) == pytest.approx(9.8)
+        assert "g" in q and q.get("absent") is None
+
+    def test_range_validation(self):
+        with pytest.raises(ValueError, match="lo <= hi"):
+            Range(2.0, 1.0)
+        with pytest.raises(ValueError, match="lo > 0"):
+            LogRange(0.0, 1.0)
+        with pytest.raises(ValueError, match="finite"):
+            Range(0.0, float("inf"))
+        with pytest.raises(ValueError, match="n_variants"):
+            ScenarioDistribution({"g": (1.0, 2.0)}, n_variants=0)
+        with pytest.raises(ValueError, match="at least one"):
+            ScenarioDistribution({})
+
+    def test_draws_deterministic_and_in_bounds(self):
+        dist = ScenarioDistribution(
+            {"g": (7.0, 13.0), "m": LogRange(0.5, 2.0)},
+            n_variants=16, seed=3)
+        a = dist.draw_concrete(5)
+        assert a == dist.draw_concrete(5)  # same (seed, variant) stream
+        assert a != dist.draw_concrete(6)
+        for v in range(16):
+            d = dist.draw_concrete(v)
+            assert 7.0 <= d["g"] <= 13.0
+            assert 0.5 <= d["m"] <= 2.0
+        # seed changes every draw
+        assert (ScenarioDistribution({"g": (7.0, 13.0)}, 4, seed=1)
+                .draw_concrete(0)
+                != ScenarioDistribution({"g": (7.0, 13.0)}, 4, seed=2)
+                .draw_concrete(0))
+
+    def test_traced_draw_matches_concrete(self):
+        """The in-program (traced-variant) draw and the host concrete
+        draw are the same stream — threefry is counter-based."""
+        dist = ScenarioDistribution({"g": (7.0, 13.0)}, 8, seed=0)
+        traced = jax.jit(lambda v: dist.draw(v)["g"])(jnp.int32(3))
+        assert float(traced) == pytest.approx(dist.draw_concrete(3)["g"])
+
+    def test_draw_all_stacks(self):
+        dist = default_distribution(Pendulum(), n_variants=5, spread=0.2)
+        stacked = dist.draw_all()
+        for name in dist.names:
+            assert np.asarray(stacked[name]).shape == (5,)
+
+    def test_spec_json_round_trip(self):
+        dist = ScenarioDistribution(
+            {"g": (7.0, 13.0), "m": LogRange(0.5, 2.0)}, 12, seed=9)
+        spec = json.loads(json.dumps(dist.spec_json()))
+        clone = ScenarioDistribution.from_json(spec)
+        assert clone.draw_concrete(7) == dist.draw_concrete(7)
+        assert clone.n_variants == 12 and clone.seed == 9
+
+    def test_validate_for_rejects_unknown_fields(self):
+        dist = ScenarioDistribution({"warp_factor": (1.0, 9.0)}, 4)
+        with pytest.raises(ValueError, match="warp_factor"):
+            dist.validate_for(Pendulum())
+
+    def test_unparameterized_env_named_in_error(self):
+        class Boring:
+            pass
+
+        with pytest.raises(ValueError, match="SCENARIO_FIELDS"):
+            default_distribution(Boring())
+
+
+# ---------------------------------------------------------------------
+# parameterized families: step_p contract
+# ---------------------------------------------------------------------
+
+class TestStepP:
+    @pytest.mark.parametrize("env", ALL_FAMILIES,
+                             ids=lambda e: type(e).__name__)
+    def test_default_path_bit_equal(self, env):
+        """step() delegates to step_p(None, ...) with Python-float
+        constants — the un-randomized graph/values are IDENTICAL."""
+        key = jax.random.PRNGKey(0)
+        state, obs = env.reset(key)
+        action = (jnp.int32(1) if env.discrete
+                  else jnp.full((env.action_dim,), 0.3))
+        a = env.step(state, action)
+        b = env.step_p(None, state, action)
+        for x, y in zip(jax.tree_util.tree_leaves(a),
+                        jax.tree_util.tree_leaves(b)):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+    @pytest.mark.parametrize("env", ALL_FAMILIES,
+                             ids=lambda e: type(e).__name__)
+    def test_traced_defaults_match_static(self, env):
+        """Feeding the family's own defaults as TRACED params reproduces
+        the static dynamics (allclose: traced operands may reassociate
+        constant folds)."""
+        params = ScenarioParams({k: jnp.float32(v) for k, v in
+                                 env.scenario_defaults().items()})
+        key = jax.random.PRNGKey(1)
+        state, obs = env.reset(key)
+        action = (jnp.int32(0) if env.discrete
+                  else jnp.full((env.action_dim,), -0.5))
+        a = env.step(state, action)
+        b = jax.jit(env.step_p)(params, state, action)
+        for x, y in zip(jax.tree_util.tree_leaves(a),
+                        jax.tree_util.tree_leaves(b)):
+            np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_perturbed_params_change_dynamics(self):
+        env = Pendulum()
+        params = ScenarioParams({"g": jnp.float32(2.0)})
+        state = jnp.asarray([1.0, 0.5])
+        a = env.step(state, jnp.asarray([0.0]))[0]
+        b = env.step_p(params, state, jnp.asarray([0.0]))[0]
+        assert not np.allclose(np.asarray(a), np.asarray(b))
+
+    def test_locomotion_scales_change_dynamics(self):
+        env = Hopper2D()
+        state, _ = env.reset(jax.random.PRNGKey(0))
+        act = jnp.full((env.action_dim,), 0.5)
+        base = env.step(state, act)[0]
+        scaled = env.step_p(
+            ScenarioParams({"gravity_scale": jnp.float32(0.5)}),
+            state, act)[0]
+        assert not np.allclose(np.asarray(base["vel"]),
+                               np.asarray(scaled["vel"]))
+
+
+# ---------------------------------------------------------------------
+# ScenarioEnv
+# ---------------------------------------------------------------------
+
+class TestScenarioEnv:
+    def test_protocol_and_variant_column(self):
+        dist = default_distribution(Pendulum(), n_variants=7, spread=0.2)
+        env = ScenarioEnv(Pendulum(), dist)
+        assert env.obs_dim == 3 and env.bc_dim == 3  # base 2 + variant
+        assert env.action_bound == 2.0
+        state, obs = env.reset(jax.random.PRNGKey(4))
+        assert obs.shape == (3,)
+        state, obs, reward, done = env.step(state, jnp.asarray([0.1]))
+        bc = np.asarray(env.behavior(state, obs))
+        assert bc.shape == (3,)
+        assert 0 <= int(round(bc[-1])) < 7
+
+    def test_variant_determines_params(self):
+        """Same reset key → same variant → same drawn constants; the
+        draw is keyed on (seed, variant), not on the episode."""
+        dist = default_distribution(Pendulum(), n_variants=5, spread=0.3)
+        env = ScenarioEnv(Pendulum(), dist)
+        (_, p1, v1, _), _ = env.reset(jax.random.PRNGKey(8))
+        (_, p2, v2, _), _ = env.reset(jax.random.PRNGKey(8))
+        assert int(v1) == int(v2)
+        assert float(p1["g"]) == float(p2["g"])
+        assert float(p1["g"]) == pytest.approx(
+            dist.draw_concrete(int(v1))["g"])
+
+    def test_obs_noise_applied_when_configured(self):
+        base = Pendulum()
+        quiet = ScenarioEnv(base, ScenarioDistribution(
+            {"g": (10.0, 10.0)}, 3, seed=0))
+        noisy = ScenarioEnv(base, ScenarioDistribution(
+            {"g": (10.0, 10.0), "obs_noise": (0.5, 0.5)}, 3, seed=0))
+        key = jax.random.PRNGKey(2)
+        (_, _, _, _), obs_q = quiet.reset(key)
+        (_, _, _, _), obs_n = noisy.reset(key)
+        assert not np.allclose(np.asarray(obs_q), np.asarray(obs_n))
+
+    def test_rejects_unparameterized_env(self):
+        class NoStepP:
+            SCENARIO_FIELDS = ("x",)
+            bc_dim = 1
+
+        with pytest.raises(ValueError, match="step_p"):
+            ScenarioEnv(NoStepP(), ScenarioDistribution({"x": (0, 1)}, 2))
+
+    def test_gait_protocol_only_when_base_has_it(self):
+        pend = ScenarioEnv(Pendulum(),
+                           default_distribution(Pendulum(), 3))
+        assert not hasattr(pend, "step_metrics")
+        hop = ScenarioEnv(Hopper2D(),
+                          default_distribution(Hopper2D(), 3))
+        assert hasattr(hop, "step_metrics")
+        state, _ = hop.reset(jax.random.PRNGKey(0))
+        m = hop.step_metrics(state)
+        assert np.asarray(m).shape == (len(hop.metric_names),)
+
+
+# ---------------------------------------------------------------------
+# fitness helpers
+# ---------------------------------------------------------------------
+
+class TestFitnessHelpers:
+    def test_block_counts_and_nan_handling(self):
+        fitness = np.asarray([1.0, 2.0, np.nan, 10.0])
+        variants = np.asarray([0.0, 0.0, 1.0, 2.0])
+        b = scenario_fitness_block(fitness, variants, 4)
+        assert b["counts"] == [2, 1, 1, 0]
+        assert b["mean"][0] == pytest.approx(1.5)
+        assert math.isnan(b["mean"][1])  # failed rollout excluded
+        assert b["best"][2] == 10.0 and math.isnan(b["mean"][3])
+
+    def test_merge_weights_by_count(self):
+        b1 = scenario_fitness_block([1.0, 3.0], [0, 0], 2)
+        b2 = scenario_fitness_block([5.0, 7.0, 9.0], [0, 0, 1], 2)
+        merged = merge_scenario_blocks([b1, b2])
+        assert merged["counts"] == [4, 1]
+        assert merged["mean"][0] == pytest.approx((1 + 3 + 5 + 7) / 4)
+        assert merged["best"][0] == 7.0
+
+    def test_worst_variant_callout_fires_and_stays_quiet(self):
+        lag = {"n_variants": 6, "counts": [4] * 6,
+               "mean": [-100.0, -102.0, -98.0, -101.0, -99.0, -400.0],
+               "best": [0.0] * 6}
+        hit = worst_variant_callout(lag)
+        assert hit and hit["variant"] == 5 and hit["lag_in_mads"] > 2
+        balanced = dict(lag, mean=[-100.0, -102.0, -98.0, -101.0,
+                                   -99.0, -103.0])
+        assert worst_variant_callout(balanced) is None
+
+
+# ---------------------------------------------------------------------
+# wiring refusals
+# ---------------------------------------------------------------------
+
+class TestWiringRefusals:
+    def test_scenarios_must_be_a_distribution(self):
+        with pytest.raises(TypeError, match="ScenarioDistribution"):
+            small_es(dist={"g": (7.0, 13.0)})
+
+    def test_host_backend_refused(self):
+        class FakeHostAgent:
+            def rollout(self, policy):
+                return 0.0
+
+        with pytest.raises(ValueError, match="device-path"):
+            ES(object, FakeHostAgent(), optax.adam,
+               scenarios=default_distribution(Pendulum(), 4))
+
+    def test_novelty_family_refused(self):
+        with pytest.raises(ValueError, match="novelty"):
+            NS_ES(MLPPolicy, JaxAgent(Pendulum(), horizon=10), optax.adam,
+                  scenarios=default_distribution(Pendulum(), 4))
+
+
+# ---------------------------------------------------------------------
+# E2E acceptance: device path
+# ---------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def trained_10v():
+    dist = default_distribution(Pendulum(), n_variants=10, spread=0.3,
+                                seed=1)
+    es = small_es(dist=dist, population_size=64)
+    es.train(3, verbose=False)
+    return es
+
+
+class TestEndToEnd:
+    def test_trains_across_ten_variants_with_fitness_block(self,
+                                                          trained_10v):
+        es = trained_10v
+        seen = set()
+        for r in es.history:
+            blk = r["scenarios"]
+            assert blk["n_variants"] == 10
+            assert sum(blk["counts"]) == 64
+            seen |= {v for v, c in enumerate(blk["counts"]) if c}
+        assert seen == set(range(10))  # every variant trained on
+
+    def test_program_count_independent_of_variant_count(self,
+                                                        trained_10v):
+        def compiles(es):
+            return sum(len(r.get("compile_events", []))
+                       for r in es.history)
+
+        es3 = small_es(dist=default_distribution(
+            Pendulum(), n_variants=3, spread=0.3, seed=1))
+        es3.train(1, verbose=False)
+        assert compiles(trained_10v) == compiles(es3) == 1
+
+    def test_mirrored_pairs_share_variants(self, trained_10v):
+        """Antithetic twins share a rollout key (common random numbers)
+        — so ±ε are compared under IDENTICAL physics."""
+        es = trained_10v
+        es.compile_time_s = es.compile_time_s or 0.0
+        es.engine.compile_split(es.state)
+        ev = es.engine.evaluate(es.state)
+        v = np.rint(variant_of_bc(ev.bc)).astype(int)
+        np.testing.assert_array_equal(v[0::2], v[1::2])
+
+    def test_manifest_and_bundle_name_the_scenarios(self, trained_10v,
+                                                    tmp_path):
+        es = trained_10v
+        cfg = es.run_manifest()["config"]
+        assert cfg["scenarios"]["n_variants"] == 10
+        assert cfg["scenarios"]["seed"] == 1
+        path = es.export_bundle(str(tmp_path / "bundle"))
+        with open(os.path.join(path, "MANIFEST.json")) as f:
+            manifest = json.load(f)
+        spec = manifest["source"]["scenarios"]
+        clone = ScenarioDistribution.from_json(spec)
+        assert clone.draw_concrete(4) == es._scenarios.draw_concrete(4)
+
+    def test_obs_summarize_scenarios_section(self, trained_10v,
+                                             tmp_path):
+        from estorch_tpu.obs.summarize import (format_summary,
+                                               load_records, summarize)
+
+        run = tmp_path / "run.jsonl"
+        with open(run, "w") as f:
+            for r in trained_10v.history:
+                f.write(json.dumps(r, default=float) + "\n")
+        s = summarize(load_records(str(run)))
+        blk = s.get("scenarios")
+        assert blk and blk["n_variants"] == 10
+        assert blk["coverage"] == 1.0
+        assert "scenarios" in s["diagnosis"]
+        assert "scenarios" in format_summary(s)
+
+    def test_overlap_scheduler_carries_the_block(self):
+        """train_async(strategy="overlap") records get the same
+        per-variant block as the sync loop (one shared attach)."""
+        dist = default_distribution(Pendulum(), n_variants=5,
+                                    spread=0.3, seed=1)
+        es = small_es(dist=dist)
+        es.train_async(2, strategy="overlap", verbose=False)
+        blk = es.history[-1]["scenarios"]
+        assert blk["n_variants"] == 5 and sum(blk["counts"]) == 16
+
+    def test_sharded_engine_composes(self):
+        dist = default_distribution(Pendulum(), n_variants=10,
+                                    spread=0.3, seed=1)
+        es = small_es(dist=dist, shard_params=True)
+        es.train(1, verbose=False)
+        blk = es.history[0]["scenarios"]
+        assert blk["n_variants"] == 10 and sum(blk["counts"]) == 16
+
+
+# ---------------------------------------------------------------------
+# PBT
+# ---------------------------------------------------------------------
+
+class TestPBT:
+    def _build(self):
+        dist = default_distribution(Pendulum(), n_variants=6,
+                                    spread=0.3, seed=1)
+        return small_es(dist=dist,
+                        optimizer=tunable_optimizer(learning_rate=0.01))
+
+    def test_validation(self):
+        es = small_es()
+        with pytest.raises(ValueError, match="n_centers"):
+            PBTController(es, n_centers=1)
+        with pytest.raises(ValueError, match="explore_every"):
+            PBTController(es, explore_every=0)
+
+    def test_run_logs_and_replays_bit_exactly(self):
+        es = self._build()
+        ctl = PBTController(es, n_centers=3, explore_every=2, seed=7)
+        assert ctl.lr_tunable
+        log = ctl.run(5, verbose=False)
+        live = np.asarray(es.state.params_flat)
+        kinds = [e["type"] for e in log["events"]]
+        assert kinds.count("init") == 3
+        assert "exploit" in kinds
+        for ev in log["events"]:
+            if ev["type"] == "exploit":
+                assert ev["lr"] is not None and ev["sigma"] > 0
+        assert len(es.meta_states) == 3
+        # the deterministic log re-drives the schedule to the SAME bits
+        es2 = self._build()
+        PBTController(es2, n_centers=3, explore_every=2, seed=7).run(
+            5, verbose=False, replay=log)
+        np.testing.assert_array_equal(live,
+                                      np.asarray(es2.state.params_flat))
+
+    def test_replay_rejects_foreign_log(self):
+        es = self._build()
+        ctl = PBTController(es, n_centers=3, explore_every=2, seed=7)
+        log = ctl.run(3, verbose=False)
+        es2 = self._build()
+        bad = PBTController(es2, n_centers=3, explore_every=3, seed=7)
+        with pytest.raises(ValueError, match="different PBT"):
+            bad.run(3, verbose=False, replay=log)
+
+    def test_exploit_actually_copies_top_params(self):
+        es = self._build()
+        ctl = PBTController(es, n_centers=3, explore_every=1, seed=0)
+        log = ctl.run(2, verbose=False)
+        exploits = [e for e in log["events"] if e["type"] == "exploit"]
+        assert exploits, "explore_every=1 must exploit after round 1"
+        ev = exploits[0]
+        assert ev["score_src"] >= ev["score_dst"]
