@@ -762,20 +762,29 @@ def _tiny_host_es(cfg, worker_mode="process"):
               worker_mode=worker_mode)
 
 
-def _async_accounting(es):
+def _async_accounting(es, baseline=None):
     """The zero-silent-drop invariant, read once from the event log +
     counters (docs/async.md): every dispatched member is consumed (fold
     or fresh), discarded with evidence, or lost to a counted worker
-    death.  Both async gates (--chaos mixed leg, --async-ab) report
-    THIS block, so they can never check different invariants."""
+    death.  All the async gates (--chaos mixed leg, --async-ab,
+    --elastic-ab) report THIS block, so they can never check different
+    invariants.  ``baseline`` is a counters snapshot taken before the
+    timed run (an untimed warm-up shares ``es.obs.counters`` but gets
+    its own event log — without the delta, warm-up folds could satisfy
+    a timed-window gate)."""
     log = es.async_event_log
     counters = es.obs.counters.snapshot()
+    base = baseline or {}
+
+    def since(name):
+        return int(counters.get(name, 0)) - int(base.get(name, 0))
+
     consumed = sum(len(u["consumed"]) for u in log.updates)
     dispatched = len(log.dispatches) * es.population_size
     return {
-        "results_folded": int(counters.get("results_folded", 0)),
-        "stale_discarded": int(counters.get("stale_discarded", 0)),
-        "results_lost": int(counters.get("results_lost", 0)),
+        "results_folded": since("results_folded"),
+        "stale_discarded": since("stale_discarded"),
+        "results_lost": since("results_lost"),
         "consumed": consumed,
         "dispatched": dispatched,
         "accounting_ok": (dispatched == consumed + len(log.discarded)
@@ -1087,6 +1096,307 @@ def stage_async_ab(selfcheck=False):
         "results_lost": sum(r.get("results_lost", 0) for r in async_rows),
         "accounting_ok": accounting_ok,
         "async_step_vs_max_phase": step_max,
+        "pass": ok,
+    }), flush=True)
+    return 0 if ok else 1
+
+
+def _elastic_spec(cfg):
+    """The shared ES spec of every --elastic-ab process (coordinator,
+    subprocess hosts, sync SPMD workers): same seed => same table =>
+    same noise coordinates everywhere (parallel/elastic.py
+    es_from_spec)."""
+    return {
+        "env": "CartPole",
+        "population_size": int(cfg.get("population", 16)),
+        "horizon": int(cfg.get("horizon", 64)),
+        "seed": 7,
+        "sigma": 0.1,
+        "lr": 1e-2,
+        "table_size": 1 << 18,
+        "telemetry": True,
+    }
+
+
+def _elastic_plan_json(cfg):
+    """The declared straggle_host plan BOTH legs run under — host 1 is
+    slow at EVERY generation/dispatch (seeded jitter on top), so the
+    sync leg's psum barrier pays the stall fleet-wide while the elastic
+    leg only loses host 1's contribution rate.  Built identically in
+    every child (same seed => same events => same jitter)."""
+    from estorch_tpu.resilience.chaos import ChaosPlan
+
+    plan = ChaosPlan.generate(
+        seed=0,
+        n_generations=int(cfg["gens"]) * 3 + 16,
+        straggle_host_every=1,
+        straggle_host=1,
+        straggle_host_sleep_s=float(cfg.get("sleep_s", 0.3)),
+        straggle_host_jitter_s=float(cfg.get("jitter_s", 0.1)),
+    )
+    return plan.to_json()
+
+
+def elastic_sync_worker(cfg):
+    """Child body for --stage-elastic-worker: ONE process of the
+    synchronous 2-process SPMD multihost leg (jax.distributed over
+    loopback + Gloo CPU collectives, tests/test_multiprocess.py
+    layering).  Every process steps the same fused program under the
+    declared straggle_host plan via multihost.train_sync — the psum
+    barrier makes host 1's stall everyone's stall, which is exactly
+    what the elastic leg is measured against.  The leader prints the
+    timed row."""
+    from estorch_tpu.resilience.chaos import CHAOS_ENV
+    from estorch_tpu.utils.backend import force_cpu_backend
+
+    force_cpu_backend(int(cfg.get("cpu_devices", 2)))
+    os.environ[CHAOS_ENV] = _elastic_plan_json(cfg)
+    import estorch_tpu.parallel.multihost as mh
+    from estorch_tpu.parallel.elastic import es_from_spec
+
+    assert mh.initialize(f"127.0.0.1:{cfg['port']}", num_processes=2,
+                         process_id=int(cfg["pid"]), timeout_s=90,
+                         cpu_collectives=True)
+    es = es_from_spec(_elastic_spec(cfg),
+                      mesh=mh.global_population_mesh())
+    gens = int(cfg["gens"])
+    es.train(1, verbose=False)  # warm-up: compile outside the window
+    t0 = time.perf_counter()
+    mh.train_sync(es, gens, verbose=False)
+    dt = time.perf_counter() - t0
+    return {
+        "mode": "sync",
+        "leader": mh.process_info()["is_leader"],
+        "gps": round(gens / dt, 3),
+        "wall_s": round(dt, 3),
+        "generations": int(es.generation),
+    }
+
+
+def measure_elastic_one(cfg):
+    """Child body for --stage-elastic-one (elastic leg): a live elastic
+    fleet on this machine — the coordinator (device-backend ES + the
+    host-granular fold scheduler, docs/multihost.md) plus two REAL
+    subprocess hosts joined through the ``python -m
+    estorch_tpu.parallel.elastic`` CLI, all under the same declared
+    straggle_host plan the sync leg pays.  Prints the timed row with
+    the dispatched == consumed + discarded + lost accounting."""
+    import signal
+    import subprocess as sp
+
+    from estorch_tpu.resilience.chaos import CHAOS_ENV
+
+    plan_json = _elastic_plan_json(cfg)
+    os.environ[CHAOS_ENV] = plan_json
+    spec = {**_elastic_spec(cfg), "cpu_devices": 2}
+    from estorch_tpu.parallel.elastic import ElasticCoordinator, es_from_spec
+
+    es = es_from_spec(spec)
+    # grace must satisfy 4 * join_grace_s < the driver's 600s child
+    # timeout: four consecutive grace-expired dispatches are what the
+    # scheduler's dry-out diagnosis needs, and a SIGKILLed child loses
+    # the host-log evidence this function exists to print
+    coord = ElasticCoordinator(join_grace_s=120.0)
+    host_env = {**os.environ, "JAX_PLATFORMS": "cpu",
+                CHAOS_ENV: plan_json}
+    # host output goes to FILES, never unread pipes: a chatty host
+    # blocking on a full 64KB pipe mid-run would look exactly like the
+    # dead-slow host this leg exists to measure
+    logdir = tempfile.mkdtemp(prefix="elastic-hosts-")
+    host_logs = [open(os.path.join(logdir, f"host{i}.log"), "w+")
+                 for i in range(2)]
+    hosts = [
+        sp.Popen([sys.executable, "-m", "estorch_tpu.parallel.elastic",
+                  "--join", f"{coord.address[0]}:{coord.address[1]}",
+                  "--spec", json.dumps(spec), "--host", str(i)],
+                 env=host_env, stdout=f, stderr=sp.STDOUT, text=True)
+        for i, f in enumerate(host_logs)
+    ]
+    gens = int(cfg["gens"])
+    try:
+        # warm-up: coordinator fold/update compiles + both hosts join
+        # and compile, all outside the timed window
+        es.train_elastic(1, fleet=coord, verbose=False)
+        warm = dict(es.obs.counters.snapshot())
+        t0 = time.perf_counter()
+        es.train_elastic(gens, fleet=coord, verbose=False)
+        dt = time.perf_counter() - t0
+    finally:
+        coord.close()
+        for i, h in enumerate(hosts):
+            try:
+                h.wait(timeout=10)
+            except sp.TimeoutExpired:
+                h.send_signal(signal.SIGKILL)
+                h.wait(timeout=10)
+            host_logs[i].close()
+            if h.returncode not in (0, -signal.SIGKILL):
+                with open(host_logs[i].name) as f:
+                    print(f"elastic host {i} exited {h.returncode}: "
+                          f"{f.read()[-800:]}", file=sys.stderr)
+    counters = es.obs.counters.snapshot()
+    return {
+        "mode": "elastic",
+        "gps": round(gens / dt, 3),
+        "wall_s": round(dt, 3),
+        "hosts": 2,
+        "hosts_lost": int(counters.get("hosts_lost", 0))
+        - int(warm.get("hosts_lost", 0)),
+        "membership_events": len(es.async_event_log.membership),
+        **_async_accounting(es, baseline=warm),
+    }
+
+
+def _run_elastic_leg(mode, base, rep=0):
+    """Run ONE --elastic-ab leg in fresh child processes and return its
+    timed row, or None after printing the failure evidence.  Shared by
+    the A/B gate and --capture-baseline's committed elastic row."""
+    import socket
+
+    child_env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    child_env.pop("ESTORCH_CHAOS", None)  # legs own their plan
+    if mode == "sync":
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        procs = [subprocess.Popen(
+            [sys.executable, __file__, "--stage-elastic-worker",
+             json.dumps({**base, "pid": pid, "port": port})],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, env=child_env) for pid in range(2)]
+        row = None
+        # drain BOTH workers' pipes concurrently: these are one SPMD
+        # job, so worker 1 blocking on a full unread pipe while we
+        # communicate() with worker 0 would stall the barrier fleet-wide
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(len(procs)) as pool:
+            futs = [pool.submit(p.communicate, None, 600) for p in procs]
+            try:
+                outs = [f.result() for f in futs]
+            except subprocess.TimeoutExpired:
+                for p in procs:
+                    p.kill()
+                print(json.dumps({"label": "elastic/sync", "rep": rep,
+                                  "error": "timeout after 600s"}),
+                      flush=True)
+                return None
+        for p, (out, err) in zip(procs, outs):
+            lines = [ln for ln in out.strip().splitlines()
+                     if ln.startswith("{")]
+            try:
+                cand = json.loads(lines[-1]) if lines else None
+            except ValueError:  # died mid-print: fail the leg, not the gate
+                cand = None
+            if p.returncode != 0 or cand is None:
+                print(json.dumps(
+                    {"label": "elastic/sync", "rep": rep,
+                     "error": f"worker exited {p.returncode}",
+                     "stderr_tail": err[-800:]}), flush=True)
+            elif cand.get("leader"):
+                row = cand
+        return row
+    argv = [sys.executable, __file__, "--stage-elastic-one",
+            json.dumps(base)]
+    try:
+        r = subprocess.run(argv, timeout=600, capture_output=True,
+                           text=True, env=child_env)
+        last = [ln for ln in r.stdout.strip().splitlines()
+                if ln.startswith("{")][-1]
+        return json.loads(last)
+    except subprocess.TimeoutExpired:
+        print(json.dumps({"label": "elastic/elastic", "rep": rep,
+                          "error": "timeout after 600s"}), flush=True)
+        return None
+    except (IndexError, ValueError):
+        print(json.dumps(
+            {"label": "elastic/elastic", "rep": rep,
+             "error": f"stage exited {r.returncode}",
+             "stderr_tail": r.stderr[-800:]}), flush=True)
+        return None
+
+
+def capture_elastic_row(gens=8):
+    """The committed-baseline elastic row (--capture-baseline): one
+    sync-SPMD + one elastic-fleet measurement under the shared declared
+    straggle_host plan, summarized for BENCH_r*.json extras."""
+    base = {"gens": int(gens), "population": 16, "horizon": 64,
+            "sleep_s": 0.3, "jitter_s": 0.1}
+    sync_row = _run_elastic_leg("sync", base)
+    el_row = _run_elastic_leg("elastic", base)
+    if not sync_row or not el_row:
+        return {"error": "one or both elastic legs failed", "cfg": base}
+    return {
+        "cfg": base,
+        "sync_gps": sync_row["gps"],
+        "elastic_gps": el_row["gps"],
+        "ratio": round(el_row["gps"] / sync_row["gps"], 3),
+        "results_folded": el_row.get("results_folded"),
+        "results_lost": el_row.get("results_lost"),
+        "accounting_ok": el_row.get("accounting_ok"),
+    }
+
+
+def stage_elastic_ab(selfcheck=False):
+    """Synchronous-SPMD-multihost vs elastic host-granular fold A/B
+    under an identical declared straggle_host plan (ISSUE 15
+    acceptance; the selfcheck form is the run_lint.sh gate).
+    Interleaved repeats, medians + a noise band learned from the
+    repeats via ``obs regress``.  Exit 0 only when (1) elastic
+    generation throughput beats the synchronous multihost loop by >=
+    1.25x beyond the band, (2) stale host contributions actually folded
+    (the plan MUST have exercised the IW path), and (3) the
+    zero-silent-drop accounting holds: dispatched == consumed +
+    discarded + lost."""
+    regress = _load_obs_regress()
+    base = ({"gens": 6, "population": 16, "horizon": 64,
+             "sleep_s": 0.3, "jitter_s": 0.1}
+            if selfcheck else
+            {"gens": 12, "population": 16, "horizon": 64,
+             "sleep_s": 0.5, "jitter_s": 0.25})
+    repeats = 2 if selfcheck else 3
+    rates = {"sync": [], "elastic": []}
+    elastic_rows = []
+    for rep in range(repeats):
+        for mode in ("sync", "elastic"):
+            row = _run_elastic_leg(mode, base, rep)
+            if row is None:
+                continue
+            if mode == "elastic":
+                elastic_rows.append(row)
+            rates[mode].append(row["gps"])
+            print(json.dumps({"label": f"elastic/{mode}", "rep": rep,
+                              **row}), flush=True)
+    if not rates["sync"] or not rates["elastic"]:
+        print(json.dumps({"label": "elastic/ab",
+                          "error": "one or both arms have no samples"}),
+              flush=True)
+        return 1
+    verdict = regress.compare(rates["elastic"], rates["sync"],
+                              metric="generations_per_sec")
+    ratio = (verdict["current_median"] / verdict["baseline_median"]
+             if verdict["baseline_median"] else None)
+    folded = sum(r.get("results_folded", 0) for r in elastic_rows)
+    accounting_ok = all(r.get("accounting_ok") for r in elastic_rows)
+    ok = (
+        ratio is not None and ratio >= 1.25
+        and bool(verdict.get("improved"))
+        and accounting_ok
+        and folded > 0  # stale host contributions MUST have folded
+    )
+    print(json.dumps({
+        "label": "elastic/ab",
+        "sync_median_gps": verdict["baseline_median"],
+        "elastic_median_gps": verdict["current_median"],
+        "ratio": round(ratio, 3) if ratio else None,
+        "band_pct": verdict["band_pct"],
+        "improved_beyond_band": bool(verdict.get("improved")),
+        "results_folded": folded,
+        "stale_discarded": sum(r.get("stale_discarded", 0)
+                               for r in elastic_rows),
+        "results_lost": sum(r.get("results_lost", 0)
+                            for r in elastic_rows),
+        "hosts_lost": sum(r.get("hosts_lost", 0) for r in elastic_rows),
+        "accounting_ok": accounting_ok,
         "pass": ok,
     }), flush=True)
     return 0 if ok else 1
@@ -2135,6 +2445,13 @@ def stage_capture_baseline(out_path: str | None = None, repeats: int = 3,
         m = len(ss)
         phases_headline[name] = round(
             ss[m // 2] if m % 2 else 0.5 * (ss[m // 2 - 1] + ss[m // 2]), 6)
+    # the elastic multi-host row (docs/multihost.md): one sync-SPMD +
+    # one elastic-fleet leg under the shared straggle_host plan, so the
+    # committed trajectory carries the barrier-vs-fold contrast the
+    # --elastic-ab gate defends
+    elastic_row = capture_elastic_row()
+    print(json.dumps({"label": "capture/elastic", **elastic_row}),
+          flush=True)
     artifact = {
         "n": len(rates),
         "cmd": "python bench.py --capture-baseline",
@@ -2151,6 +2468,7 @@ def stage_capture_baseline(out_path: str | None = None, repeats: int = 3,
             "repeat_rates": [round(x, 1) for x in rates],
             "phases_headline": phases_headline,
             "tail_headline": tail_headline,
+            "elastic": elastic_row,
         },
         # the embedded history the --phases/--tail gates consume
         # (obs/export/regress.py expand_embedded_rows)
@@ -2375,6 +2693,12 @@ no arguments        full headline benchmark (device probe decides the
                     (medians + learned noise band via obs regress;
                      gates the >=1.25x throughput win and the
                      zero-silent-drop accounting)
+  --elastic-ab [--selfcheck]  synchronous 2-process SPMD multihost loop
+                    vs the elastic host-granular fold scheduler under an
+                    identical declared straggle_host plan (medians +
+                    learned band via obs regress; gates the >=1.25x
+                    throughput win, stale-host folds actually firing,
+                    and dispatched == consumed + discarded + lost)
   --serve [--selfcheck]   dynamic-batching serving A/B
   --fleet [--selfcheck]   serving-fleet robustness gate: replica SIGKILL
                     under load (declared ESTORCH_CHAOS kill_replica)
@@ -2401,9 +2725,9 @@ no arguments        full headline benchmark (device probe decides the
                     so `obs regress --phases/--tail` gate against
                     committed history
   --regress [BASELINE] [--repeats N] [--cpu]   gate vs newest BENCH_r*.json
-(--stage-one/--stage-chaos-one/--stage-async-one/--stage-serve-one/
- --stage-fleet-one/--stage-shard-ab-one/--stage-scenario-one are
- internal child modes)
+(--stage-one/--stage-chaos-one/--stage-async-one/--stage-elastic-one/
+ --stage-elastic-worker/--stage-serve-one/--stage-fleet-one/
+ --stage-shard-ab-one/--stage-scenario-one are internal child modes)
 """
 
 
@@ -2437,6 +2761,21 @@ if __name__ == "__main__":
         if "--selfcheck" not in sys.argv:
             _lock_or_warn()
         sys.exit(stage_async_ab(selfcheck="--selfcheck" in sys.argv))
+    elif "--stage-elastic-worker" in sys.argv:
+        cfg = json.loads(
+            sys.argv[sys.argv.index("--stage-elastic-worker") + 1])
+        print(json.dumps(elastic_sync_worker(cfg)))
+    elif "--stage-elastic-one" in sys.argv:
+        cfg = json.loads(
+            sys.argv[sys.argv.index("--stage-elastic-one") + 1])
+        print(json.dumps(measure_elastic_one(cfg)))
+    elif "--elastic-ab" in sys.argv:
+        # the selfcheck form runs inside run_lint.sh (tiny config, CPU
+        # processes over loopback): skip the evidence lock a full
+        # measurement takes
+        if "--selfcheck" not in sys.argv:
+            _lock_or_warn()
+        sys.exit(stage_elastic_ab(selfcheck="--selfcheck" in sys.argv))
     elif "--stage-shard-ab-one" in sys.argv:
         cfg = json.loads(sys.argv[sys.argv.index("--stage-shard-ab-one") + 1])
         print(json.dumps(measure_shard_ab(cfg)))

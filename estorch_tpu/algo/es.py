@@ -877,10 +877,49 @@ class ES:
                                 n_steps=n_steps)
         return sched.run(n_steps, log_fn=log_fn, verbose=verbose)
 
+    def train_elastic(
+        self,
+        n_steps: int,
+        fleet=None,
+        log_fn: Callable[[dict], None] | None = None,
+        verbose: bool = True,
+        max_consecutive_rejections: int = 3,
+        max_stale: int = 16,
+        iw_clip: float = 2.0,
+        replay=None,
+    ):
+        """Elastic multi-host generations (docs/multihost.md,
+        parallel/elastic.py): remote hosts evaluate whole-population
+        dispatches as async sources, THIS process folds their
+        contributions with clipped importance weights and broadcasts
+        only the O(dim) center per update.  A slow host costs
+        throughput, a dead host costs ``results_lost`` (replaced by
+        extra dispatches) — never the fleet.
+
+        ``fleet`` is an :class:`~estorch_tpu.parallel.elastic.
+        ElasticCoordinator` hosts have joined / will join (membership is
+        elastic — joining mid-run is the point).  ``replay`` re-drives a
+        recorded :class:`~estorch_tpu.algo.scheduler.AsyncEventLog` as
+        pure math (no fleet needed): bit-identical parameters.  The live
+        run's log is left on ``es.async_event_log``."""
+        from .scheduler import ElasticScheduler
+
+        if fleet is None and replay is None:
+            raise ValueError(
+                "train_elastic needs a fleet (ElasticCoordinator) to run "
+                "live, or replay= to re-drive a recorded log")
+        sched = ElasticScheduler(
+            self, fleet, max_stale=max_stale, iw_clip=iw_clip,
+            max_consecutive_rejections=max_consecutive_rejections)
+        if replay is not None:
+            return sched.replay(replay, log_fn=log_fn, verbose=verbose,
+                                n_steps=n_steps)
+        return sched.run(n_steps, log_fn=log_fn, verbose=verbose)
+
     @property
     def async_event_log(self):
-        """The last ``train_async`` fold run's deterministic event log
-        (None before any fold-mode run)."""
+        """The last ``train_async``/``train_elastic`` fold run's
+        deterministic event log (None before any fold-mode run)."""
         return getattr(self, "_async_log", None)
 
     def _setup_n_proc(self, n_proc: int) -> None:

@@ -73,6 +73,35 @@ def stale_log_ratios(dots, norms, d2: float, c: float, dim: int):
     return dim * np.log(c) + 0.5 * (norms - eps_new_sq)
 
 
+def mirrored_member_stats(dots, norms):
+    """Expand per-PAIR noise stats (``engine.noise_stats``) to the
+    mirrored member layout — member 2k = +ε_k, member 2k+1 = −ε_k, the
+    ops/noise.py convention every estimator in this family leans on.
+    One home for the sign/repeat rule so the three λ computations
+    (IW_ES, worker fold, host fold) can never drift apart."""
+    dots = np.asarray(dots)
+    return (np.repeat(dots, 2) * np.tile([1.0, -1.0], dots.shape[0]),
+            np.repeat(np.asarray(norms), 2))
+
+
+def clipped_stale_lambdas(dots, norms, d2: float, c: float, dim: int,
+                          iw_clip: float) -> np.ndarray:
+    """Per-member truncated importance weights for ONE stale source —
+    the fold rule shared verbatim by the worker-granular and
+    host-granular schedulers (docs/async.md, docs/multihost.md):
+    :func:`stale_log_ratios`, max-shift stabilization (λ only ever
+    enters self-normalized; shift-invariant in log space), mean-1
+    self-normalization within the source (IW-ES), then IMPACT's
+    truncation at ``iw_clip`` so one wild ratio cannot hijack the
+    update.  ``dots`` are SIGNED per-member values (mirrored expansion
+    already applied)."""
+    log_lam = stale_log_ratios(dots, norms, d2, c, dim)
+    log_lam -= log_lam.max()
+    lam = np.exp(log_lam)
+    lam = lam * (len(lam) / max(lam.sum(), 1e-30))
+    return np.minimum(lam, iw_clip).astype(np.float32)
+
+
 class IW_ES(ES):
     """ES with importance-weighted reuse of the previous generation."""
 
@@ -279,9 +308,7 @@ class IW_ES(ES):
         dots, norms = np.asarray(dots), np.asarray(norms)
         d2 = float(jnp.vdot(d_vec, d_vec))
         if self._mirrored:
-            # members 2k/2k+1 share pair row k with signs ±1
-            dots = np.repeat(dots, 2) * np.tile([1.0, -1.0], dots.shape[0])
-            norms = np.repeat(norms, 2)
+            dots, norms = mirrored_member_stats(dots, norms)
         log_lam = stale_log_ratios(dots, norms, d2, c, self._spec.dim)
         # log-sum-exp style stabilization: λ only ever enters self-normalized
         # (λ̃ and ESS are shift-invariant in log space)

@@ -70,7 +70,7 @@ import numpy as np
 from ..host.engine import member_sign_offset
 from ..resilience.chaos import member_fault, mutate_fitness
 from ..utils.fault import rank_weights_with_failures
-from .iwes import stale_log_ratios
+from .iwes import clipped_stale_lambdas, mirrored_member_stats
 
 # short poll slice for every blocking point in the event loop: the loop
 # must wake to notice dead workers / shutdown, never sleep unbounded
@@ -131,15 +131,24 @@ class AsyncEventLog:
         self.updates: list[dict] = []
         self.discarded: list[list] = []  # [dispatch, member]
         self.lost: list[list] = []  # [dispatch, member]
+        # elastic multi-host runs (parallel/elastic.py) additionally
+        # record membership transitions: {"event": "join"|"leave",
+        # "host": id, "at_dispatch": count}.  Forensic, not replayed —
+        # replay is pure math over dispatches/updates; membership is
+        # WHY the schedule looked the way it did
+        self.membership: list[dict] = []
 
     def to_dict(self) -> dict:
-        return {
+        out = {
             "schema": 1,
             "dispatches": [list(d) for d in self.dispatches],
             "updates": self.updates,
             "discarded": [list(d) for d in self.discarded],
             "lost": [list(d) for d in self.lost],
         }
+        if self.membership:
+            out["membership"] = [dict(m) for m in self.membership]
+        return out
 
     @classmethod
     def from_dict(cls, data: dict) -> "AsyncEventLog":
@@ -148,6 +157,7 @@ class AsyncEventLog:
         log.updates = list(data.get("updates", []))
         log.discarded = [list(d) for d in data.get("discarded", [])]
         log.lost = [list(d) for d in data.get("lost", [])]
+        log.membership = [dict(m) for m in data.get("membership", [])]
         return log
 
 
@@ -223,8 +233,12 @@ class _ThreadSource:
             self.events.put(Arrival(source.dispatch, i, float(fit),
                                     int(steps), t1 - t0, t1))
 
-    def poll_lost(self) -> list[tuple[int, int]]:
+    def poll_lost(self, timeout_s: float = POLL_SLICE_S
+                  ) -> list[tuple[int, int]]:
         return []  # threads don't die silently; exceptions became NaN
+
+    def notify_update(self, version: int, state) -> None:
+        pass  # workers read θ from the Source snapshot, not a push
 
     def close(self) -> None:
         self._stop.set()
@@ -355,15 +369,19 @@ class _ProcessSource:
             del self._outstanding[seq]
             self._lose(dispatch, indices)
 
-    def poll_lost(self) -> list[tuple[int, int]]:
+    def poll_lost(self, timeout_s: float = POLL_SLICE_S
+                  ) -> list[tuple[int, int]]:
         """Drain arrived slices into the event queue; returns members
         lost to dead workers (accumulated since the last call)."""
-        self._drain(POLL_SLICE_S)
+        self._drain(timeout_s)
         # slices owned by workers that died with an empty pipe never
         # arrive: account them as lost so nothing is silently dropped
         self._sweep_dead(final=False)
         out, self._lost_now = self._lost_now, []
         return out
+
+    def notify_update(self, version: int, state) -> None:
+        pass  # workers read θ from the Source snapshot, not a push
 
     def close(self) -> None:
         pass  # the pool belongs to the engine; HostEngine.close owns it
@@ -386,12 +404,7 @@ class GenerationScheduler:
 
     def __init__(self, es, max_stale: int = 16, iw_clip: float = 2.0,
                  max_consecutive_rejections: int = 3):
-        if es.backend != "host":
-            raise ValueError(
-                "GenerationScheduler folds partial host results; device/"
-                "pooled/sharded backends use the overlap scheduler "
-                f"(got backend={es.backend!r})"
-            )
+        self._check_es(es)
         if max_stale < 1:
             raise ValueError(f"max_stale must be >= 1, got {max_stale}")
         if iw_clip < 1.0:
@@ -423,6 +436,44 @@ class GenerationScheduler:
         # exactly
         self._staleness_counts: dict[int, int] = {}
 
+    # -------------------------------------------------- backend hooks
+    # (the elastic host-granular scheduler overrides these; everything
+    # else — pacing, staleness, accounting, replay — is shared)
+
+    def _check_es(self, es) -> None:
+        if es.backend != "host":
+            raise ValueError(
+                "GenerationScheduler folds partial host results; device/"
+                "pooled/sharded backends use the overlap scheduler "
+                f"(got backend={es.backend!r})"
+            )
+
+    def _sigma_of(self, st) -> float:
+        return float(self.engine._state_sigma(st))
+
+    def _offsets_for(self, st, dispatch: int) -> np.ndarray:
+        return np.asarray(
+            self.engine._pair_offsets(st._replace(generation=dispatch)))
+
+    def _ensure_compiled(self) -> None:
+        es = self.es
+        if es.compile_time_s is None:
+            self.obs.note("compile")
+            es.compile_time_s = self.engine.compile(es.state)
+
+    def _make_source(self, events: "queue.Queue"):
+        source_cls = (_ProcessSource
+                      if self.engine.worker_mode == "process"
+                      else _ThreadSource)
+        return source_cls(self.engine, events)
+
+    def _inflight_budget(self, src_pool) -> int:
+        """Member count to keep in flight beyond the arrived backlog —
+        one population here (the loop adds a population per dispatch, so
+        ~2 stay in flight); the elastic scheduler scales it by live
+        hosts."""
+        return self.n
+
     # ------------------------------------------------------------ sources
 
     def _snapshot(self, dispatch: int, version: int) -> Source:
@@ -431,12 +482,12 @@ class GenerationScheduler:
         synchronous loop's (key, generation) — dispatch d of an async
         run and generation d of a sync run draw the same noise."""
         st = self.es.state
-        offs = self.engine._pair_offsets(st._replace(generation=dispatch))
         src = Source(
             dispatch=dispatch, version=version,
-            params=np.array(st.params_flat, np.float32, copy=True),
-            sigma=float(self.engine._state_sigma(st)),
-            offsets=np.asarray(offs),
+            params=np.array(np.asarray(st.params_flat), np.float32,
+                            copy=True),
+            sigma=self._sigma_of(st),
+            offsets=self._offsets_for(st, dispatch),
             t_dispatch=time.perf_counter(),
         )
         self._sources[dispatch] = src
@@ -479,7 +530,7 @@ class GenerationScheduler:
         if n_valid < 2:
             return None, None, fit, {"n_valid": n_valid}
         w = rank_weights_with_failures(fit)
-        sigma_u = eng._state_sigma(st)
+        sigma_u = self._sigma_of(st)
         center = np.asarray(st.params_flat, np.float32)
         dim = eng.dim
 
@@ -518,15 +569,8 @@ class GenerationScheduler:
                             dots[kk] = float(eps @ d_vec) * signs[kk]
                             norms[kk] = float(eps @ eps)
                         d2 = float(d_vec @ d_vec)
-                        log_lam = stale_log_ratios(dots, norms, d2, c, dim)
-                        log_lam -= log_lam.max()
-                        lam = np.exp(log_lam)
-                        # mean-1 self-normalization within the source
-                        # dispatch (IW-ES), then IMPACT's truncation:
-                        # one wild ratio cannot hijack the update
-                        lam = lam * (k / max(lam.sum(), 1e-30))
-                        lam = np.minimum(lam, self.iw_clip).astype(
-                            np.float32)
+                        lam = clipped_stale_lambdas(dots, norms, d2, c,
+                                                    dim, self.iw_clip)
                         lam_stale.extend(float(x) for x in lam)
                     coeff = (np.asarray([w[j] for j in idx], np.float32)
                              * lam)
@@ -639,7 +683,7 @@ class GenerationScheduler:
                                       t_now - src.t_dispatch)
 
         steps = int(sum(a.steps for a in batch))
-        sigma = float(self.engine._state_sigma(es.state))
+        sigma = self._sigma_of(es.state)
         es.state = new_state
         # the log append rides IMMEDIATELY on the state transition: the
         # two together are "this batch was consumed" — anything raising
@@ -736,14 +780,9 @@ class GenerationScheduler:
         es = self.es
         obs = self.obs
         obs.discard_phases()
-        if es.compile_time_s is None:
-            obs.note("compile")
-            es.compile_time_s = self.engine.compile(es.state)
+        self._ensure_compiled()
         events: queue.Queue = queue.Queue()
-        source_cls = (_ProcessSource
-                      if self.engine.worker_mode == "process"
-                      else _ThreadSource)
-        src_pool = source_cls(self.engine, events)
+        src_pool = self._make_source(events)
         self._n_workers = src_pool.n_workers
         self._discards_since_update = {}
 
@@ -790,7 +829,8 @@ class GenerationScheduler:
                 # generations), so a lossy run still finishes its
                 # schedule with full batches
                 remaining = (n_steps - updates_done) * self.n - len(arrived)
-                if len(inflight) < min(self.n, remaining):
+                while len(inflight) < min(self._inflight_budget(src_pool),
+                                          remaining):
                     # the dispatch's trace id threads through its span,
                     # the async_dispatch event, and every later fold /
                     # discard event — one grep through the flight
@@ -815,14 +855,20 @@ class GenerationScheduler:
                             f"reached no live worker ({lost} results "
                             f"lost so far)")
 
-                # ---- collect arrivals (one bounded wait, then drain)
+                # ---- collect arrivals (one bounded wait, then drain);
+                # with a full population already waiting the wait drops
+                # to a pure drain, so a ready update never sits behind a
+                # poll slice
                 with obs.phase("eval"):
-                    for d, i in src_pool.poll_lost():
+                    ready = len(arrived) >= self.n
+                    for d, i in src_pool.poll_lost(
+                            0.0 if ready else POLL_SLICE_S):
                         inflight.pop((d, i), None)
                         self.log.lost.append([d, i])
                         lost += 1
                     try:
-                        a = events.get(timeout=POLL_SLICE_S)
+                        a = (events.get_nowait() if ready
+                             else events.get(timeout=POLL_SLICE_S))
                     except queue.Empty:
                         a = None
                     while a is not None:
@@ -874,6 +920,10 @@ class GenerationScheduler:
                         t_update = time.perf_counter()
                         version += 1
                         updates_done += 1
+                        # the elastic source pushes the new center to
+                        # every live host here (O(dim) broadcast);
+                        # in-process sources have nothing to push
+                        src_pool.notify_update(version, es.state)
                         self._prune_sources(
                             version,
                             {d for d, _ in inflight}
@@ -883,6 +933,17 @@ class GenerationScheduler:
                         # apply (same membership → deterministic re-run)
                         arrived = batch + arrived
         finally:
+            # one final zero-timeout loss drain: a dispatch surrendered
+            # as lost moments before an aborting raise (the dry-out
+            # guard fires straight after the empty dispatch) must still
+            # land on the log — no poll ever ran after it
+            try:
+                for d, i in src_pool.poll_lost(0.0):
+                    inflight.pop((d, i), None)
+                    self.log.lost.append([d, i])
+                    lost += 1
+            except Exception:  # noqa: BLE001 — the run is already over
+                obs.event("final_loss_drain_failed")
             src_pool.close()
             # tail accounting: results still in flight or arrived-but-
             # unconsumed at shutdown are recorded as discarded (the run
@@ -951,6 +1012,387 @@ class GenerationScheduler:
             self._prune_sources(version)
         es._async_log = self.log
         return es
+
+
+# ---------------------------------------------------------------------
+# the elastic host-granular scheduler (parallel/elastic.py fleets)
+# ---------------------------------------------------------------------
+
+
+class _HostSource:
+    """Host-granular source: each dispatch is a FULL population evaluated
+    by one remote host of an elastic fleet (parallel/elastic.py), results
+    arrive a population at a time, and a dead host's in-flight dispatches
+    surrender as ``results_lost`` — the PR-8 worker-source contract lifted
+    to host granularity.
+
+    The fleet object (``ElasticCoordinator``) owns the sockets and the
+    membership table; this adapter owns the scheduler-facing accounting:
+    Arrival conversion, membership entries on the event log, the per-host
+    latency distributions, and the loss/membership counters."""
+
+    def __init__(self, scheduler: "ElasticScheduler", fleet,
+                 events: "queue.Queue"):
+        self.sched = scheduler
+        self.fleet = fleet
+        self.events = events
+        self.n = scheduler.n
+        self.obs = scheduler.obs
+        self._fold_p99: dict[int, float] = {}
+        self._lost_now: list[tuple[int, int]] = []
+
+    def dispatch(self, source: Source) -> list[int]:
+        host = self.fleet.dispatch(source.dispatch, source.version)
+        if host is None:
+            # grace expired with no live host: the never-sent population
+            # is surrendered as lost UP FRONT (the _ProcessSource dead-
+            # pipe contract), because the dispatch is already on the log
+            # — dispatched == consumed + discarded + lost must survive
+            # even a run that recovers when a host finally joins.  The
+            # empty member list still feeds the dry-out guard
+            self.obs.counters.inc("results_lost", self.n)
+            self.obs.event("results_lost", dispatch=int(source.dispatch),
+                           host=None, n=self.n)
+            self._lost_now.extend((int(source.dispatch), i)
+                                  for i in range(self.n))
+            return []
+        self.obs.event("elastic_dispatch", trace=f"d{source.dispatch}",
+                       dispatch=int(source.dispatch), host=int(host))
+        return list(range(self.n))
+
+    def _note_membership(self, events: list[dict]) -> None:
+        for m in events:
+            entry = dict(m, at_dispatch=len(self.sched.log.dispatches))
+            self.sched.log.membership.append(entry)
+            if m["event"] == "join":
+                self.obs.counters.inc("hosts_joined")
+            else:
+                self.obs.counters.inc("hosts_lost")
+                # the worst-host rollup must not be pinned by a dead
+                # straggler's history: drop its distribution snapshot
+                if self._fold_p99.pop(int(m["host"]), None) is not None:
+                    self.obs.counters.gauge(
+                        "elastic_fold_p99_worst_s",
+                        round(max(self._fold_p99.values()), 6)
+                        if self._fold_p99 else 0.0)
+            self.obs.event(f"host_{m['event']}", host=int(m["host"]))
+        self.obs.counters.gauge("elastic_hosts", self.fleet.n_live())
+
+    def poll_lost(self, timeout_s: float = POLL_SLICE_S
+                  ) -> list[tuple[int, int]]:
+        results, lost_dispatches, membership = self.fleet.poll(timeout_s)
+        if membership:
+            self._note_membership(membership)
+        t_arr = time.perf_counter()
+        for r in results:
+            d, host = int(r["dispatch"]), int(r["host"])
+            src = self.sched._sources.get(d)
+            if src is None:
+                # a stray from a PREVIOUS run on this fleet (the fleet
+                # outlives runs; a straggler can answer run 1's dispatch
+                # during run 2): not this log's dispatch, so folding or
+                # even discard-logging it would break the run's
+                # dispatched == consumed + discarded + lost invariant —
+                # dropped WITH evidence, outside the log
+                self.obs.counters.inc("foreign_results_dropped")
+                self.obs.event("foreign_result_dropped", dispatch=d,
+                               host=host)
+                continue
+            fit = np.asarray(r["fitness"], np.float32)
+            k = max(len(fit), 1)
+            per = float(r["eval_s"]) / k
+            base_steps, rem = divmod(int(r["steps"]), k)
+            if src.t_dispatch:
+                # per-host dispatch→arrival latency: the host's whole
+                # contribution lag, the tail `obs dash`'s host column
+                # renders (worst host p99 rides a gauge so the dash can
+                # read it from the store alone)
+                lat = t_arr - src.t_dispatch
+                self.obs.hists.observe("elastic/fold_s", lat)
+                self.obs.hists.observe(f"elastic/h{host}/fold_s", lat)
+                p99 = self.obs.hists.quantile(f"elastic/h{host}/fold_s",
+                                              0.99)
+                if p99 is not None:
+                    self._fold_p99[host] = p99
+                    self.obs.counters.gauge(f"elastic_fold_p99_s_h{host}",
+                                            round(p99, 6))
+                    self.obs.counters.gauge(
+                        "elastic_fold_p99_worst_s",
+                        round(max(self._fold_p99.values()), 6))
+            self.obs.event("elastic_result", trace=f"d{d}", dispatch=d,
+                           host=host, eval_s=round(float(r["eval_s"]), 4))
+            for i in range(len(fit)):
+                self.events.put(Arrival(
+                    d, i, float(fit[i]),
+                    base_steps + (1 if i < rem else 0), per, t_arr))
+        lost: list[tuple[int, int]] = []
+        for d, host in lost_dispatches:
+            if self.sched._sources.get(int(d)) is None:
+                # same foreign-dispatch rule as above: a host that died
+                # still holding a PREVIOUS run's dispatch must not
+                # inflate this run's loss accounting
+                self.obs.event("foreign_loss_dropped", dispatch=int(d),
+                               host=int(host))
+                continue
+            self.obs.counters.inc("results_lost", self.n)
+            self.obs.event("results_lost", dispatch=int(d),
+                           host=int(host), n=self.n)
+            lost.extend((int(d), i) for i in range(self.n))
+        out = self._lost_now + lost
+        self._lost_now = []
+        return out
+
+    def notify_update(self, version: int, state) -> None:
+        self.fleet.push_center(
+            version, np.asarray(state.params_flat, np.float32),
+            float(np.asarray(state.sigma)))
+
+    def close(self) -> None:
+        # the fleet outlives the run (hosts stay joined for the next
+        # train_elastic call / operator shutdown) — nothing to tear down
+        self.obs.counters.gauge("elastic_hosts", self.fleet.n_live())
+
+    @property
+    def n_workers(self) -> int:
+        return max(self.fleet.n_live(), 1)
+
+
+class ElasticScheduler(GenerationScheduler):
+    """The fold scheduler at HOST granularity on the device engine
+    (docs/multihost.md): dispatches go to remote hosts running the
+    sharded/replicated generation program as async sources
+    (parallel/elastic.py), per-host fitness contributions fold in with
+    the same clipped-importance-weight math (``iwes.stale_log_ratios``,
+    mean-1 self-normalized, truncated at ``iw_clip``), an update fires
+    per population's-worth of arrivals, and only the O(dim) center rides
+    the wire back to the hosts.
+
+    The coordinator's update programs are the REPLICATED device engine's
+    split path: a batch whose single source is the current center is the
+    plain ``apply_weights`` update (the exact synchronous estimator); a
+    batch carrying stale sources routes through ``apply_weights_reuse``
+    (the IW-ES combined-estimator program) with λ per source dispatch.
+    Event log, staleness discards, loss replacement, accounting and
+    bit-exact ``replay`` are all inherited from the base scheduler —
+    host granularity changes who evaluates, not what is recorded."""
+
+    def __init__(self, es, fleet, max_stale: int = 16,
+                 iw_clip: float = 2.0,
+                 max_consecutive_rejections: int = 3):
+        self.fleet = fleet
+        super().__init__(
+            es, max_stale=max_stale, iw_clip=iw_clip,
+            max_consecutive_rejections=max_consecutive_rejections)
+
+    # ----------------------------------------------------- backend hooks
+
+    def _check_es(self, es) -> None:
+        if es.backend != "device" or getattr(es, "_shard_params", False):
+            raise ValueError(
+                "ElasticScheduler runs on the coordinator's replicated "
+                "device engine (table noise); hosts may run the sharded "
+                "program, the coordinator's fold/update programs are the "
+                f"replicated split path (got backend={es.backend!r}"
+                f"{', shard_params=True' if getattr(es, '_shard_params', False) else ''})"
+            )
+        es.engine._require_dense_noise("elastic host fold")
+        if getattr(es, "_obs_norm", False):
+            raise ValueError(
+                "elastic folding does not support obs_norm: a stale "
+                "host's fitness was measured under OLDER running stats, "
+                "so the density ratio's fixed-f(θ) assumption silently "
+                "breaks (same refusal as IW_ES)")
+        if getattr(es, "_streamed", False) or getattr(es, "_noise_kernel",
+                                                      False):
+            raise ValueError(
+                "elastic folding supports the standard/decomposed "
+                "forwards; streamed/noise_kernel are untested with the "
+                "reuse-update program")
+
+    def _sigma_of(self, st) -> float:
+        return float(np.asarray(st.sigma))
+
+    def _offsets_for(self, st, dispatch: int) -> np.ndarray:
+        import jax.numpy as jnp
+
+        return np.asarray(self.engine.all_pair_offsets(
+            st._replace(generation=jnp.asarray(int(dispatch),
+                                               jnp.int32))))
+
+    def _ensure_compiled(self) -> None:
+        es = self.es
+        if es.compile_time_s is not None:
+            return
+        self.obs.note("compile")
+        es.compile_time_s = self.engine.compile_split(es.state)
+        # warm the single-source-group reuse shape (the host-granular
+        # common case: one whole stale population per update) outside
+        # the timed loop — the IW_ES._warm_reuse_programs discipline
+        import jax.numpy as jnp
+
+        t0 = time.perf_counter()
+        st = es.state
+        offs = self.engine.all_pair_offsets(st)
+        zeros_d = jnp.zeros_like(st.params_flat)
+        self.engine.noise_stats(offs, zeros_d)
+        out, _ = self.engine.apply_weights_reuse(
+            st, jnp.zeros((self.n,), jnp.float32),
+            offs, jnp.zeros((int(offs.shape[0]),), jnp.float32),
+            zeros_d[None, :], jnp.zeros((1,), jnp.float32))
+        jnp.asarray(out.params_flat).block_until_ready()
+        dt = time.perf_counter() - t0
+        self.obs.compile_event("elastic_fold_warm", dt,
+                               count_recompiles=2, programs=2,
+                               first_call=True)
+        es.compile_time_s += dt
+
+    def _make_source(self, events: "queue.Queue"):
+        # seed the fleet's center (version 0) so hosts that joined
+        # before this run — or join during it — sync the right state
+        st = self.es.state
+        self.fleet.push_center(
+            0, np.asarray(st.params_flat, np.float32), self._sigma_of(st))
+        return _HostSource(self, self.fleet, events)
+
+    def _inflight_budget(self, src_pool) -> int:
+        # one population in flight PER LIVE HOST (plus the one the loop
+        # is about to add): every host stays fed, a straggling host
+        # queues at most ~one extra dispatch
+        return self.n * max(1, self.fleet.n_live())
+
+    # -------------------------------------------------------- fold math
+
+    def _best_theta(self, arrival: Arrival) -> np.ndarray:
+        eng = self.engine
+        src = self._sources[arrival.dispatch]
+        sign, off = member_sign_offset(src.offsets, arrival.member,
+                                       bool(eng.config.mirrored))
+        eps = np.asarray(eng.table.slice(int(off), eng.spec.dim))
+        return src.params + src.sigma * sign * eps
+
+    def _fold_batch(self, batch: list[Arrival], version: int):
+        """Device-path fold of one mixed-staleness batch — pure given
+        (center state, sources, batch), exactly like the host fold, so
+        replay stays bit-identical.  All-fresh single-source batches are
+        the synchronous estimator through ``apply_weights``; anything
+        else is the IW-ES combined-estimator program with per-source λ.
+        """
+        import jax.numpy as jnp
+
+        from ..ops.gradient import fold_mirrored_weights
+
+        eng = self.engine
+        st = self.es.state
+        dim = int(eng.spec.dim)
+        mirrored = bool(eng.config.mirrored)
+        batch = sorted(batch, key=lambda a: (a.dispatch, a.member))
+        fit = np.asarray([a.fitness for a in batch], np.float32)
+        fit = mutate_fitness(int(np.asarray(st.generation)), fit)
+        n_valid = int(np.isfinite(fit).sum())
+        if n_valid < 2:
+            return None, None, fit, {"n_valid": n_valid}
+        w = rank_weights_with_failures(fit)
+        sigma_u = self._sigma_of(st)
+        n_tot = len(batch)
+        center = np.asarray(st.params_flat, np.float32)
+
+        by_dispatch: dict[int, list[int]] = {}
+        for j, a in enumerate(batch):
+            by_dispatch.setdefault(a.dispatch, []).append(j)
+        lam_stale: list[float] = []
+        n_fresh = 0
+        with self.obs.phase("async"):
+            with self.obs.phase("fold"):
+                only = next(iter(by_dispatch))
+                fresh_single = (
+                    len(by_dispatch) == 1 and n_tot == self.n
+                    and self._sources[only].version == version)
+                if fresh_single:
+                    w_vec = np.zeros(self.n, np.float32)
+                    for kk, j in enumerate(by_dispatch[only]):
+                        w_vec[batch[j].member] = w[j]
+                    n_fresh = n_tot
+                    reuse_args = None
+                else:
+                    offs_parts, oldw_parts, d_rows, coeffs = [], [], [], []
+                    for d in sorted(by_dispatch):
+                        src = self._sources[d]
+                        idx = by_dispatch[d]
+                        k = len(idx)
+                        if src.version == version:
+                            lam = np.ones(k, np.float32)
+                            c = 1.0
+                            d_vec = np.zeros(dim, np.float32)
+                            n_fresh += k
+                        else:
+                            d_vec = ((src.params - center)
+                                     / sigma_u).astype(np.float32)
+                            c = src.sigma / sigma_u
+                            dots, norms = eng.noise_stats(
+                                jnp.asarray(src.offsets),
+                                jnp.asarray(d_vec))
+                            dots, norms = (np.asarray(dots),
+                                           np.asarray(norms))
+                            if mirrored:
+                                dots, norms = mirrored_member_stats(
+                                    dots, norms)
+                            members = np.asarray(
+                                [batch[j].member for j in idx], np.intp)
+                            d2 = float(d_vec @ d_vec)
+                            lam = clipped_stale_lambdas(
+                                dots[members], norms[members], d2, c,
+                                dim, self.iw_clip)
+                            lam_stale.extend(float(x) for x in lam)
+                        # per-member weights over the dispatch's FULL
+                        # population; members not in the batch weigh 0
+                        w_eff = np.zeros(self.n, np.float32)
+                        for kk, j in enumerate(idx):
+                            w_eff[batch[j].member] = w[j] * lam[kk]
+                        folded = (np.asarray(fold_mirrored_weights(
+                            jnp.asarray(w_eff))) if mirrored else w_eff)
+                        oldw_parts.append(
+                            folded * np.float32(c / (n_tot * sigma_u)))
+                        offs_parts.append(src.offsets)
+                        d_rows.append(d_vec)
+                        coeffs.append(float(w_eff.sum())
+                                      / (n_tot * sigma_u))
+                    reuse_args = (
+                        np.concatenate(offs_parts),
+                        np.concatenate(oldw_parts).astype(np.float32),
+                        np.stack(d_rows).astype(np.float32),
+                        np.asarray(coeffs, np.float32),
+                    )
+            with self.obs.phase("update"):
+                if reuse_args is None:
+                    new_state, gnorm = eng.apply_weights(
+                        st._replace(generation=jnp.asarray(int(only),
+                                                           jnp.int32)),
+                        jnp.asarray(w_vec))
+                else:
+                    new_state, gnorm = eng.apply_weights_reuse(
+                        st, jnp.zeros((self.n,), jnp.float32),
+                        jnp.asarray(reuse_args[0]),
+                        jnp.asarray(reuse_args[1]),
+                        jnp.asarray(reuse_args[2]),
+                        jnp.asarray(reuse_args[3]))
+                # state.generation counts UPDATES (the fold-scheduler
+                # contract); the per-dispatch noise generation was an
+                # operand of this one program only
+                new_state = new_state._replace(
+                    generation=jnp.asarray(version + 1, jnp.int32))
+                gnorm = float(np.asarray(gnorm))
+        stats = {
+            "n_valid": n_valid,
+            "fresh": n_fresh,
+            "folded": len(batch) - n_fresh,
+            "mean_lambda": (round(float(np.mean(lam_stale)), 4)
+                            if lam_stale else None),
+            "max_staleness": version - min(
+                self._sources[d].version for d in by_dispatch),
+            "consumed_by_dispatch": [[int(d), len(by_dispatch[d])]
+                                     for d in sorted(by_dispatch)],
+        }
+        return new_state, gnorm, fit, stats
 
 
 # ---------------------------------------------------------------------

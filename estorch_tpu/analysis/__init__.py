@@ -37,6 +37,10 @@ Rules (docs/analysis.md has the full rationale per rule):
                                 a jitted rollout/step construction
                                 (recompile-per-variant; traced-operand
                                 contract of estorch_tpu/scenarios)
+* R17 unfenced-cross-host-barrier — jax.distributed.initialize without
+                                initialization_timeout, or an untimed
+                                coordinator-socket accept/recv(n)
+                                (one silent peer wedges the fleet)
 
 Nothing in this package imports jax or the analyzed modules — analysis
 is pure ``ast`` and safe to run where no accelerator exists.

@@ -1,6 +1,7 @@
 """Host-side robustness rules: R05 untimed-subprocess-wait,
 R06 signature-probe-default, R11 blocking-wait-in-scheduler,
-R13 untimed-network-call, R15 unbounded-retry.
+R13 untimed-network-call, R15 unbounded-retry,
+R17 unfenced-cross-host-barrier.
 
 R05 is the wedge class ``doctor.py`` exists to detect after the fact:
 a ``proc.wait()`` / ``proc.communicate()`` with no timeout turns a hung
@@ -29,6 +30,21 @@ global socket default (None: block forever), so one replica that
 accepts the TCP connection and then goes silent wedges the scraper,
 the client, or the doctor probe that called it.  CPython's own default
 timeouts are None throughout; the bound must be at the call site.
+
+R17 is the R05/R11/R13 family lifted to the HOST layer — the hazard
+class the elastic multi-host work (parallel/elastic.py, multihost.py)
+made systemic: a cross-host rendezvous with no deadline.  Two shapes:
+(1) ``jax.distributed.initialize`` without ``initialization_timeout`` —
+the cluster barrier where a peer that never dials in hangs every host
+in the job, indefinitely and identically, so no survivor can even name
+the missing peer; (2) a raw coordinator-socket blocking wait —
+``.accept()`` or a buffer-sized ``.recv(n)``/``.recvfrom(n)`` on a
+socket-ish receiver — in a scope that never bounds it (no
+``settimeout``, no ``select``-style readiness wait, and no
+``socket.timeout``/``TimeoutError`` handler, which only ever fires on a
+timed socket).  The zero-arg pipe ``recv()`` stays R11's; socket
+CONSTRUCTION timeouts stay R13's; R17 owns the per-wait fence on an
+accepted/long-lived connection.
 
 R15 is the retry half of the same failure story: a loop that catches a
 network call's exception and tries again with NO attempt bound (``while
@@ -447,6 +463,111 @@ def check_unbounded_retry(ctx: ModuleContext):
                     "sleep between attempts (exponential backoff + "
                     "jitter, `time.sleep(base * 2**attempt * jitter)`) "
                     "or escalate after the first failure",
+                    symbol))
+    return out
+
+
+# ---------------------------------------------------------------------
+# R17 unfenced-cross-host-barrier
+# ---------------------------------------------------------------------
+
+_SOCKISH_NAME = re.compile(
+    r"(^|_)(sock|socket|srv|server|listener|conn|connection|peer)"
+    r"(s)?($|_)",
+    re.IGNORECASE)
+_SELECTISH_NAME = re.compile(
+    r"(^|_)(sel|selector|selectors|select|poller|epoll|kqueue)(s)?($|_)",
+    re.IGNORECASE)
+_TIMEOUTISH_EXC = ("timeout", "TimeoutError")
+
+
+def _scope_bounds_socket_waits(ctx: ModuleContext, scope,
+                               wait_tail: str) -> bool:
+    """True when the scope provably fences a wait on the receiver named
+    ``wait_tail``: a ``settimeout(x)`` with a non-None bound on the SAME
+    receiver (a timeout on some other socket bounds nothing here), a
+    readiness wait on a selector-ish receiver (``sel.select(...)``/
+    ``select.select(...)`` — the socket itself was registered elsewhere,
+    so no receiver match is possible; a ``.select()`` on a non-selector
+    receiver, e.g. an ORM query or a soup, is not a fence), or an
+    ``except socket.timeout / TimeoutError`` handler — which only ever
+    fires on a socket that HAS a timeout, so catching it is evidence one
+    was set upstream (the elastic protocol helpers' shape: the
+    connect/accept site sets the timeout, the recv loop catches)."""
+    for node in scope_nodes(scope):
+        if isinstance(node, ast.Call) and isinstance(node.func,
+                                                     ast.Attribute):
+            if (node.func.attr == "settimeout" and node.args
+                    and _receiver_tail(node.func) == wait_tail
+                    and not (isinstance(node.args[0], ast.Constant)
+                             and node.args[0].value is None)):
+                return True
+            if node.func.attr == "select" and (node.args or node.keywords):
+                recv = _receiver_tail(node.func)
+                if recv is not None and _SELECTISH_NAME.search(recv):
+                    return True
+        if isinstance(node, ast.ExceptHandler) and node.type is not None:
+            types = (node.type.elts
+                     if isinstance(node.type, ast.Tuple) else [node.type])
+            for t in types:
+                name = (t.attr if isinstance(t, ast.Attribute)
+                        else t.id if isinstance(t, ast.Name) else None)
+                if name in _TIMEOUTISH_EXC:
+                    return True
+    return False
+
+
+@rule("R17", "unfenced-cross-host-barrier", "error",
+      "cross-host rendezvous (jax.distributed init / coordinator-socket "
+      "wait) with no deadline hangs the whole fleet on one silent peer")
+def check_unfenced_cross_host_barrier(ctx: ModuleContext):
+    r = get_rule("R17")
+    out = []
+    for symbol, scope in iter_scopes(ctx):
+        bounded: dict[str, bool] = {}  # per waited receiver, lazily
+        for node in scope_nodes(scope):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = ctx.resolve(node.func)
+            if resolved == "jax.distributed.initialize":
+                kw = _kw(node, "initialization_timeout")
+                if kw is None or (isinstance(kw.value, ast.Constant)
+                                  and kw.value.value is None):
+                    out.append(make_finding(
+                        ctx, r, node,
+                        "`jax.distributed.initialize` without "
+                        "`initialization_timeout` — one peer that never "
+                        "dials in hangs EVERY host in the job, "
+                        "indefinitely and identically",
+                        "pass initialization_timeout=... (seconds) so "
+                        "the barrier becomes a timed error naming the "
+                        "wedge (parallel/multihost.py is the shape)",
+                        symbol))
+                continue
+            if not isinstance(node.func, ast.Attribute):
+                continue
+            method = node.func.attr
+            tail = _receiver_tail(node.func)
+            if tail is None or not _SOCKISH_NAME.search(tail):
+                continue
+            # .accept() is argless; socket .recv/.recvfrom carry a
+            # buffer size (the argless pipe recv() is R11's territory)
+            wait = (method == "accept" and not node.args) or (
+                method in ("recv", "recvfrom", "recv_into") and node.args)
+            if not wait:
+                continue
+            if tail not in bounded:
+                bounded[tail] = _scope_bounds_socket_waits(ctx, scope,
+                                                           tail)
+            if not bounded[tail]:
+                out.append(make_finding(
+                    ctx, r, node,
+                    f"`{tail}.{method}()` with no deadline — a silent "
+                    "peer (wedged host, half-open TCP) blocks this end "
+                    "of the fleet forever",
+                    "settimeout(...) the socket (or select with a "
+                    "timeout) and loop on socket.timeout in bounded "
+                    "slices, re-checking liveness each slice",
                     symbol))
     return out
 

@@ -379,6 +379,189 @@ def check_scenarios(timeout_s: float = 90.0) -> dict:
     return result
 
 
+# elastic probe: proves the multi-host layers (parallel/multihost.py +
+# parallel/elastic.py, docs/multihost.md) can run here — staged:
+# (1) jax.distributed bring-up of TWO real OS processes over loopback
+#     (Gloo CPU collectives, timed barrier),
+# (2) the global population mesh spanning both processes' devices,
+# (3) one cross-process psum through that mesh,
+# (4) the elastic coordinator's TCP round-trip (join → sync → center →
+#     dispatch → result), which is deliberately jax-free.
+# The parent orchestrates, prints one marker per stage, and bounds every
+# wait; the first missing marker names the failing layer.
+_ELASTIC_WORKER = """
+import sys
+pid, port, out_path = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+from estorch_tpu.utils.backend import force_cpu_backend
+force_cpu_backend(2)
+import estorch_tpu.parallel.multihost as mh
+f = open(out_path, "w", buffering=1)
+mh.initialize("127.0.0.1:" + port, 2, pid, timeout_s=45,
+              cpu_collectives=True)
+print("WINIT", file=f)
+import jax
+mesh = mh.global_population_mesh()
+print("WMESH", mesh.devices.size, file=f)
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+from estorch_tpu.utils.backend import shard_map
+fn = jax.jit(shard_map(lambda x: jax.lax.psum(x, "pop"), mesh,
+                       (P(),), P(), check_vma=False))
+out = fn(jnp.ones(4))
+print("WPSUM", float(out[0]), file=f)
+"""
+
+_ELASTIC_PROBE = """
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+print("ELASTIC_START", flush=True)
+workdir = tempfile.mkdtemp(prefix="estorch_elastic_probe_")
+worker_py = os.path.join(workdir, "worker.py")
+with open(worker_py, "w") as f:
+    f.write(%r)
+with socket.socket() as s:
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+marks = [os.path.join(workdir, "w%%d.txt" %% i) for i in range(2)]
+env = dict(os.environ, JAX_PLATFORMS="cpu")
+procs = [subprocess.Popen([sys.executable, worker_py, str(i), str(port),
+                           marks[i]], env=env,
+                          stdout=subprocess.DEVNULL,
+                          stderr=subprocess.PIPE, text=True)
+         for i in range(2)]
+
+def both_have(marker, deadline):
+    while time.monotonic() < deadline:
+        got = 0
+        for m in marks:
+            try:
+                with open(m) as f:
+                    if any(ln.startswith(marker) for ln in f):
+                        got += 1
+            except OSError:
+                pass
+        if got == 2:
+            return True
+        if any(p.poll() not in (None, 0) for p in procs):
+            return False
+        time.sleep(0.1)
+    return False
+
+deadline = time.monotonic() + 70
+try:
+    if not both_have("WINIT", deadline):
+        raise SystemExit(3)
+    print("ELASTIC_INIT_OK", flush=True)
+    if not both_have("WMESH", deadline):
+        raise SystemExit(3)
+    print("ELASTIC_MESH_OK", flush=True)
+    if not both_have("WPSUM", deadline):
+        raise SystemExit(3)
+    print("ELASTIC_PSUM_OK", flush=True)
+finally:
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+        try:
+            p.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            pass
+        if p.returncode not in (None, 0):
+            sys.stderr.write((p.stderr.read() or "")[-800:])
+
+# stage 4: coordinator round-trip — jax-free by construction
+import numpy as np
+from estorch_tpu.parallel.elastic import (ElasticCoordinator, recv_msg,
+                                          send_msg)
+coord = ElasticCoordinator(join_grace_s=5.0)
+cl = socket.create_connection(coord.address, timeout=5)
+cl.settimeout(0.05)
+send_msg(cl, {"t": "join", "host": 0})
+deadline = time.monotonic() + 10
+
+def next_msg():
+    while time.monotonic() < deadline:
+        got = recv_msg(cl, 0.05)
+        if got is not None:
+            return got
+    raise SystemExit(4)
+
+header, arrays = next_msg()
+assert header["t"] == "sync", header
+coord.push_center(0, np.arange(4, dtype=np.float32), 0.1)
+assert coord.dispatch(0, 0) == 0
+seen = set()
+while {"center", "dispatch"} - seen:
+    header, arrays = next_msg()
+    seen.add(header["t"])
+    if header["t"] == "center":
+        assert arrays["center"].tolist() == [0.0, 1.0, 2.0, 3.0]
+send_msg(cl, {"t": "result", "dispatch": 0, "steps": 3, "eval_s": 0.01},
+         {"fitness": np.ones(4, np.float32)})
+got = ([], [], [])
+while not got[0] and time.monotonic() < deadline:
+    got = coord.poll(0.2)
+assert got[0] and got[0][0]["dispatch"] == 0, got
+coord.close()
+cl.close()
+print("ELASTIC_COORD_OK", flush=True)
+""" % (_ELASTIC_WORKER,)
+
+_ELASTIC_STAGES = (
+    ("ELASTIC_INIT_OK", "distributed-init"),
+    ("ELASTIC_MESH_OK", "mesh-build"),
+    ("ELASTIC_PSUM_OK", "cross-process-psum"),
+    ("ELASTIC_COORD_OK", "coordinator-roundtrip"),
+)
+
+
+def classify_elastic_probe(out: str, timed_out: bool, returncode
+                           ) -> tuple[str, str | None]:
+    """(status, failed-stage) from the elastic probe's markers — pure,
+    so the taxonomy is unit-testable without spawning a fleet."""
+    markers = {ln.split()[0] for ln in out.splitlines() if ln.strip()}
+    if "ELASTIC_COORD_OK" in markers and not timed_out and returncode == 0:
+        return "ok", None
+    for marker, stage in _ELASTIC_STAGES:
+        if marker not in markers:
+            return "failed", stage
+    return "failed", "coordinator-roundtrip"
+
+
+def check_elastic(timeout_s: float = 120.0) -> dict:
+    """Can the elastic multi-host path run here?  Findings, never
+    tracebacks: a staged subprocess brings up a REAL 2-process
+    ``jax.distributed`` job over loopback, builds the cross-process
+    mesh, runs one cross-process psum, then round-trips the elastic
+    coordinator protocol — the first missing marker names the failing
+    layer (no Gloo, broken loopback, protocol regression, ...)."""
+    import os
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    run = _run_staged_probe(_ELASTIC_PROBE, timeout_s, env)
+    status, stage = classify_elastic_probe(run["out"], run["timed_out"],
+                                           run["returncode"])
+    result: dict = {
+        "status": status,
+        "elapsed_s": run["elapsed_s"],
+        "timeout_s": timeout_s,
+    }
+    if status != "ok":
+        result["failed_stage"] = stage
+        result["timed_out"] = run["timed_out"]
+        result["stderr_tail"] = run["err"][-500:]
+    if run["unreapable"]:
+        result["unreapable_child"] = True
+    return result
+
+
 def check_native_pool() -> dict:
     """Is the C++ env pool built/loadable, or will pools fall back to NumPy?"""
     try:
@@ -1060,6 +1243,7 @@ def report(timeout_s: float = 45.0, run_dir: str | None = None,
         "device_probe": probe,
         "native": check_native_pool(),
         "mesh": check_mesh(),
+        "elastic": check_elastic(),
         "scenarios": check_scenarios(),
         "optional": check_optional_deps(),
         "host": check_host(),

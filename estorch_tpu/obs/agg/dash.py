@@ -164,6 +164,14 @@ def fleet_snapshot(store_root: str, *, window_s: float = 60.0,
             "queue_depth": latest("estorch_queue_depth"),
             "recompiles": store.increase("estorch_recompiles", labels,
                                          window_s, now),
+            # elastic multi-host coordinators (docs/multihost.md) export
+            # membership + per-host fold-latency gauges; training runs
+            # without a fleet — and every serve target — honestly lack
+            # them and render '-'
+            "elastic_hosts": latest("estorch_elastic_hosts"),
+            "host_fold_p99_s": latest("estorch_elastic_fold_p99_worst_s"),
+            "hosts_lost": store.increase("estorch_hosts_lost", labels,
+                                         window_s, now),
             "router": router,
             "alerts": sorted(rule for (rule, tgt) in active
                              if tgt == name),
@@ -182,8 +190,8 @@ def render(store_root: str, *, window_s: float = 60.0,
     snap = fleet_snapshot(store_root, window_s=window_s, now=now,
                           store=store)
     header = ("target", "up", "gen", "cold", "req p50/p99 ms",
-              "disp p99 ms", "queue", "recomp", "brk", "retry", "hedge",
-              "repl p99", "alerts")
+              "disp p99 ms", "hosts", "host p99 ms", "queue", "recomp",
+              "brk", "retry", "hedge", "repl p99", "alerts")
     table = [header]
     for row in snap["targets"]:
         # cold: startup seconds, suffixed ! when the replica paid fresh
@@ -213,6 +221,15 @@ def render(store_root: str, *, window_s: float = 60.0,
             repl_p99 = _fmt_ms(ro["worst_p99_s"])
         else:
             brk = retry = hedge = repl_p99 = "-"
+        # hosts: elastic membership count, suffixed !N when N host
+        # deaths landed inside the window (a shrinking fleet should
+        # jump out of the table the way open breakers do)
+        hosts = "-"
+        if row.get("elastic_hosts") is not None:
+            hosts = _fmt_num(row["elastic_hosts"])
+            lost = row.get("hosts_lost")
+            if lost:
+                hosts += f"!{int(lost)}"
         table.append((
             row["target"],
             "UP" if row["up"] else "DOWN",
@@ -220,6 +237,8 @@ def render(store_root: str, *, window_s: float = 60.0,
             cold,
             f"{_fmt_ms(row['req_p50_s'])} / {_fmt_ms(row['req_p99_s'])}",
             _fmt_ms(row["dispatch_p99_s"]),
+            hosts,
+            _fmt_ms(row["host_fold_p99_s"]),
             _fmt_num(row["queue_depth"]),
             _fmt_num(row["recompiles"]),
             brk, retry, hedge, repl_p99,

@@ -58,6 +58,9 @@ GAUGE_NAMES = frozenset({
     "first_request_s",
     "compiles_at_load",
     "warm_cache_hits",
+    # elastic multi-host membership (algo/scheduler.py _HostSource):
+    # live-host count is a level, not a monotone count
+    "elastic_hosts",
 })
 
 _METRIC_OK = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
@@ -73,7 +76,9 @@ def is_gauge(name: str) -> bool:
     from the ledger, hence gauges."""
     return (name in GAUGE_NAMES
             or name.endswith(("_last", "_depth"))
-            or name.startswith(("peak_", "compile_")))
+            # per-host fold-latency p99s (elastic_fold_p99_s_h<i> +
+            # the worst-host rollup): last-write quantile snapshots
+            or name.startswith(("peak_", "compile_", "elastic_fold_p99")))
 
 
 def metric_name(name: str) -> str:

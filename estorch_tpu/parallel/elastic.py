@@ -1,0 +1,744 @@
+"""Elastic multi-host ES — async importance-weighted folding across hosts.
+
+``multihost.py`` scales by fully-synchronous SPMD: every host steps the
+same program and the psum is a fleet-wide barrier, so one straggling
+host stalls every generation (and a dead host kills the job).  This
+module is the other regime (ROADMAP item 3, docs/multihost.md): hosts
+are independent JAX processes — no ``jax.distributed``, no global mesh —
+joined to a COORDINATOR over stdlib TCP.  Each host runs the generation
+program (replicated, or the PR-7 sharded program over its local mesh) as
+an async *source*: the coordinator assigns it whole-population
+dispatches, the host evaluates them under the center it was told and
+sends back the (population,) fitness contribution, and the scheduler
+(algo/scheduler.py::ElasticScheduler) folds arrivals with the clipped
+importance weights the worker-level fold already uses — a slow host's
+results arrive stale and fold with λ < 1 instead of stalling the fleet;
+a dead host's in-flight dispatches are counted ``results_lost`` and
+replaced.  Only O(dim) floats cross the wire per update (the center;
+never the optimizer state, the noise, or the population).
+
+Membership is ELASTIC: a host may join mid-run (it syncs center +
+version from the coordinator and starts contributing — dispatch ids
+keep flowing from the coordinator's single counter, so noise
+coordinates are never reused) and may leave at any time (TCP EOF is the
+death signal; SIGKILL closes the socket).  Every transition lands on
+the scheduler's event log (``membership``) and the obs hub
+(``hosts_joined``/``hosts_lost`` counters, ``elastic_hosts`` gauge,
+per-host ``elastic/h<i>/fold_s`` latency distributions), and
+``replay=log`` stays bit-exact because replay is pure math over the
+recorded dispatches/updates — membership explains the schedule, it does
+not re-drive it.
+
+Wire protocol (every socket operation timed — esguard R17): framed
+messages of a JSON header plus raw float32/float64 array payloads;
+message types ``join``/``sync``/``center``/``dispatch``/``result``/
+``close``.  Chaos (resilience/chaos.py): ``straggle_host`` sleeps in
+the host's evaluate loop keyed on (dispatch, host); ``kill_host``
+SIGKILLs a subprocess host (a thread-simulated host drops its
+connection — same observable death), both on the once-semantics ledger.
+
+Launch recipe (one command per host; docs/multihost.md):
+
+    # coordinator (also the training driver)
+    coord = ElasticCoordinator()                 # prints host:port
+    es = es_from_spec(spec)                      # device backend
+    es.train_elastic(n, fleet=coord)
+
+    # each host, any time before or DURING the run:
+    python -m estorch_tpu.parallel.elastic --join HOST:PORT \
+        --spec spec.json --host 1
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import signal
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+
+# bounded slice for every blocking point (accept/recv/inbox get): the
+# loops must wake to notice shutdown and dead peers, never sleep
+# unbounded (esguard R11/R17 — mechanized as unfenced-cross-host-barrier)
+POLL_SLICE_S = 0.05
+# sends get their OWN deadline, far above the recv poll slice: the
+# socket's 50ms timeout also applies to send(), and a busy-but-alive
+# peer (mid-evaluation, not draining) can easily take longer than one
+# slice to accept a real model's O(dim) center — only a peer that
+# accepts NOTHING for this long is declared dead
+SEND_DEADLINE_S = 60.0
+PROTO_VERSION = 1
+_HDR = struct.Struct(">I")
+_MAX_HEADER = 1 << 20
+
+
+def _socket_close(sock) -> None:
+    """Teardown-quiet close (R08: close paths may swallow OSError)."""
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
+class ElasticError(RuntimeError):
+    """Protocol violation or a dead coordinator/host connection."""
+
+
+class _Killed(Exception):
+    """A chaos ``kill_host`` in a thread-simulated host (a subprocess
+    host SIGKILLs itself instead)."""
+
+
+# ---------------------------------------------------------------------
+# framed message protocol
+# ---------------------------------------------------------------------
+
+
+def send_msg(sock: socket.socket, header: dict,
+             arrays: dict[str, np.ndarray] | None = None,
+             deadline_s: float = SEND_DEADLINE_S) -> None:
+    """One framed message: 4-byte length + JSON header + raw buffers.
+    The header lists ``arrays`` as [name, dtype, shape] so the receiver
+    can slice them back without pickling anything.  Sent in timed
+    slices against ``deadline_s`` (the socket's own timeout is the recv
+    poll slice — one slice is NOT long enough for a large frame to a
+    peer that is busy evaluating), raising ``TimeoutError`` when the
+    peer accepts nothing for the whole deadline."""
+    arrays = arrays or {}
+    specs = []
+    bufs = []
+    for name, arr in arrays.items():
+        arr = np.ascontiguousarray(arr)
+        specs.append([name, str(arr.dtype), list(arr.shape)])
+        bufs.append(arr.tobytes())
+    head = json.dumps({**header, "_arrays": specs}).encode()
+    view = memoryview(_HDR.pack(len(head)) + head + b"".join(bufs))
+    deadline = time.monotonic() + deadline_s
+    while view:
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"peer not draining ({len(view)} bytes unsent)")
+        try:
+            sent = sock.send(view)
+        except socket.timeout:
+            continue  # no buffer space this slice; the deadline bounds us
+        view = view[sent:]
+
+
+def _recv_exact(sock: socket.socket, n: int, deadline: float) -> bytes:
+    """Read exactly n bytes in timed slices; raises on EOF or deadline.
+    The socket must already carry a timeout (set at connect/accept)."""
+    chunks = []
+    got = 0
+    while got < n:
+        if time.monotonic() > deadline:
+            raise TimeoutError(f"peer silent mid-message ({got}/{n} bytes)")
+        try:
+            chunk = sock.recv(min(n - got, 1 << 20))
+        except socket.timeout:
+            continue
+        if not chunk:
+            raise ElasticError("connection closed mid-message")
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)
+
+
+def recv_msg(sock: socket.socket, timeout_s: float
+             ) -> tuple[dict, dict[str, np.ndarray]] | None:
+    """One framed message, or None when nothing arrived within
+    ``timeout_s`` (the caller's poll slice).  A peer that starts a frame
+    must finish it within the message deadline below, so a half-written
+    frame cannot wedge the reader (esguard R17)."""
+    deadline = time.monotonic() + timeout_s
+    head_len = None
+    while head_len is None:
+        if time.monotonic() > deadline:
+            return None
+        try:
+            first = sock.recv(_HDR.size)
+        except socket.timeout:
+            continue
+        if not first:
+            raise ElasticError("connection closed")
+        if len(first) < _HDR.size:
+            first += _recv_exact(sock, _HDR.size - len(first),
+                                 time.monotonic() + 30.0)
+        head_len = _HDR.unpack(first)[0]
+    if head_len > _MAX_HEADER:
+        raise ElasticError(f"oversized header ({head_len} bytes)")
+    msg_deadline = time.monotonic() + 60.0
+    header = json.loads(_recv_exact(sock, head_len, msg_deadline).decode())
+    arrays: dict[str, np.ndarray] = {}
+    for name, dtype, shape in header.pop("_arrays", []):
+        n_bytes = int(np.dtype(dtype).itemsize * int(np.prod(shape or [1])))
+        buf = _recv_exact(sock, n_bytes, msg_deadline)
+        arrays[name] = np.frombuffer(buf, dtype=dtype).reshape(shape)
+    return header, arrays
+
+
+# ---------------------------------------------------------------------
+# coordinator
+# ---------------------------------------------------------------------
+
+
+class _HostConn:
+    def __init__(self, hid: int, conn: socket.socket):
+        self.hid = hid
+        self.conn = conn
+        self.send_lock = threading.Lock()
+        self.inflight: set[int] = set()
+        self.alive = True
+        self.synced = False  # sync sent — only then routable/broadcast
+        self.last_dispatch_t = 0.0
+
+
+class ElasticCoordinator:
+    """Membership + dispatch routing + center broadcast for an elastic
+    host fleet.  One instance serves one training driver (usually the
+    process calling ``es.train_elastic``); the scheduler talks to it
+    through :class:`~estorch_tpu.algo.scheduler._HostSource`.
+
+    Threads: one acceptor (timed ``accept`` loop) plus one reader per
+    joined host (timed ``recv`` loop feeding the inbox).  All state
+    transitions funnel through the inbox so the scheduler's single
+    ``poll`` consumer sees joins/results/leaves in one ordered stream.
+    """
+
+    def __init__(self, listen_host: str = "127.0.0.1", port: int = 0,
+                 join_grace_s: float = 120.0):
+        self.join_grace_s = float(join_grace_s)
+        self._srv = socket.socket()
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((listen_host, port))
+        self._srv.listen(16)
+        self._srv.settimeout(POLL_SLICE_S)
+        self.address = self._srv.getsockname()
+        self._inbox: queue.Queue = queue.Queue()
+        self._hosts: dict[int, _HostConn] = {}
+        self._lock = threading.Lock()
+        self._next_hid = 0
+        self._center: np.ndarray | None = None
+        self._sigma: float | None = None
+        self._version = 0
+        self._stop = threading.Event()
+        self._threads = [threading.Thread(target=self._acceptor,
+                                          daemon=True)]
+        self._threads[0].start()
+
+    # ---------------------------------------------------------- center
+
+    def push_center(self, version: int, center: np.ndarray,
+                    sigma: float) -> None:
+        """One update happened: record it and broadcast the O(dim)
+        center to every live host (TCP ordering guarantees a later
+        dispatch naming ``version`` finds the center already there)."""
+        center = np.asarray(center, np.float32)
+        with self._lock:
+            self._version = int(version)
+            self._center = center.copy()
+            self._sigma = float(sigma)
+            targets = [h for h in self._hosts.values()
+                       if h.alive and h.synced]
+        for h in targets:
+            self._send(h, {"t": "center", "version": int(version),
+                           "sigma": float(sigma)}, {"center": center})
+
+    # -------------------------------------------------------- dispatch
+
+    def n_live(self) -> int:
+        with self._lock:
+            return sum(1 for h in self._hosts.values()
+                       if h.alive and h.synced)
+
+    def dispatch(self, dispatch: int, version: int) -> int | None:
+        """Route one dispatch to the least-loaded live host; blocks in
+        poll slices up to ``join_grace_s`` for a host to exist (elastic
+        start: the driver may begin before the first host finishes its
+        jax import).  Returns the host id, or None when the grace
+        expired with no live host (the scheduler's dry-out guard turns
+        that into a diagnosis)."""
+        deadline = time.monotonic() + self.join_grace_s
+        while not self._stop.is_set():
+            # least loaded first; ties go to the host idle LONGEST.  A
+            # fast host answers inside one poll slice, so at decision
+            # time every host often shows zero in-flight — a
+            # lowest-id tie-break would then starve every other host
+            # (and a declared-slow host that never receives work can
+            # never exercise the stale fold it exists to absorb)
+            with self._lock:
+                live = sorted((len(h.inflight), h.last_dispatch_t, h.hid)
+                              for h in self._hosts.values()
+                              if h.alive and h.synced)
+            if live:
+                hid = live[0][2]
+                with self._lock:
+                    h = self._hosts.get(hid)
+                    if h is not None and h.alive:
+                        h.inflight.add(int(dispatch))
+                        h.last_dispatch_t = time.monotonic()
+                ok = h is not None and self._send(
+                    h, {"t": "dispatch", "dispatch": int(dispatch),
+                        "version": int(version)})
+                if ok:
+                    return hid
+                # send failed: mark dead NOW (the reader's leave event
+                # still owns the loss/membership accounting) so the next
+                # iteration cannot spin on the same corpse — the
+                # dispatch was never delivered, try the next host
+                with self._lock:
+                    if h is not None:
+                        h.inflight.discard(int(dispatch))
+                        h.alive = False
+                continue
+            if time.monotonic() > deadline:
+                return None
+            time.sleep(POLL_SLICE_S)
+        return None
+
+    def poll(self, timeout_s: float
+             ) -> tuple[list[dict], list[tuple[int, int]], list[dict]]:
+        """Drain the inbox: (results, lost (dispatch, host) pairs,
+        membership transitions).  One bounded wait, then everything
+        already buffered."""
+        results: list[dict] = []
+        lost: list[tuple[int, int]] = []
+        membership: list[dict] = []
+        wait = timeout_s
+        while True:
+            try:
+                kind, hid, payload = self._inbox.get(timeout=wait)
+            except queue.Empty:
+                break
+            wait = 0.0
+            if kind == "result":
+                h = payload.pop("_conn")
+                with self._lock:
+                    h.inflight.discard(int(payload["dispatch"]))
+                results.append(payload)
+            elif kind == "join":
+                membership.append({"event": "join", "host": hid})
+            elif kind == "leave":
+                h = payload  # the dying conn (reader enqueues itself)
+                with self._lock:
+                    pending = sorted(h.inflight)
+                    h.alive = False
+                    h.inflight.clear()
+                lost.extend((d, hid) for d in pending)
+                membership.append({"event": "leave", "host": hid})
+        return results, lost, membership
+
+    # ------------------------------------------------------- internals
+
+    def _send(self, h: _HostConn, header: dict,
+              arrays: dict[str, np.ndarray] | None = None) -> bool:
+        try:
+            with h.send_lock:
+                send_msg(h.conn, header, arrays)
+            return True
+        except OSError:
+            # a failed (or timed-out) send may have left a PARTIAL
+            # frame on the wire — the stream is unusable, so a send
+            # failure IS the connection's death: close it now (the
+            # reader's EOF posts the leave that owns the loss and
+            # membership accounting)
+            h.alive = False
+            _socket_close(h.conn)
+            return False
+
+    def _acceptor(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _addr = self._srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            conn.settimeout(POLL_SLICE_S)
+            threading.Thread(target=self._handshake, args=(conn,),
+                             daemon=True).start()
+
+    def _handshake(self, conn: socket.socket) -> None:
+        try:
+            got = None
+            deadline = time.monotonic() + 30.0
+            while got is None:
+                if time.monotonic() > deadline or self._stop.is_set():
+                    conn.close()
+                    return
+                got = recv_msg(conn, POLL_SLICE_S)
+            header, _ = got
+            if header.get("t") != "join":
+                conn.close()
+                return
+        except (ElasticError, OSError, ValueError):
+            conn.close()
+            return
+        with self._lock:
+            want = header.get("host")
+            hid = int(want) if want is not None else self._next_hid
+            while hid in self._hosts and self._hosts[hid].alive:
+                hid += 1  # duplicate index → next free (ids stay unique)
+            self._next_hid = max(self._next_hid, hid + 1)
+            h = _HostConn(hid, conn)
+            # reserve the id NOW (two concurrent joins asking for the
+            # same index must both see the other's claim); the host
+            # stays un-routable and un-broadcast until synced
+            self._hosts[hid] = h
+            center = self._center
+            sync_version = self._version
+            sync = {"t": "sync", "host": hid, "proto": PROTO_VERSION,
+                    "version": sync_version,
+                    "sigma": self._sigma if self._sigma is not None
+                    else 0.0}
+        # sync BEFORE the host becomes routable: a dispatch can never
+        # overtake the center it references (single writer per conn)
+        if not self._send(h, sync, {"center": center}
+                          if center is not None else None):
+            with self._lock:
+                if self._hosts.get(hid) is h:
+                    del self._hosts[hid]  # release the reservation
+            _socket_close(conn)
+            return
+        # catch the host up to any center its handshake window skipped,
+        # BEFORE it becomes routable: a dispatch naming version v must
+        # never overtake center v on this connection.  Loop until the
+        # version is stable across a send — `h.synced = True` happens
+        # under the same lock that reads the version, so a concurrent
+        # push_center either already included this host in its broadcast
+        # or left a version bump this loop re-sends.  (The seed center
+        # keeps version 0 — same as an empty sync — so "host has no
+        # center yet" is its own catch-up condition, not a version gap.)
+        sent_version = sync_version if center is not None else None
+        while True:
+            with self._lock:
+                cur_version = self._version
+                cur_center = self._center
+                cur_sigma = self._sigma
+                if cur_center is None or sent_version == cur_version:
+                    h.synced = True
+                    break
+            if not self._send(h, {"t": "center",
+                                  "version": int(cur_version),
+                                  "sigma": float(cur_sigma)},
+                              {"center": cur_center}):
+                with self._lock:
+                    if self._hosts.get(hid) is h:
+                        del self._hosts[hid]  # release the reservation
+                _socket_close(conn)
+                return
+            sent_version = cur_version
+        self._inbox.put(("join", hid, None))
+        t = threading.Thread(target=self._reader, args=(h,), daemon=True)
+        self._threads.append(t)
+        t.start()
+
+    def _reader(self, h: _HostConn) -> None:
+        try:
+            while not self._stop.is_set():
+                try:
+                    got = recv_msg(h.conn, POLL_SLICE_S)
+                except (ElasticError, OSError, TimeoutError, ValueError):
+                    break
+                if got is None:
+                    continue
+                header, arrays = got
+                if header.get("t") == "result":
+                    # _conn: like the leave path, the result must settle
+                    # its bookkeeping on THE CONNECTION that produced it
+                    # — a same-id rejoin may have replaced the table
+                    # entry, and discarding on the new conn would leave
+                    # the dispatch to be double-counted as lost
+                    self._inbox.put(("result", h.hid, {
+                        "dispatch": int(header["dispatch"]),
+                        "host": h.hid,
+                        "fitness": arrays["fitness"],
+                        "steps": int(header.get("steps", 0)),
+                        "eval_s": float(header.get("eval_s", 0.0)),
+                        "_conn": h,
+                    }))
+                elif header.get("t") == "bye":
+                    break
+        finally:
+            # the leave carries the dying _HostConn itself: a host that
+            # died and REJOINED under the same id before this drains
+            # must not have its fresh connection killed by the stale
+            # leave (poll mutates the payload conn, never the table's)
+            self._inbox.put(("leave", h.hid, h))
+            _socket_close(h.conn)
+
+    def close(self) -> None:
+        self._stop.set()
+        with self._lock:
+            hosts = list(self._hosts.values())
+        for h in hosts:
+            self._send(h, {"t": "close"})
+            _socket_close(h.conn)
+        _socket_close(self._srv)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+# ---------------------------------------------------------------------
+# host worker
+# ---------------------------------------------------------------------
+
+
+class HostWorker:
+    """One elastic host: joins a coordinator, evaluates dispatched
+    populations with its OWN engine (replicated device, or the PR-7
+    sharded generation program when the ES was built ``shard_params=
+    True``), and streams back (population,) fitness contributions.
+
+    The worker never sees the optimizer or other hosts — its whole
+    world is (center, sigma, version) pushes and dispatch ids; the
+    noise regenerates from the shared table via ``(key, dispatch)``
+    exactly as on the coordinator."""
+
+    def __init__(self, address: tuple[str, int], es, host_index: int,
+                 simulate_kill: bool = False):
+        self.address = (str(address[0]), int(address[1]))
+        self.es = es
+        self.host_index = int(host_index)
+        self.simulate_kill = bool(simulate_kill)
+        self._stop = threading.Event()
+        self._center: np.ndarray | None = None
+        self._sigma: float | None = None
+        self._version = -1
+        self.dispatches_done = 0
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    # ------------------------------------------------------------ run
+
+    def run(self, connect_timeout_s: float = 30.0,
+            sync_timeout_s: float = 120.0) -> None:
+        import jax.numpy as jnp  # noqa: F401 — the engine is jax-backed
+
+        from ..resilience.chaos import host_fault
+
+        sock = socket.create_connection(self.address,
+                                        timeout=connect_timeout_s)
+        sock.settimeout(POLL_SLICE_S)
+        self._sock = sock
+        try:
+            send_msg(sock, {"t": "join", "host": self.host_index,
+                            "proto": PROTO_VERSION})
+            deadline = time.monotonic() + sync_timeout_s
+            got = None
+            while got is None:
+                if time.monotonic() > deadline:
+                    raise ElasticError("coordinator never answered JOIN")
+                got = recv_msg(sock, POLL_SLICE_S)
+            header, arrays = got
+            if header.get("t") != "sync":
+                raise ElasticError(f"expected sync, got {header.get('t')!r}")
+            self.host_index = int(header["host"])
+            self._version = int(header["version"])
+            if "center" in arrays:
+                self._center = np.asarray(arrays["center"], np.float32)
+                self._sigma = float(header["sigma"])
+            self._warm()
+            while not self._stop.is_set():
+                try:
+                    got = recv_msg(sock, POLL_SLICE_S)
+                except (ElasticError, OSError):
+                    return  # coordinator gone: the run is over for us
+                if got is None:
+                    continue
+                header, arrays = got
+                t = header.get("t")
+                if t == "center":
+                    self._center = np.asarray(arrays["center"], np.float32)
+                    self._sigma = float(header["sigma"])
+                    self._version = int(header["version"])
+                elif t == "dispatch":
+                    d = int(header["dispatch"])
+                    if host_fault(d, self.host_index):
+                        self._die()
+                    fitness, steps, eval_s = self._evaluate(d)
+                    try:
+                        send_msg(sock, {"t": "result", "dispatch": d,
+                                        "steps": int(steps),
+                                        "eval_s": float(eval_s)},
+                                 {"fitness": np.asarray(fitness,
+                                                        np.float32)})
+                    except OSError:
+                        return  # coordinator gone mid-result: run over
+                    self.dispatches_done += 1
+                elif t == "close":
+                    return
+        except _Killed:
+            return  # simulated SIGKILL: socket closed abruptly below
+        finally:
+            _socket_close(sock)
+
+    # ------------------------------------------------------- internals
+
+    def _die(self):
+        """kill_host: a subprocess host dies for real (SIGKILL closes
+        the socket, which IS the membership-leave signal); a simulated
+        (in-thread) host reproduces the observable part — abrupt close."""
+        if self.simulate_kill:
+            _socket_close(self._sock)
+            raise _Killed()
+        os.kill(os.getpid(), signal.SIGKILL)
+
+    def _state_for(self, dispatch: int):
+        import jax.numpy as jnp
+
+        es = self.es
+        if self._center is None:
+            raise ElasticError("dispatch before any center sync")
+        if getattr(es, "_shard_params", False):
+            # the sharded program DONATES its input state — rebuild a
+            # fresh one from the synced center each dispatch (the
+            # discarded in-program update also consumed the buffers)
+            st = es.engine.init_state(jnp.asarray(self._center),
+                                      es.state.key)
+            return st._replace(
+                generation=jnp.asarray(int(dispatch), jnp.int32),
+                sigma=jnp.asarray(self._sigma, jnp.float32))
+        return es.state._replace(
+            params_flat=jnp.asarray(self._center),
+            sigma=jnp.asarray(self._sigma, jnp.float32),
+            generation=jnp.asarray(int(dispatch), jnp.int32))
+
+    def _evaluate(self, dispatch: int):
+        t0 = time.perf_counter()
+        es = self.es
+        st = self._state_for(dispatch)
+        if getattr(es, "_shard_params", False):
+            # sharded-program-as-source: run the fused generation and
+            # keep only the fitness — the update it computed is the
+            # coordinator's job, not ours
+            _new, metrics = es.engine.generation_step(st)
+            fitness = np.asarray(metrics["fitness"], np.float32)
+            steps = int(np.asarray(metrics["steps"]))
+        else:
+            ev = es.engine.evaluate(st)
+            fitness = np.asarray(ev.fitness, np.float32)
+            steps = int(np.asarray(ev.steps))
+        return fitness, steps, time.perf_counter() - t0
+
+    def _warm(self) -> None:
+        """Compile the evaluation program BEFORE accepting dispatches so
+        the first real dispatch is not a multi-second compile outlier in
+        the coordinator's latency accounting."""
+        if self._center is None:
+            return
+        try:
+            self._evaluate(0)
+        except Exception:  # noqa: BLE001 — warmth is best-effort
+            self.es.obs.event("elastic_warm_failed", host=self.host_index)
+
+
+def run_host_thread(address: tuple[str, int], es, host_index: int
+                    ) -> tuple[HostWorker, threading.Thread]:
+    """An in-process 'simulated host' (tests, single-machine demos): its
+    own engine instance over the same virtual devices, joined through a
+    real loopback socket — everything but the separate interpreter."""
+    worker = HostWorker(address, es, host_index, simulate_kill=True)
+    t = threading.Thread(target=worker.run, daemon=True,
+                         name=f"elastic-host-{host_index}")
+    t.start()
+    return worker, t
+
+
+# ---------------------------------------------------------------------
+# spec → ES (the subprocess-host / bench entry)
+# ---------------------------------------------------------------------
+
+
+def es_from_spec(spec: dict, mesh=None):
+    """Build the demo-family ES a spec JSON names — the shared recipe of
+    the coordinator, every subprocess host, and both ``--elastic-ab``
+    legs (same seed ⇒ same table ⇒ same noise coordinates everywhere).
+    ``mesh`` threads a caller-built device mesh through (the sync-SPMD
+    leg passes ``multihost.global_population_mesh()``)."""
+    from ..utils.backend import (enable_compilation_cache,
+                                 force_cpu_backend)
+
+    if spec.get("cpu_devices"):
+        force_cpu_backend(int(spec["cpu_devices"]))
+    if spec.get("compilation_cache", True):
+        enable_compilation_cache()
+    import optax
+
+    from .. import ES, JaxAgent, MLPPolicy
+    from .. import envs as envs_mod
+
+    env = getattr(envs_mod, spec.get("env", "CartPole"))()
+    kw = dict(
+        policy=MLPPolicy,
+        agent=JaxAgent,
+        optimizer=optax.adam,
+        population_size=int(spec.get("population_size", 16)),
+        sigma=float(spec.get("sigma", 0.1)),
+        policy_kwargs=dict(spec.get("policy_kwargs")
+                           or {"action_dim": env.action_dim,
+                               "hidden": (8,), "discrete": True}),
+        agent_kwargs={"env": env,
+                      "horizon": int(spec.get("horizon", 64))},
+        optimizer_kwargs={"learning_rate": float(spec.get("lr", 1e-2))},
+        seed=int(spec.get("seed", 7)),
+        table_size=int(spec.get("table_size", 1 << 18)),
+        telemetry=bool(spec.get("telemetry", True)),
+    )
+    if spec.get("eval_chunk"):
+        kw["eval_chunk"] = int(spec["eval_chunk"])
+    if spec.get("shard"):
+        kw.update(shard_params=True, noise_mode="table")
+        if spec.get("model_shards"):
+            kw["model_shards"] = int(spec["model_shards"])
+    if mesh is not None:
+        kw["mesh"] = mesh
+    return ES(**kw)
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="python -m estorch_tpu.parallel.elastic",
+        description="join an elastic ES coordinator as one host "
+                    "(docs/multihost.md)")
+    p.add_argument("--join", required=True, metavar="HOST:PORT")
+    p.add_argument("--spec", required=True,
+                   help="JSON file (or inline JSON) naming the ES config "
+                        "— must match the coordinator's (same seed)")
+    p.add_argument("--host", type=int, default=None,
+                   help="host index (chaos plans key on it); default: "
+                        "coordinator-assigned")
+    args = p.parse_args(argv)
+    text = args.spec
+    if os.path.exists(text):
+        with open(text) as f:
+            text = f.read()
+    spec = json.loads(text)
+    es = es_from_spec(spec)
+    host, port = args.join.rsplit(":", 1)
+    idx = (args.host if args.host is not None
+           else 10_000 + (os.getpid() % 10_000))
+    worker = HostWorker((host, int(port)), es, idx)
+    worker.run()
+    print(json.dumps({"host": worker.host_index,
+                      "dispatches_done": worker.dispatches_done}),
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -21,6 +21,9 @@ JSON, so forked/spawned children inherit the same plan):
         {"kind": "nan_update",  "gen": 2},
         {"kind": "die",         "gen": 12},
         {"kind": "wedge",       "gen": 2, "sleep_s": 300.0},
+        {"kind": "straggle_host", "gen": 3, "host": 1, "sleep_s": 0.5,
+         "jitter_s": 0.2},
+        {"kind": "kill_host",     "gen": 6, "host": 1},
         {"kind": "kill_replica",  "at_s": 2.0, "replica": 1},
         {"kind": "wedge_replica", "at_s": 4.0, "replica": 0}
      ],
@@ -47,6 +50,15 @@ die             SIGKILL of the WHOLE process (resilience.run_resilient
                 loop head) — exercises the Supervisor restart path
 wedge           a long un-heartbeated sleep at the same point —
                 exercises the Supervisor's staleness watchdog
+straggle_host   in an elastic multi-host run (parallel/elastic.py):
+                host ``host`` sleeps before evaluating the dispatch
+                whose id equals ``gen`` — the whole HOST is slow, the
+                hazard the async host fold exists to absorb; sleep_s/
+                jitter_s as for ``straggler``
+kill_host       SIGKILL of elastic host ``host`` at dispatch ``gen``
+                (in a thread-simulated host the worker dies abruptly
+                instead) — exercises loss accounting + membership
+                leave + the coordinator's replacement dispatches
 kill_replica    SIGKILL of serving replica ``replica`` (fleet monitor,
                 serve/fleet.py) — exercises router failover + respawn
 wedge_replica   SIGSTOP of serving replica ``replica`` — alive process,
@@ -87,6 +99,8 @@ KINDS = (
     "ckpt_crash",
     "die",
     "wedge",
+    "straggle_host",
+    "kill_host",
     "kill_replica",
     "wedge_replica",
 )
@@ -163,6 +177,10 @@ class ChaosPlan:
         straggler_every: int = 0,
         straggler_sleep_s: float = 1.0,
         straggler_jitter_s: float = 0.0,
+        straggle_host_every: int = 0,
+        straggle_host: int = 0,
+        straggle_host_sleep_s: float = 1.0,
+        straggle_host_jitter_s: float = 0.0,
     ) -> "ChaosPlan":
         """Seeded random plan — deterministic in ``seed``: the same seed
         always schedules the same faults at the same points.
@@ -189,6 +207,16 @@ class ChaosPlan:
                       "sleep_s": float(straggler_sleep_s)}
                 if straggler_jitter_s > 0.0:
                     ev["jitter_s"] = float(straggler_jitter_s)
+                events.append(ev)
+            if straggle_host_every and g % straggle_host_every == 0:
+                # one declared slow HOST (elastic multi-host / sync
+                # multihost A/B — bench.py --elastic-ab): the same plan
+                # stalls the same host by the same amounts in both legs
+                ev = {"kind": "straggle_host", "gen": g,
+                      "host": int(straggle_host),
+                      "sleep_s": float(straggle_host_sleep_s)}
+                if straggle_host_jitter_s > 0.0:
+                    ev["jitter_s"] = float(straggle_host_jitter_s)
                 events.append(ev)
             if p_rollout_exc and rng.random() < p_rollout_exc:
                 events.append(
@@ -330,6 +358,40 @@ def member_fault(generation, member: int) -> None:
             raise ChaosError(
                 f"injected rollout exception (gen {gen}, member {member})"
             )
+
+
+def _matches_host(ev: dict, host: int) -> bool:
+    h = ev.get("host", "all")
+    if h == "all":
+        return True
+    if isinstance(h, (list, tuple)):
+        return int(host) in [int(x) for x in h]
+    return int(h) == int(host)
+
+
+def host_fault(dispatch, host: int) -> bool:
+    """Host-granular faults for one (dispatch, host) in an elastic
+    multi-host run (parallel/elastic.py) — and, symmetrically, for one
+    (generation, process) in the synchronous multihost loop, where the
+    SPMD barrier makes one host's stall everyone's stall (that contrast
+    is exactly what ``bench.py --elastic-ab`` measures).
+
+    ``straggle_host`` sleeps (sleep_s + the deterministic event-id-seeded
+    jitter, like ``straggler``); returns True when a ``kill_host`` event
+    fired — the CALLER owns the death (a subprocess host SIGKILLs itself,
+    a thread-simulated host drops its coordinator connection), because
+    only it knows what dying means in its medium."""
+    plan = active_plan()
+    if plan is None:
+        return False
+    gen = int(dispatch)
+    for ev in plan.events_at(gen, "straggle_host"):
+        if _matches_host(ev, host) and plan.fire(ev):
+            time.sleep(straggler_sleep_s(ev))
+    return any(
+        plan.fire(ev) for ev in plan.events_at(gen, "kill_host")
+        if _matches_host(ev, host)
+    )
 
 
 def mutate_fitness(generation, fitness):

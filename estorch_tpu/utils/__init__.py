@@ -1,5 +1,6 @@
 from .backend import (compile_event_counts, enable_compilation_cache,
-                      force_cpu_backend, install_compile_event_counters,
+                      enable_cpu_gloo_collectives, force_cpu_backend,
+                      install_compile_event_counters,
                       scoped_compilation_cache, set_host_device_count_flag)
 from .checkpoint import (PeriodicCheckpointer, latest_checkpoint,
                          restore_checkpoint, save_checkpoint)
@@ -10,6 +11,7 @@ from .profiler import annotate, timed_generations, trace
 __all__ = [
     "compile_event_counts",
     "enable_compilation_cache",
+    "enable_cpu_gloo_collectives",
     "force_cpu_backend",
     "install_compile_event_counters",
     "scoped_compilation_cache",

@@ -241,6 +241,26 @@ def set_host_device_count_flag(n_devices: int) -> None:
     os.environ["XLA_FLAGS"] = flags
 
 
+def enable_cpu_gloo_collectives() -> bool:
+    """Route CPU cross-process collectives through Gloo — required for
+    ``jax.distributed`` multi-process runs on the CPU backend (the
+    default CPU client answers any cross-process psum with
+    "Multiprocess computations aren't implemented").  Version-portable:
+    jax 0.4.x spells it ``jax_cpu_collectives_implementation``; where
+    only the older boolean exists that is set instead.  Only effective
+    before the backend initializes; returns True when a knob took."""
+    import jax
+
+    for name, value in (("jax_cpu_collectives_implementation", "gloo"),
+                        ("jax_cpu_enable_gloo_collectives", True)):
+        try:
+            jax.config.update(name, value)
+            return True
+        except Exception:  # noqa: BLE001 — knob absent in this version
+            continue
+    return False
+
+
 def force_cpu_backend(n_devices: int = 8) -> bool:
     """Best-effort switch to the CPU backend with ``n_devices`` virtual
     devices.  Returns True if the config took; False if the backend was

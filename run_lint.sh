@@ -80,6 +80,19 @@ echo "== async-ab selfcheck =="
 # or counted — zero silent drops.  Host path only, no device touch.
 python bench.py --async-ab --selfcheck
 
+echo "== elastic-ab selfcheck =="
+# elastic multi-host gate (estorch_tpu/parallel/elastic.py +
+# algo/scheduler.py ElasticScheduler, docs/multihost.md): under an
+# IDENTICAL declared straggle_host plan, the elastic host-granular fold
+# must beat the synchronous 2-process SPMD multihost loop >=1.25x
+# beyond the learned noise band (a slow host costs throughput, the
+# barrier costs the fleet), stale host contributions must actually
+# FOLD with clipped importance weights, and the accounting invariant
+# dispatched == consumed + discarded + lost must hold.  CPU processes
+# over loopback (jax.distributed/Gloo for the sync leg, stdlib TCP for
+# the elastic fleet), ~2 min.
+python bench.py --elastic-ab --selfcheck
+
 echo "== shard-ab selfcheck =="
 # param-sharded gate (estorch_tpu/parallel/sharded.py, docs/sharding.md):
 # a same-seed sharded run must match the replicated fused path allclose

@@ -14,10 +14,13 @@ sitecustomize pins the axon TPU plugin, which can wedge indefinitely.
 import pathlib
 import sys
 
-import jax
+# version-portable CPU pin: jax 0.4.x spells the device count as an
+# XLA_FLAGS entry (the repo's shim), newer jax as jax_num_cpu_devices —
+# force_cpu_backend handles both (the bare config.update bit-rotted on
+# 0.4.37, which lacks the option entirely)
+from estorch_tpu.utils.backend import force_cpu_backend
 
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 4)
+force_cpu_backend(4)
 
 
 def main() -> None:
@@ -27,8 +30,11 @@ def main() -> None:
 
     import estorch_tpu.parallel.multihost as mh
 
+    # Gloo CPU collectives: the default CPU client refuses any
+    # cross-process psum ("Multiprocess computations aren't implemented")
     assert mh.initialize(f"localhost:{port}", num_processes=nprocs,
-                         process_id=pid), "distributed init did not happen"
+                         process_id=pid, cpu_collectives=True), \
+        "distributed init did not happen"
     info = mh.process_info()
     assert info["process_count"] == nprocs
     assert info["global_devices"] == nprocs * 4

@@ -1755,12 +1755,151 @@ def launch(cmd):
 """
 
 
+class TestR17:
+    """unfenced-cross-host-barrier — the R05/R11/R13 family lifted to
+    the host layer (docs/analysis.md)."""
+
+    def test_distributed_initialize_without_timeout_flagged(self):
+        """The motivating hazard: the cluster barrier.  One peer that
+        never dials in hangs EVERY host identically, so no survivor can
+        even name the missing one."""
+        found = findings("""
+            import jax
+
+            def bring_up(addr, n, pid):
+                jax.distributed.initialize(addr, n, pid)
+        """, "R17")
+        assert len(found) == 1
+        assert "initialization_timeout" in found[0].message
+
+    def test_distributed_initialize_timeout_none_flagged(self):
+        found = findings("""
+            import jax
+
+            def bring_up():
+                jax.distributed.initialize(initialization_timeout=None)
+        """, "R17")
+        assert len(found) == 1
+
+    def test_distributed_initialize_with_timeout_clean(self):
+        assert not findings("""
+            import jax
+
+            def bring_up(addr):
+                jax.distributed.initialize(
+                    addr, initialization_timeout=120)
+        """, "R17")
+
+    def test_untimed_accept_flagged(self):
+        found = findings("""
+            import socket
+
+            def serve(srv_sock):
+                conn, addr = srv_sock.accept()
+                return conn
+        """, "R17")
+        assert len(found) == 1
+        assert "accept" in found[0].message
+
+    def test_untimed_socket_recv_flagged(self):
+        """Buffer-sized recv on a socket-ish receiver: the coordinator-
+        socket wait; the argless pipe recv() stays R11's."""
+        found = findings("""
+            def read_result(conn_sock):
+                return conn_sock.recv(65536)
+        """, "R17")
+        assert len(found) == 1
+
+    def test_settimeout_in_scope_clean(self):
+        assert not findings("""
+            import socket
+
+            def serve(srv_sock):
+                srv_sock.settimeout(0.05)
+                conn, addr = srv_sock.accept()
+                return conn
+        """, "R17")
+
+    def test_settimeout_none_not_a_fence(self):
+        """settimeout(None) is SPELLING blocking mode, not bounding it."""
+        found = findings("""
+            def serve(srv_sock):
+                srv_sock.settimeout(None)
+                conn, addr = srv_sock.accept()
+                return conn
+        """, "R17")
+        assert len(found) == 1
+
+    def test_timeout_handler_counts_as_fence(self):
+        """except socket.timeout only ever fires on a timed socket —
+        catching it is evidence the deadline was set at the
+        connect/accept site (the elastic protocol helpers' shape)."""
+        assert not findings("""
+            import socket
+
+            def pump(conn_sock, deadline):
+                while True:
+                    try:
+                        return conn_sock.recv(4096)
+                    except socket.timeout:
+                        continue
+        """, "R17")
+
+    def test_select_readiness_counts_as_fence(self):
+        assert not findings("""
+            def pump(sel, conn_sock):
+                for key, _ in sel.select(timeout=0.05):
+                    return conn_sock.recv(4096)
+        """, "R17")
+
+    def test_settimeout_on_other_socket_not_a_fence(self):
+        """A deadline on some OTHER socket bounds nothing here — the
+        fence must be on the receiver that waits."""
+        found = findings("""
+            def pump(ctl_sock, conn_sock):
+                ctl_sock.settimeout(5.0)
+                return conn_sock.recv(65536)
+        """, "R17")
+        assert len(found) == 1
+
+    def test_non_selector_select_not_a_fence(self):
+        """`.select(...)` on a non-selector receiver (an ORM query, a
+        soup) is a name collision, not a readiness wait."""
+        found = findings("""
+            def scrape(soup, conn_sock):
+                rows = soup.select("div.row")
+                return conn_sock.recv(65536)
+        """, "R17")
+        assert len(found) == 1
+
+    def test_non_socketish_receiver_clean(self):
+        """dict.get-style receivers and non-sock names stay quiet —
+        the receiver heuristic is the R05/R11 one."""
+        assert not findings("""
+            def pull(ring):
+                return ring.recv(16)
+        """, "R17")
+
+    def test_elastic_layer_self_clean(self):
+        """Self-application over the modules the rule was written for:
+        the elastic coordinator/host protocol and the multihost init."""
+        import estorch_tpu.parallel.elastic as elastic
+        import estorch_tpu.parallel.multihost as multihost
+
+        for mod in (elastic, multihost):
+            with open(mod.__file__) as f:
+                src = f.read()
+            hits = [x for x in analyze_source(mod.__file__, src)
+                    if x.rule == "R17"]
+            assert not hits, [h.message for h in hits]
+
+
 class TestEngine:
     def test_every_rule_registered(self):
         ids = [r.id for r in all_rules()]
         assert ids == ["R01", "R02", "R03", "R04", "R05", "R06", "R07",
                        "R08", "R09", "R10", "R11", "R12", "R13", "R14",
-                       "R15", "R16"]
+                       "R15", "R16", "R17"]
 
     def test_syntax_error_becomes_finding(self):
         found = analyze_source("bad.py", "def broken(:\n")
@@ -1894,7 +2033,7 @@ class TestConfig:
         assert cfg.baseline == "esguard_baseline.json"
         assert cfg.rule_ids([r.id for r in all_rules()]) == [
             "R01", "R02", "R03", "R04", "R05", "R06", "R07", "R08", "R09",
-            "R10", "R11", "R12", "R13", "R14", "R15", "R16"]
+            "R10", "R11", "R12", "R13", "R14", "R15", "R16", "R17"]
 
 
 class TestCLI:

@@ -134,6 +134,10 @@ class TestCheckDevice:
             lambda timeout_s=20.0, platform=None: {
                 "status": "failed", "reason": "init-hang",
                 "elapsed_s": timeout_s, "timeout_s": timeout_s})
+        monkeypatch.setattr(doctor, "check_elastic",
+                            lambda **kw: {"status": "ok",
+                                          "elapsed_s": 0.1,
+                                          "timeout_s": 120.0})
         rep = doctor.report(timeout_s=5)
         assert rep["device_probe"]["reason"] == "init-hang"
         # ONE staged probe serves both rows: the legacy device summary
@@ -188,6 +192,10 @@ class TestMeshCheck:
                                 "status": "ok", "platform": "cpu",
                                 "n_devices": 8, "elapsed_s": 0.1,
                                 "timeout_s": timeout_s})
+        monkeypatch.setattr(doctor, "check_elastic",
+                            lambda **kw: {"status": "ok",
+                                          "elapsed_s": 0.1,
+                                          "timeout_s": 120.0})
         rep = doctor.report(timeout_s=5.0)
         assert rep["mesh"]["status"] == "ok"
 
@@ -235,8 +243,67 @@ class TestScenariosCheck:
                                 "status": "ok", "platform": "cpu",
                                 "n_devices": 8, "elapsed_s": 0.1,
                                 "timeout_s": timeout_s})
+        monkeypatch.setattr(doctor, "check_elastic",
+                            lambda **kw: {"status": "ok",
+                                          "elapsed_s": 0.1,
+                                          "timeout_s": 120.0})
         rep = doctor.report(timeout_s=5.0)
         assert rep["scenarios"]["status"] == "ok"
+
+
+class TestElasticCheck:
+    """The elastic multi-host probe (check_elastic): staged subprocess —
+    2-process jax.distributed bring-up over loopback (Gloo CPU
+    collectives) → cross-process mesh → one cross-process psum →
+    the jax-free coordinator TCP round-trip (docs/multihost.md);
+    findings-not-tracebacks, the first missing marker names the layer."""
+
+    def test_classifier_taxonomy(self):
+        c = doctor.classify_elastic_probe
+        ok = ("ELASTIC_START\nELASTIC_INIT_OK\nELASTIC_MESH_OK\n"
+              "ELASTIC_PSUM_OK\nELASTIC_COORD_OK\n")
+        assert c(ok, False, 0) == ("ok", None)
+        assert c("ELASTIC_START\n", True, None) == \
+            ("failed", "distributed-init")
+        assert c("ELASTIC_START\nELASTIC_INIT_OK\n", False, 1) == \
+            ("failed", "mesh-build")
+        assert c("ELASTIC_START\nELASTIC_INIT_OK\nELASTIC_MESH_OK\n",
+                 False, 1) == ("failed", "cross-process-psum")
+        # all markers but a dirty exit: the last stage takes the blame
+        assert c(ok, False, 1) == ("failed", "coordinator-roundtrip")
+
+    def test_healthy_elastic_probe(self):
+        out = doctor.check_elastic(timeout_s=120.0)
+        assert out["status"] == "ok", out
+        assert "failed_stage" not in out
+
+    def test_failing_stage_named_not_raised(self, monkeypatch):
+        monkeypatch.setattr(doctor, "_ELASTIC_PROBE", (
+            'print("ELASTIC_START", flush=True)\n'
+            'print("ELASTIC_INIT_OK", flush=True)\n'
+            'raise RuntimeError("no cross-process mesh here")\n'))
+        out = doctor.check_elastic(timeout_s=30.0)
+        assert out["status"] == "failed"
+        assert out["failed_stage"] == "mesh-build"
+        assert "no cross-process mesh here" in out["stderr_tail"]
+
+    def test_report_gains_elastic_row(self, monkeypatch):
+        monkeypatch.setattr(doctor, "check_elastic",
+                            lambda **kw: {"status": "failed",
+                                          "failed_stage": "distributed-init",
+                                          "elapsed_s": 0.1,
+                                          "timeout_s": 120.0})
+        monkeypatch.setattr(doctor, "check_mesh",
+                            lambda **kw: {"status": "ok"})
+        monkeypatch.setattr(doctor, "check_scenarios",
+                            lambda **kw: {"status": "ok"})
+        monkeypatch.setattr(doctor, "check_device",
+                            lambda timeout_s=20.0, platform=None: {
+                                "status": "ok", "platform": "cpu",
+                                "n_devices": 8, "elapsed_s": 0.1,
+                                "timeout_s": timeout_s})
+        rep = doctor.report(timeout_s=5.0)
+        assert rep["elastic"]["failed_stage"] == "distributed-init"
 
 
 class TestOptionalDeps:
@@ -357,6 +424,10 @@ class TestCollectorCheck:
                                 "timeout_s": timeout_s})
         monkeypatch.setattr(doctor, "check_collector",
                             lambda: {"ok": True})
+        monkeypatch.setattr(doctor, "check_elastic",
+                            lambda **kw: {"status": "ok",
+                                          "elapsed_s": 0.1,
+                                          "timeout_s": 120.0})
         rep = doctor.report(timeout_s=5.0)
         assert rep["collector"] == {"ok": True}
 
@@ -397,6 +468,10 @@ class TestRouterCheck:
                             lambda: {"ok": True})
         monkeypatch.setattr(doctor, "check_router",
                             lambda: {"ok": True, "retries": 1})
+        monkeypatch.setattr(doctor, "check_elastic",
+                            lambda **kw: {"status": "ok",
+                                          "elapsed_s": 0.1,
+                                          "timeout_s": 120.0})
         rep = doctor.report(timeout_s=5.0)
         assert rep["router"] == {"ok": True, "retries": 1}
 
@@ -590,6 +665,10 @@ class TestReport:
                 "status": "failed", "reason": "init-hang",
                 "elapsed_s": timeout_s, "timeout_s": timeout_s,
                 "stderr_tail": ""})
+        monkeypatch.setattr(doctor, "check_elastic",
+                            lambda **kw: {"status": "ok",
+                                          "elapsed_s": 0.1,
+                                          "timeout_s": 120.0})
         rep = doctor.report()
         assert rep["device"]["status"] == "wedged"
         assert "cpu" in rep["hint"]
@@ -613,6 +692,10 @@ class TestReport:
                 "status": "ok", "platform": "cpu", "n_devices": 8,
                 "elapsed_s": 1.0, "timeout_s": timeout_s})
         Heartbeat(str(tmp_path / "heartbeat.json")).beat("update", 11)
+        monkeypatch.setattr(doctor, "check_elastic",
+                            lambda **kw: {"status": "ok",
+                                          "elapsed_s": 0.1,
+                                          "timeout_s": 120.0})
         rep = doctor.report(run_dir=str(tmp_path))
         assert rep["obs"]["heartbeat"]["generation"] == 11
 
@@ -622,6 +705,10 @@ class TestReport:
             lambda timeout_s=20.0, platform=None: {
                 "status": "ok", "platform": "cpu", "n_devices": 8,
                 "elapsed_s": 1.0, "timeout_s": timeout_s})
+        monkeypatch.setattr(doctor, "check_elastic",
+                            lambda **kw: {"status": "ok",
+                                          "elapsed_s": 0.1,
+                                          "timeout_s": 120.0})
         rc = doctor.main(["--timeout", "5"])
         rep = json.loads(capsys.readouterr().out)
         assert rc == 0

@@ -487,6 +487,52 @@ class TestDashUnits:
         assert "7.2s!41" in text  # fresh builds called out
         assert "1.5s?" in text  # unproven warmth never reads as clean
 
+    def test_elastic_host_columns_from_store_alone(self, tmp_path):
+        """An elastic multi-host coordinator (docs/multihost.md) renders
+        membership count (with a `!N` suffix when N hosts died inside
+        the window), the worst per-host fold-latency p99, and nothing
+        invented for targets without a fleet."""
+        from estorch_tpu.obs.agg.dash import fleet_snapshot, render
+
+        root = str(tmp_path / "store")
+        s = SeriesStore(root)
+        now = time.time()
+        s.append([
+            {"name": "estorch_up", "labels": {"target": "coord"},
+             "value": 1},
+            {"name": "estorch_elastic_hosts",
+             "labels": {"target": "coord"}, "value": 3},
+            {"name": "estorch_hosts_lost",
+             "labels": {"target": "coord"}, "value": 0},
+            {"name": "estorch_elastic_fold_p99_worst_s",
+             "labels": {"target": "coord"}, "value": 0.0421},
+            {"name": "estorch_up", "labels": {"target": "serve-x"},
+             "value": 1},
+        ], ts=now - 30)
+        # one host died inside the window: count drops, lost increases
+        s.append([
+            {"name": "estorch_up", "labels": {"target": "coord"},
+             "value": 1},
+            {"name": "estorch_elastic_hosts",
+             "labels": {"target": "coord"}, "value": 2},
+            {"name": "estorch_hosts_lost",
+             "labels": {"target": "coord"}, "value": 1},
+            {"name": "estorch_elastic_fold_p99_worst_s",
+             "labels": {"target": "coord"}, "value": 0.0550},
+            {"name": "estorch_up", "labels": {"target": "serve-x"},
+             "value": 1},
+        ], ts=now)
+        snap = fleet_snapshot(root, window_s=60, now=now)
+        rows = {r["target"]: r for r in snap["targets"]}
+        assert rows["coord"]["elastic_hosts"] == 2
+        assert rows["coord"]["hosts_lost"] == 1
+        assert rows["coord"]["host_fold_p99_s"] == 0.0550
+        assert rows["serve-x"]["elastic_hosts"] is None
+        text = render(root, window_s=60, now=now)
+        assert "hosts" in text.splitlines()[1]  # the header row
+        assert "2!1" in text  # membership with the death called out
+        assert "55.0" in text  # worst-host fold p99 in ms
+
     def test_router_columns_from_store_alone(self, tmp_path):
         """A front-router target (serve/router.py) renders breaker
         state, windowed retry/hedge increases, and the worst per-replica

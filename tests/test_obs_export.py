@@ -682,6 +682,27 @@ class TestMixedSchemaBaselines:
                               for v in phases.values())
         assert "wall_time_s" in extract_tail_groups(rows)
 
+    def test_committed_r07_artifact_carries_the_elastic_row(self):
+        """The round-19 committed baseline (ISSUE 15 satellite: fresh
+        committed history for this round's gates): same steady-state
+        phase-row contract as r06 PLUS the elastic multi-host row —
+        sync-SPMD vs elastic-fold gps under the shared straggle_host
+        plan, with the fold actually exercised and the accounting
+        invariant intact at capture time."""
+        path = os.path.join(REPO, "BENCH_r07.json")
+        with open(path) as f:
+            art = json.load(f)
+        assert art["phase_rows"] and all(
+            isinstance(r.get("phases"), dict) for r in art["phase_rows"])
+        walls = [r["wall_time_s"] for r in art["phase_rows"]]
+        assert max(walls) < 3 * sorted(walls)[len(walls) // 2], (
+            "compile-spike rows leaked into the committed tail baseline")
+        el = art["extras"]["elastic"]
+        assert el["ratio"] >= 1.25
+        assert el["elastic_gps"] > el["sync_gps"]
+        assert el["results_folded"] > 0
+        assert el["accounting_ok"] is True
+
 
 # ---------------------------------------------------------------------
 # THE e2e acceptance demo
