@@ -6,8 +6,9 @@ subprocess waits wedging a pod worker — are invisible to unit tests
 until real hardware makes them expensive.  esguard catches them at
 AST level, on CPU, in seconds:
 
-    python -m estorch_tpu.analysis estorch_tpu/          # human output
-    python -m estorch_tpu.analysis --json estorch_tpu/   # machine output
+    python -m estorch_tpu.analysis estorch_tpu/                # human
+    python -m estorch_tpu.analysis --format=json estorch_tpu/  # machine
+    python -m estorch_tpu.analysis --changed origin/main...HEAD  # PR path
 
 Rules (docs/analysis.md has the full rationale per rule):
 
@@ -42,6 +43,22 @@ Rules (docs/analysis.md has the full rationale per rule):
                                 coordinator-socket accept/recv(n)
                                 (one silent peer wedges the fleet)
 
+The R18–R22 lockset family runs at PROJECT scope — per-file summaries
+are linked into a whole-program view (import graph, call graph,
+shared-mutable-state inventory) before the checks fire, because no
+single file shows both sides of a data race:
+
+* R18 unguarded-shared-write  — attribute guarded by a lock somewhere,
+                                written bare somewhere else
+* R19 lock-order-inversion    — two locks taken in both orders
+                                (lexically or one call level deep)
+* R20 callback-mutates-foreign-state — thread/callback/handler root
+                                mutating another object's state lockless
+* R21 await-under-lock        — indefinitely-blocking call while a
+                                lock is held
+* R22 daemon-thread-orphan    — non-daemon thread never joined, or
+                                started and dropped
+
 Nothing in this package imports jax or the analyzed modules — analysis
 is pure ``ast`` and safe to run where no accelerator exists.
 """
@@ -50,12 +67,19 @@ from .baseline import (ApplyResult, Baseline, BaselineEntry, load_baseline,
                        save_baseline)
 from .config import EsguardConfig, load_config
 from .engine import (Rule, all_rules, analyze_paths, analyze_source,
-                     get_rule, iter_py_files, rule)
+                     default_jobs, get_rule, iter_py_files,
+                     render_rule_table, rule)
 from .findings import Finding, findings_to_json, sort_findings
+from .project import ModuleSummary, ProjectContext, build_summary
+from .ratchet import (RatchetResult, check_ratchet, count_findings,
+                      load_ratchet, save_ratchet)
 
 __all__ = [
     "ApplyResult", "Baseline", "BaselineEntry", "EsguardConfig", "Finding",
-    "Rule", "all_rules", "analyze_paths", "analyze_source",
-    "findings_to_json", "get_rule", "iter_py_files", "load_baseline",
-    "load_config", "rule", "save_baseline", "sort_findings",
+    "ModuleSummary", "ProjectContext", "RatchetResult", "Rule",
+    "all_rules", "analyze_paths", "analyze_source", "build_summary",
+    "check_ratchet", "count_findings", "default_jobs", "findings_to_json",
+    "get_rule", "iter_py_files", "load_baseline", "load_config",
+    "load_ratchet", "render_rule_table", "rule", "save_baseline",
+    "save_ratchet", "sort_findings",
 ]

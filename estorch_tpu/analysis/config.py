@@ -13,6 +13,7 @@ Recognized keys::
     enable   = ["R01", "R02"]   # default: all registered rules
     disable  = ["R04"]          # subtracted after `enable`
     baseline = "esguard_baseline.json"
+    ratchet  = "esguard_ratchet.json"   # per-rule shrink-only counts
     exclude  = ["*_pb2.py", "build/*"]  # glob per file path / basename
 """
 
@@ -28,6 +29,7 @@ class EsguardConfig:
     enable: list[str] | None = None  # None -> all rules
     disable: list[str] = field(default_factory=list)
     baseline: str | None = None
+    ratchet: str | None = None
     exclude: list[str] = field(default_factory=list)
     root: str = "."  # directory the config file lives in
 
@@ -35,6 +37,11 @@ class EsguardConfig:
         if self.baseline is None:
             return None
         return os.path.join(self.root, self.baseline)
+
+    def ratchet_path(self) -> str | None:
+        if self.ratchet is None:
+            return None
+        return os.path.join(self.root, self.ratchet)
 
     def rule_ids(self, all_ids: list[str]) -> list[str]:
         ids = list(all_ids) if self.enable is None else [
@@ -128,6 +135,8 @@ def load_config(pyproject_path: str | None = None) -> EsguardConfig:
         cfg.disable = list(table["disable"])
     if "baseline" in table:
         cfg.baseline = str(table["baseline"])
+    if "ratchet" in table:
+        cfg.ratchet = str(table["ratchet"])
     if "exclude" in table:
         cfg.exclude = list(table["exclude"])
     return cfg

@@ -66,41 +66,55 @@ class ModuleContext:
     traced: set[ast.AST] = field(default_factory=set)
     # def node -> enclosing qualname ("Engine._step.body")
     qualnames: dict[ast.AST, str] = field(default_factory=dict)
+    # every call-valued Assign with its nearest enclosing class name —
+    # the lockset layer scans these for Lock()/RLock()/... factories
+    # without re-walking the tree
+    call_assigns: list[tuple[ast.Assign, str]] = field(default_factory=list)
 
     def line_at(self, lineno: int) -> str:
         if 1 <= lineno <= len(self.lines):
             return self.lines[lineno - 1].strip()
         return ""
 
+    # node -> resolved path: a dozen rules re-resolve the same call
+    # heads, and the dotted-name walk is pure per-node work
+    _resolve_cache: dict[ast.AST, str | None] = field(default_factory=dict)
+
     def resolve(self, node: ast.AST) -> str | None:
         """Canonical dotted path of a name/attribute expression, expanding
         the module's import aliases: with ``import jax.random as jr``,
         ``jr.split`` resolves to "jax.random.split"."""
+        try:
+            return self._resolve_cache[node]
+        except KeyError:
+            pass
         dotted = dotted_name(node)
         if dotted is None:
-            return None
-        head, _, rest = dotted.partition(".")
-        canon = self.aliases.get(head, head)
-        return canon + ("." + rest if rest else "")
+            out = None
+        else:
+            head, _, rest = dotted.partition(".")
+            canon = self.aliases.get(head, head)
+            out = canon + ("." + rest if rest else "")
+        self._resolve_cache[node] = out
+        return out
 
     def is_traced(self, fn: ast.AST) -> bool:
         return fn in self.traced
 
 
-def _collect_aliases(tree: ast.Module, aliases: dict[str, str]) -> None:
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Import):
-            for a in node.names:
-                aliases[a.asname or a.name.partition(".")[0]] = (
-                    a.name if a.asname else a.name.partition(".")[0])
-        elif isinstance(node, ast.ImportFrom):
-            # relative imports keep their dots ("..utils.backend.shard_map")
-            # — unresolvable to an absolute module, but enough for the
-            # distinctive-tail rule to see through in-repo shims
-            prefix = "." * node.level + (node.module or "")
-            for a in node.names:
-                aliases[a.asname or a.name] = (
-                    f"{prefix}.{a.name}" if prefix else a.name)
+def _record_alias(node: ast.AST, aliases: dict[str, str]) -> None:
+    if isinstance(node, ast.Import):
+        for a in node.names:
+            aliases[a.asname or a.name.partition(".")[0]] = (
+                a.name if a.asname else a.name.partition(".")[0])
+    elif isinstance(node, ast.ImportFrom):
+        # relative imports keep their dots ("..utils.backend.shard_map")
+        # — unresolvable to an absolute module, but enough for the
+        # distinctive-tail rule to see through in-repo shims
+        prefix = "." * node.level + (node.module or "")
+        for a in node.names:
+            aliases[a.asname or a.name] = (
+                f"{prefix}.{a.name}" if prefix else a.name)
 
 
 _FN_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
@@ -143,36 +157,44 @@ def build_context(path: str, source: str) -> ModuleContext:
     tree = ast.parse(source, filename=path)
     ctx = ModuleContext(path=path, source=source, tree=tree,
                         lines=source.splitlines())
-    _collect_aliases(tree, ctx.aliases)
 
-    # ---- qualnames + defs_by_name ------------------------------------
-    def walk_defs(node: ast.AST, prefix: str) -> None:
+    # ---- single structural pass --------------------------------------
+    # One recursive traversal collects import aliases, qualnames,
+    # defs_by_name, the lexical-parent-function map, and every Call node
+    # (tracing heads are filtered AFTER the walk, once aliases are
+    # complete).  parent_fn matters twice: name references at a tracing
+    # call site resolve against the call's enclosing scope chain, not
+    # module-wide — an unrelated host function that happens to share a
+    # closure name like `body`/`step_fn` must not become traced — and it
+    # is the same map engine.enclosing_defs serves to the rules, so it
+    # is cached on the tree here instead of being rebuilt there.
+    parent_fn: dict[ast.AST, ast.AST | None] = {}
+    calls: list[ast.Call] = []
+
+    def walk(node: ast.AST, prefix: str, fn: ast.AST | None,
+             cls: str) -> None:
         for child in ast.iter_child_nodes(node):
+            parent_fn[child] = fn
             if isinstance(child, _FN_NODES):
                 qn = f"{prefix}{child.name}"
                 ctx.qualnames[child] = qn
                 ctx.defs_by_name.setdefault(child.name, []).append(child)
-                walk_defs(child, qn + ".")
-            elif isinstance(child, ast.ClassDef):
-                walk_defs(child, f"{prefix}{child.name}.")
-            else:
-                walk_defs(child, prefix)
+                walk(child, qn + ".", child, cls)
+                continue
+            if isinstance(child, ast.ClassDef):
+                walk(child, f"{prefix}{child.name}.", fn, child.name)
+                continue
+            if isinstance(child, ast.Call):
+                calls.append(child)
+            elif isinstance(child, (ast.Import, ast.ImportFrom)):
+                _record_alias(child, ctx.aliases)
+            elif isinstance(child, ast.Assign) and isinstance(
+                    child.value, ast.Call):
+                ctx.call_assigns.append((child, cls))
+            walk(child, prefix, fn, cls)
 
-    walk_defs(tree, "")
-
-    # ---- traced roots ------------------------------------------------
-    # lexical parent function of every node: name references at a tracing
-    # call site resolve against the call's enclosing scope chain, not
-    # module-wide — an unrelated host function that happens to share a
-    # closure name like `body`/`step_fn` must not become traced
-    parent_fn: dict[ast.AST, ast.AST | None] = {}
-
-    def walk_parents(node: ast.AST, fn: ast.AST | None) -> None:
-        for child in ast.iter_child_nodes(node):
-            parent_fn[child] = fn
-            walk_parents(child, child if isinstance(child, _FN_NODES) else fn)
-
-    walk_parents(tree, None)
+    walk(tree, "", None, "")
+    tree._esguard_parent_fn = parent_fn
 
     def resolve_local_def(call: ast.Call, name: str) -> ast.AST | None:
         chain = []
@@ -192,8 +214,8 @@ def build_context(path: str, source: str) -> ModuleContext:
         for dec in getattr(fn, "decorator_list", []):
             if _decorator_traces(ctx, dec):
                 ctx.traced.add(fn)
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Call) and _is_tracing_head(ctx, node.func):
+    for node in calls:
+        if _is_tracing_head(ctx, node.func):
             resolved = ctx.resolve(node.func) or ""
             if resolved.rsplit(".", 1)[-1] in _CALLABLE_FIRST:
                 cand = node.args[:1]

@@ -6,6 +6,15 @@ with :func:`rule`.  The driver parses each ``.py`` file once, builds one
 every enabled rule — so adding a rule costs one function, not a new
 traversal pipeline.
 
+Rules come in two scopes.  ``scope="module"`` (the default) sees one
+file at a time.  ``scope="project"`` rules (the R18–R22 lockset family)
+receive a :class:`~estorch_tpu.analysis.project.ProjectContext` linking
+every analyzed module — import aliases, call graph, shared-state
+inventory — built from per-file :class:`ModuleSummary` records.  The
+per-file work (parse + module rules + summary extraction) fans out
+across a fork-based process pool; the cheap project pass links the
+returned summaries in the parent.
+
 The engine itself never imports the analyzed code: everything is
 ``ast``-level, runs on CPU in milliseconds, and is safe to point at
 modules whose import would grab an accelerator.
@@ -14,7 +23,9 @@ modules whose import would grab an accelerator.
 from __future__ import annotations
 
 import ast
+import concurrent.futures
 import fnmatch
+import multiprocessing
 import os
 from dataclasses import dataclass
 from typing import Callable, Iterable, Iterator
@@ -29,19 +40,21 @@ class Rule:
     name: str  # "prng-key-reuse"
     severity: str  # default severity for findings it emits
     description: str
-    check: Callable[[ModuleContext], Iterable[Finding]]
+    check: Callable[..., Iterable[Finding]]
+    scope: str = "module"  # "module" -> ModuleContext, "project" -> ProjectContext
 
 
 _REGISTRY: dict[str, Rule] = {}
 
 
-def rule(id: str, name: str, severity: str, description: str):
+def rule(id: str, name: str, severity: str, description: str,
+         scope: str = "module"):
     """Register ``check(ctx) -> Iterable[Finding]`` under a rule id."""
 
-    def deco(check: Callable[[ModuleContext], Iterable[Finding]]):
+    def deco(check: Callable[..., Iterable[Finding]]):
         if id in _REGISTRY:
             raise ValueError(f"duplicate rule id {id}")
-        _REGISTRY[id] = Rule(id, name, severity, description, check)
+        _REGISTRY[id] = Rule(id, name, severity, description, check, scope)
         return check
 
     return deco
@@ -60,7 +73,21 @@ def get_rule(rule_id: str) -> Rule:
 def _load_builtin_rules() -> None:
     # import for side effect: each module registers its rules on import
     from . import (rules_host, rules_perf, rules_prng,  # noqa: F401
-                   rules_resilience, rules_trace)
+                   rules_races, rules_resilience, rules_trace)
+
+
+def render_rule_table() -> str:
+    """The registry as a markdown table — docs/analysis.md embeds this
+    between markers so the catalog cannot drift from the code (a test
+    diffs the two)."""
+    rows = [
+        "| id | name | severity | scope | description |",
+        "| --- | --- | --- | --- | --- |",
+    ]
+    for r in all_rules():
+        rows.append(f"| {r.id} | `{r.name}` | {r.severity} | {r.scope} "
+                    f"| {r.description} |")
+    return "\n".join(rows) + "\n"
 
 
 def _rebase(path: str) -> str:
@@ -104,37 +131,110 @@ def iter_py_files(paths: Iterable[str],
                         yield full
 
 
+def _syntax_finding(path: str, e: SyntaxError) -> Finding:
+    return Finding(
+        rule="R00", file=path, line=e.lineno or 0, col=e.offset or 0,
+        severity="error", message=f"file does not parse: {e.msg}",
+        hint="fix the syntax error; esguard skipped this file",
+        symbol="<module>", snippet=(e.text or "").strip(),
+    )
+
+
+def _split_rules(rules: list[Rule]) -> tuple[list[Rule], list[Rule]]:
+    return ([r for r in rules if r.scope == "module"],
+            [r for r in rules if r.scope == "project"])
+
+
 def analyze_source(path: str, source: str,
                    rules: Iterable[Rule] | None = None) -> list[Finding]:
     """Run rules over one module's source.  Syntax errors become a single
-    parse-error finding instead of aborting the whole run."""
+    parse-error finding instead of aborting the whole run.  Project
+    rules see a single-module ProjectContext — a one-file "program" —
+    so fixtures and single-file invocations still exercise R18–R22."""
+    from .project import ProjectContext, build_summary
     if rules is None:
         rules = all_rules()
+    mod_rules, proj_rules = _split_rules(list(rules))
     try:
         ctx = build_context(path, source)
     except SyntaxError as e:
-        return [Finding(
-            rule="R00", file=path, line=e.lineno or 0, col=e.offset or 0,
-            severity="error", message=f"file does not parse: {e.msg}",
-            hint="fix the syntax error; esguard skipped this file",
-            symbol="<module>", snippet=(e.text or "").strip(),
-        )]
+        return [_syntax_finding(path, e)]
     findings: list[Finding] = []
-    for r in rules:
+    for r in mod_rules:
         findings.extend(r.check(ctx))
+    if proj_rules:
+        pctx = ProjectContext([build_summary(ctx)])
+        for r in proj_rules:
+            findings.extend(r.check(pctx))
     return findings
+
+
+def _analyze_one(task: tuple[str, tuple[str, ...], bool]):
+    """Process-pool unit: one file -> (module-rule findings, summary).
+    Top-level so it pickles; rules rehydrate from the registry by id
+    (the fork start method means workers inherit a loaded registry)."""
+    from .project import build_summary
+    path, rule_ids, need_summary = task
+    mod_rules = [get_rule(i) for i in rule_ids]
+    with open(path, encoding="utf-8") as fh:
+        source = fh.read()
+    try:
+        ctx = build_context(path, source)
+    except SyntaxError as e:
+        return [_syntax_finding(path, e)], None
+    findings: list[Finding] = []
+    for r in mod_rules:
+        findings.extend(r.check(ctx))
+    summary = build_summary(ctx) if need_summary else None
+    return findings, summary
+
+
+def default_jobs() -> int:
+    return max(1, min(os.cpu_count() or 1, 8))
 
 
 def analyze_paths(paths: Iterable[str],
                   rules: Iterable[Rule] | None = None,
-                  exclude: Iterable[str] = ()) -> list[Finding]:
+                  exclude: Iterable[str] = (),
+                  jobs: int | None = None) -> list[Finding]:
+    """Analyze every file under ``paths``: module rules per file (in a
+    fork process pool when it pays off), then the whole-program pass
+    over the linked summaries.  ``jobs<=1`` forces the serial path; any
+    pool failure falls back to it too — the analyzer must never be the
+    thing that breaks CI."""
+    from .project import ProjectContext
     if rules is None:
         rules = all_rules()
-    rules = list(rules)
+    mod_rules, proj_rules = _split_rules(list(rules))
+    files = list(iter_py_files(paths, exclude))
+    tasks = [(p, tuple(r.id for r in mod_rules), bool(proj_rules))
+             for p in files]
+    if jobs is None:
+        jobs = default_jobs()
+    results = None
+    if (jobs > 1 and len(tasks) >= 16
+            and "fork" in multiprocessing.get_all_start_methods()):
+        try:
+            mp_ctx = multiprocessing.get_context("fork")
+            with concurrent.futures.ProcessPoolExecutor(
+                    max_workers=jobs, mp_context=mp_ctx) as pool:
+                results = list(pool.map(
+                    _analyze_one, tasks,
+                    chunksize=max(1, len(tasks) // (jobs * 4))))
+        except Exception:
+            results = None  # serial fallback below
+    if results is None:
+        results = [_analyze_one(t) for t in tasks]
     findings: list[Finding] = []
-    for path in iter_py_files(paths, exclude):
-        with open(path, encoding="utf-8") as fh:
-            findings.extend(analyze_source(path, fh.read(), rules))
+    summaries = []
+    for file_findings, summary in results:
+        findings.extend(file_findings)
+        if summary is not None:
+            summaries.append(summary)
+    if proj_rules:
+        pctx = ProjectContext(summaries)
+        for r in proj_rules:
+            findings.extend(r.check(pctx))
     return findings
 
 
@@ -142,8 +242,25 @@ def analyze_paths(paths: Iterable[str],
 # shared helpers for the rule modules
 # ---------------------------------------------------------------------
 
+def walk_tree(tree: ast.Module) -> tuple[ast.AST, ...]:
+    """``ast.walk(tree)`` flattened once and cached on the tree — the
+    traversal itself (deque + iter_child_nodes per node) costs more than
+    most rules' per-node work, and every rule repeats it."""
+    cached = getattr(tree, "_esguard_all_nodes", None)
+    if cached is None:
+        cached = tuple(ast.walk(tree))
+        tree._esguard_all_nodes = cached
+    return cached
+
+
 def enclosing_defs(tree: ast.Module) -> dict[ast.AST, ast.AST | None]:
-    """node -> nearest enclosing function def (None at module level)."""
+    """node -> nearest enclosing function def (None at module level).
+    Cached on the tree: a dozen rules ask for this map per file, and on
+    a single-core runner rebuilding it dominated the whole-tree wall
+    time (the ~2s run_lint budget)."""
+    cached = getattr(tree, "_esguard_parent_fn", None)
+    if cached is not None:
+        return cached
     parent_fn: dict[ast.AST, ast.AST | None] = {}
 
     def walk(node: ast.AST, fn: ast.AST | None) -> None:
@@ -153,19 +270,28 @@ def enclosing_defs(tree: ast.Module) -> dict[ast.AST, ast.AST | None]:
                 child, (ast.FunctionDef, ast.AsyncFunctionDef)) else fn)
 
     walk(tree, None)
+    tree._esguard_parent_fn = parent_fn
     return parent_fn
 
 
 def scope_nodes(scope: ast.AST):
     """Nodes belonging to one function (or module) scope: walks the body
     without descending into nested function defs, so a rule iterating
-    per-scope never double-reports a nested function's body."""
+    per-scope never double-reports a nested function's body.  Cached on
+    the scope node — every iter_scopes-driven rule re-enumerates the
+    same scopes."""
+    cached = getattr(scope, "_esguard_scope_nodes", None)
+    if cached is not None:
+        return cached
+    out = []
     stack = list(ast.iter_child_nodes(scope))
     while stack:
         node = stack.pop()
-        yield node
+        out.append(node)
         if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             stack.extend(ast.iter_child_nodes(node))
+    scope._esguard_scope_nodes = out
+    return out
 
 
 def iter_scopes(ctx: ModuleContext):
@@ -173,6 +299,19 @@ def iter_scopes(ctx: ModuleContext):
     yield "<module>", ctx.tree
     for fn, qualname in ctx.qualnames.items():
         yield qualname, fn
+
+
+def symbol_map(ctx: ModuleContext) -> dict:
+    """node -> qualname of its own scope, cached on the tree (the
+    iter_scopes × scope_nodes product is the same for every rule)."""
+    cached = getattr(ctx.tree, "_esguard_symbol_of", None)
+    if cached is None:
+        cached = {}
+        for symbol, scope in iter_scopes(ctx):
+            for node in scope_nodes(scope):
+                cached.setdefault(node, symbol)
+        ctx.tree._esguard_symbol_of = cached
+    return cached
 
 
 def make_finding(ctx: ModuleContext, rule_: Rule, node: ast.AST,

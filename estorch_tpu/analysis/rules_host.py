@@ -66,7 +66,7 @@ import ast
 import re
 
 from .context import ModuleContext
-from .engine import get_rule, iter_scopes, make_finding, rule, scope_nodes
+from .engine import get_rule, iter_scopes, make_finding, rule, scope_nodes, walk_tree
 
 # ---------------------------------------------------------------------
 # R05 untimed-subprocess-wait
@@ -615,7 +615,7 @@ def check_signature_probe(ctx: ModuleContext):
         for node in scope_nodes(scope):
             parent_symbol[node] = symbol
     out = []
-    for node in ast.walk(ctx.tree):
+    for node in walk_tree(ctx.tree):
         if not isinstance(node, ast.Try):
             continue
         if not _calls_signature(ctx, node.body):

@@ -31,7 +31,7 @@ import ast
 import re
 
 from .context import ModuleContext
-from .engine import get_rule, iter_scopes, make_finding, rule, scope_nodes
+from .engine import get_rule, iter_scopes, make_finding, rule, scope_nodes, walk_tree
 
 _CLOCK_CALLS = {"time.time", "time.perf_counter", "time.monotonic"}
 
@@ -73,7 +73,7 @@ def _jitted_names(ctx: ModuleContext) -> tuple[set[str], set[str]]:
     dispatch is the common engine idiom."""
     names: set[str] = set()
     attrs: set[str] = set()
-    for node in ast.walk(ctx.tree):
+    for node in walk_tree(ctx.tree):
         if not isinstance(node, ast.Assign):
             continue
         if not _jit_binding_value(ctx, node.value):
@@ -209,7 +209,7 @@ def check_nonmonotonic_span_clock(ctx: ModuleContext):
     # supervisor idiom stamps the start in __init__ and takes the delta
     # in another method
     wall_attrs: set[str] = set()
-    for node in ast.walk(ctx.tree):
+    for node in walk_tree(ctx.tree):
         if isinstance(node, ast.Assign) and _is_wall_call(ctx, node.value):
             for tgt in node.targets:
                 if isinstance(tgt, ast.Attribute):

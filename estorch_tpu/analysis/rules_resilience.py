@@ -30,7 +30,8 @@ from __future__ import annotations
 import ast
 
 from .context import ModuleContext
-from .engine import enclosing_defs, get_rule, iter_scopes, make_finding, rule, scope_nodes
+from .engine import (enclosing_defs, get_rule, iter_scopes, make_finding,
+                     rule, scope_nodes, symbol_map, walk_tree)
 
 _TEARDOWN_NAMES = {"__del__", "__exit__", "close", "shutdown"}
 
@@ -60,12 +61,9 @@ def _falls_through(try_node: ast.Try) -> bool:
 def check_swallowed_fault(ctx: ModuleContext):
     r = get_rule("R08")
     parent_fn = enclosing_defs(ctx.tree)
-    symbol_of: dict[ast.AST, str] = {}
-    for symbol, scope in iter_scopes(ctx):
-        for node in scope_nodes(scope):
-            symbol_of.setdefault(node, symbol)
+    symbol_of = symbol_map(ctx)
     out = []
-    for node in ast.walk(ctx.tree):
+    for node in walk_tree(ctx.tree):
         if not isinstance(node, ast.Try):
             continue
         if _is_teardown(parent_fn.get(node)):

@@ -15,8 +15,7 @@ from __future__ import annotations
 import ast
 
 from .context import ModuleContext
-from .engine import (enclosing_defs, get_rule, iter_scopes, make_finding,
-                     rule, scope_nodes)
+from .engine import (enclosing_defs, get_rule, iter_scopes, make_finding, rule, scope_nodes, walk_tree)
 
 _FN_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
 
@@ -239,7 +238,7 @@ def _jitted_without_donation(ctx: ModuleContext):
                     yield fn, fn
             elif _jit_head(ctx, dec):
                 yield fn, fn
-    for node in ast.walk(ctx.tree):
+    for node in walk_tree(ctx.tree):
         if (isinstance(node, ast.Call) and _jit_head(ctx, node.func)
                 and not _donates(node.keywords)
                 and node.args and isinstance(node.args[0], ast.Name)):
@@ -389,7 +388,7 @@ def _host_array_bindings(ctx: ModuleContext) -> dict[str, str]:
 
     enclosing = enclosing_defs(ctx.tree)
     out: dict[str, str] = {}
-    for node in ast.walk(ctx.tree):
+    for node in walk_tree(ctx.tree):
         if not isinstance(node, ast.Assign) or not isinstance(node.value, ast.Call):
             continue
         if enclosing.get(node) is not None:
@@ -424,7 +423,7 @@ def _sharded_jit_targets(ctx: ModuleContext):
     sharding-spelling jit application provably traces: ``jax.jit(f,
     in_shardings=...)`` with a Name/attribute/lambda argument, plus the
     ``@partial(jax.jit, out_shardings=...)`` decorator form."""
-    for node in ast.walk(ctx.tree):
+    for node in walk_tree(ctx.tree):
         if (isinstance(node, ast.Call) and _jit_head(ctx, node.func)
                 and _has_sharding_kwargs(node.keywords) and node.args):
             tgt = node.args[0]

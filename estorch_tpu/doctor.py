@@ -545,6 +545,13 @@ def check_elastic(timeout_s: float = 120.0) -> dict:
 
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
+    # the probe writes worker.py into a tempdir and runs it as a script,
+    # so the worker's sys.path[0] is that tempdir — from a source
+    # checkout (package not pip-installed) estorch_tpu is only
+    # importable if we forward our own package root explicitly
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = (root + os.pathsep + env["PYTHONPATH"]
+                         if env.get("PYTHONPATH") else root)
     run = _run_staged_probe(_ELASTIC_PROBE, timeout_s, env)
     status, stage = classify_elastic_probe(run["out"], run["timed_out"],
                                            run["returncode"])

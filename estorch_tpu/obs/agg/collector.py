@@ -306,14 +306,21 @@ class Collector:
             with res_lock:
                 results[t.name] = {"samples": samples, "error": err,
                                    "elapsed_s": dt}
-            state.inflight = False
+            # handshake with the next tick's skip-if-stuck check — the
+            # collector lock orders this against tick's read+set
+            with self._lock:
+                state.inflight = False
 
         threads = []
         budget = max((t.timeout_s for t in self.targets),
                      default=DEFAULT_TIMEOUT_S) + 1.0
         for t in self.targets:
             state = self._states[t.name]
-            if state.inflight:
+            # test-and-set under the collector lock: a zombie scrape
+            # clearing the flag concurrently must not double-spawn
+            with self._lock:
+                stuck, state.inflight = state.inflight, True
+            if stuck:
                 # previous scrape still stuck past its own timeout: skip
                 # this round rather than stacking threads on a zombie
                 with res_lock:
@@ -321,7 +328,6 @@ class Collector:
                                        "error": "previous scrape still "
                                                 "in flight"}
                 continue
-            state.inflight = True
             th = threading.Thread(target=scrape, args=(t, state),
                                   name=f"agg-scrape-{t.name}", daemon=True)
             th.start()

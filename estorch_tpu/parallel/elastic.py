@@ -346,8 +346,12 @@ class ElasticCoordinator:
             # frame on the wire — the stream is unusable, so a send
             # failure IS the connection's death: close it now (the
             # reader's EOF posts the leave that owns the loss and
-            # membership accounting)
-            h.alive = False
+            # membership accounting).  The with-block released the
+            # send lock on the exception path, so re-take it: other
+            # senders racing this one must see alive flip before they
+            # try the dead socket
+            with h.send_lock:
+                h.alive = False
             _socket_close(h.conn)
             return False
 

@@ -12,15 +12,25 @@ failure:
   and restart-from-latest-checkpoint.
 * :class:`ChaosPlan` / ``ESTORCH_CHAOS`` — deterministic fault schedule
   so every recovery path above is exercised reproducibly.
+* :class:`Interleaver` / :func:`run_interleaved` — seeded forced-yield
+  thread scheduler that turns the data races esguard's lockset rules
+  (R18–R22) point at into bit-identical, replayable failures.
 """
 
 from .chaos import CHAOS_ENV, ChaosError, ChaosPlan
+from .interleave import (CoopLock, DeadlockError, InterleaveResult,
+                         Interleaver, run_interleaved)
 from .supervisor import Supervisor, run_resilient
 
 __all__ = [
     "CHAOS_ENV",
     "ChaosError",
     "ChaosPlan",
+    "CoopLock",
+    "DeadlockError",
+    "InterleaveResult",
+    "Interleaver",
     "Supervisor",
+    "run_interleaved",
     "run_resilient",
 ]

@@ -195,6 +195,11 @@ class Fleet:
         )
         self.slots = [_Slot(i, self.workdir)
                       for i in range(int(config["replicas"]))]
+        # slot state machine fields (state/proc/timers) are written by
+        # BOTH the monitor thread (_tick) and the rollout thread
+        # (rollback kills) — every mutation holds this lock; process
+        # kill/wait stays outside it so a slow reap can't wedge a tick
+        self._slots_lock = threading.Lock()
         self.events: list[dict] = []
         self._events_lock = threading.Lock()
         self._stop = threading.Event()
@@ -249,16 +254,18 @@ class Fleet:
                              if env.get("PYTHONPATH") else pkg_root)
         log = open(slot.log_path, "a")
         try:
-            slot.proc = subprocess.Popen(
+            proc = subprocess.Popen(
                 self._serve_argv(slot), stdout=log, stderr=log, env=env)
         finally:
             log.close()
-        slot.state = "starting"
-        slot.started_at = time.monotonic()
-        slot.down_since = None
-        slot.wedged = False
+        with self._slots_lock:
+            slot.proc = proc
+            slot.state = "starting"
+            slot.started_at = time.monotonic()
+            slot.down_since = None
+            slot.wedged = False
         self._event("replica_spawned", replica=slot.name,
-                    pid=slot.proc.pid)
+                    pid=proc.pid)
 
     def _check_starting(self, slot: _Slot) -> None:
         if os.path.exists(slot.port_file):
@@ -267,8 +274,9 @@ class Fleet:
                     pf = json.load(f)
             except (OSError, ValueError):
                 return  # racing the atomic rename; next tick
-            slot.address = f"{pf['host']}:{pf['port']}"
-            slot.state = "up"
+            with self._slots_lock:
+                slot.address = f"{pf['host']}:{pf['port']}"
+                slot.state = "up"
             self.router.update_replica(slot.name, slot.address)
             self._event("replica_up", replica=slot.name,
                         address=slot.address)
@@ -286,17 +294,19 @@ class Fleet:
                 proc.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 self._event("replica_unreapable", replica=slot.name)
-        slot.state = "down"
-        slot.down_since = None
+        with self._slots_lock:
+            slot.state = "down"
+            slot.down_since = None
         self._event("replica_killed", replica=slot.name, reason=reason)
 
     def _schedule_respawn(self, slot: _Slot) -> None:
-        slot.restarts += 1
         self.router.counters.inc("fleet_respawns_total")
-        backoff = min(self.backoff_s * (2 ** max(0, slot.restarts - 1)),
-                      self.backoff_max_s)
-        slot.next_spawn_at = time.monotonic() + backoff
-        slot.state = "down"
+        with self._slots_lock:
+            slot.restarts += 1
+            backoff = min(self.backoff_s * (2 ** max(0, slot.restarts - 1)),
+                          self.backoff_max_s)
+            slot.next_spawn_at = time.monotonic() + backoff
+            slot.state = "down"
 
     # ------------------------------------------------------------- monitor
 
@@ -343,14 +353,16 @@ class Fleet:
                 down = h.get("polled") and not h.get("ok")
                 if down:
                     if slot.down_since is None:
-                        slot.down_since = now
+                        with self._slots_lock:
+                            slot.down_since = now
                     elif now - slot.down_since > self.wedge_kill_s:
                         self.router.counters.inc(
                             "fleet_wedge_kills_total")
                         self._kill_slot(slot, reason="wedged")
                         self._schedule_respawn(slot)
                 else:
-                    slot.down_since = None
+                    with self._slots_lock:
+                        slot.down_since = None
                 continue
             # down: respawn when the backoff expires (bounded)
             if slot.restarts > self.max_restarts:

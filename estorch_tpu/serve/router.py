@@ -375,7 +375,8 @@ class Router:
                     "queue_depth", facts["queue_depth"])
             except (OSError, ValueError, http.client.HTTPException):
                 facts["p99_ms"] = rep.health.get("p99_ms")
-            rep.health = facts
+            with rep.lock:
+                rep.health = facts
             if facts["ok"]:
                 # the poll IS the half-open probe: an answering replica
                 # re-enters rotation without risking a client request
@@ -387,13 +388,14 @@ class Router:
                 # not a death — no breaker-open storm for a clean drain
                 pass
         except (OSError, ValueError, http.client.HTTPException) as e:
-            rep.health = {"polled": True, "ok": False,
-                          "error": f"{type(e).__name__}: {e}",
-                          "draining": rep.health.get("draining"),
-                          "queue_depth": None,
-                          "p99_ms": rep.health.get("p99_ms"),
-                          "age_s": None,
-                          "version": rep.health.get("version")}
+            with rep.lock:
+                rep.health = {"polled": True, "ok": False,
+                              "error": f"{type(e).__name__}: {e}",
+                              "draining": rep.health.get("draining"),
+                              "queue_depth": None,
+                              "p99_ms": rep.health.get("p99_ms"),
+                              "age_s": None,
+                              "version": rep.health.get("version")}
             if rep.breaker.record_failure():
                 self.counters.inc("router_breaker_opens_total")
         finally:

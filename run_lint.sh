@@ -9,7 +9,28 @@ cd "$(dirname "$0")"
 export JAX_PLATFORMS=cpu
 
 echo "== esguard =="
+# Two-speed gate.  When a base ref is available (CI PRs export
+# ESGUARD_CHANGED_RANGE, or origin/main exists locally) the changed-file
+# pass runs FIRST so a racy edit fails in well under a second; the full
+# whole-program pass (lockset rules R18-R22 need every module linked,
+# and the esguard_ratchet.json shrink-only counts are checked here)
+# always follows, archiving the machine-readable findings report for CI.
+CHANGED_RANGE="${ESGUARD_CHANGED_RANGE:-}"
+if [ -z "$CHANGED_RANGE" ] && git rev-parse --verify -q origin/main >/dev/null 2>&1; then
+    CHANGED_RANGE="origin/main...HEAD"
+fi
+if [ -n "$CHANGED_RANGE" ]; then
+    echo "-- changed files ($CHANGED_RANGE) --"
+    python -m estorch_tpu.analysis --changed "$CHANGED_RANGE"
+fi
+echo "-- full tree --"
+ARTIFACT_DIR="${ESGUARD_ARTIFACT_DIR:-/tmp/esguard}"
+mkdir -p "$ARTIFACT_DIR"
+python -m estorch_tpu.analysis --format=json estorch_tpu/ \
+    > "$ARTIFACT_DIR/findings.json" \
+    || { cat "$ARTIFACT_DIR/findings.json"; exit 1; }
 python -m estorch_tpu.analysis estorch_tpu/
+echo "findings artifact: $ARTIFACT_DIR/findings.json"
 
 echo "== obs selfcheck =="
 # record-schema validation of the golden generation record + summarize

@@ -1899,7 +1899,8 @@ class TestEngine:
         ids = [r.id for r in all_rules()]
         assert ids == ["R01", "R02", "R03", "R04", "R05", "R06", "R07",
                        "R08", "R09", "R10", "R11", "R12", "R13", "R14",
-                       "R15", "R16", "R17"]
+                       "R15", "R16", "R17", "R18", "R19", "R20", "R21",
+                       "R22"]
 
     def test_syntax_error_becomes_finding(self):
         found = analyze_source("bad.py", "def broken(:\n")
@@ -2031,9 +2032,11 @@ class TestConfig:
         root = os.path.join(os.path.dirname(__file__), "..")
         cfg = load_config(os.path.join(root, "pyproject.toml"))
         assert cfg.baseline == "esguard_baseline.json"
+        assert cfg.ratchet == "esguard_ratchet.json"
         assert cfg.rule_ids([r.id for r in all_rules()]) == [
             "R01", "R02", "R03", "R04", "R05", "R06", "R07", "R08", "R09",
-            "R10", "R11", "R12", "R13", "R14", "R15", "R16", "R17"]
+            "R10", "R11", "R12", "R13", "R14", "R15", "R16", "R17",
+            "R18", "R19", "R20", "R21", "R22"]
 
 
 class TestCLI:
@@ -2053,7 +2056,9 @@ class TestCLI:
 
         target = tmp_path / "clean.py"
         target.write_text("def f(x):\n    return x\n")
-        assert main([str(target), "--no-baseline"]) == 0
+        # --no-ratchet for the same reason as --no-baseline: the repo's
+        # own ledgers describe the whole tree, not this tmp file
+        assert main([str(target), "--no-baseline", "--no-ratchet"]) == 0
         assert "0 findings" in capsys.readouterr().out
 
     def test_findings_exit_one_and_json(self, tmp_path):
@@ -2083,7 +2088,8 @@ class TestCLI:
 
         target = tmp_path / "dirty.py"
         target.write_text(textwrap.dedent(SNIPPET_WITH_FINDING))
-        assert main(["--select", "R01", str(target), "--no-baseline"]) == 0
+        assert main(["--select", "R01", str(target), "--no-baseline",
+                     "--no-ratchet"]) == 0
         capsys.readouterr()
 
 
@@ -2127,3 +2133,533 @@ class TestCarryInitProbe:
                 return params
 
         assert carry_init_takes_params(NoSignatureParams()) is True
+
+
+# ---------------------------------------------------------------------
+# R18–R22 lockset family (project scope; analyze_source runs them on a
+# single-module "program" so fixtures stay one snippet each)
+# ---------------------------------------------------------------------
+
+class TestR18:
+    def test_bare_write_next_to_locked_write_flagged(self):
+        found = findings("""
+            import threading
+
+            class Store:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.state = {}
+
+                def put(self, k, v):
+                    with self._lock:
+                        self.state[k] = v
+                        self.version = 1
+
+                def clear(self):
+                    self.version = 2
+        """, "R18")
+        assert len(found) == 1
+        assert found[0].symbol == "Store.clear"
+        assert "bare here" in found[0].message
+
+    def test_init_writes_never_count_as_bare(self):
+        """__init__ runs before the object escapes to other threads —
+        the constructor publishing unlocked fields is the normal idiom,
+        not a race."""
+        assert not findings("""
+            import threading
+
+            class Store:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.version = 0
+
+                def bump(self):
+                    with self._lock:
+                        self.version += 1
+        """, "R18")
+
+    def test_locked_suffix_convention_suppresses(self):
+        """Documented suppression: a `*_locked` helper asserts its
+        caller holds the lock — flagging its body would punish the
+        exact factoring the hint recommends."""
+        assert not findings("""
+            import threading
+
+            class Store:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.version = 0
+
+                def bump(self):
+                    with self._lock:
+                        self._bump_locked()
+                        self.version += 1
+
+                def _bump_locked(self):
+                    self.version += 1
+        """, "R18")
+
+
+class TestR19:
+    def test_inverted_order_flagged(self):
+        found = findings("""
+            import threading
+
+            a = threading.Lock()
+            b = threading.Lock()
+
+            def forward():
+                with a:
+                    with b:
+                        pass
+
+            def backward():
+                with b:
+                    with a:
+                        pass
+        """, "R19")
+        assert len(found) == 1
+        assert found[0].severity == "error"
+        assert "inversion" in found[0].message
+
+    def test_consistent_order_clean(self):
+        assert not findings("""
+            import threading
+
+            a = threading.Lock()
+            b = threading.Lock()
+
+            def one():
+                with a:
+                    with b:
+                        pass
+
+            def two():
+                with a:
+                    with b:
+                        pass
+        """, "R19")
+
+    def test_one_level_call_expansion(self):
+        """An inner acquire one call down still forms an edge: holder()
+        takes `a` then calls helper() which takes `b`; inverse() takes
+        b→a lexically."""
+        found = findings("""
+            import threading
+
+            a = threading.Lock()
+            b = threading.Lock()
+
+            def helper():
+                with b:
+                    pass
+
+            def holder():
+                with a:
+                    helper()
+
+            def inverse():
+                with b:
+                    with a:
+                        pass
+        """, "R19")
+        assert found
+
+
+class TestR20:
+    def test_thread_target_mutating_foreign_state_flagged(self):
+        found = findings("""
+            import threading
+
+            def poll(rep):
+                rep.health = "ok"
+
+            def start(rep):
+                t = threading.Thread(target=poll, args=(rep,))
+                t.daemon = True
+                t.start()
+        """, "R20")
+        assert len(found) == 1
+        assert "torn update" in found[0].message
+
+    def test_locked_foreign_write_clean(self):
+        assert not findings("""
+            import threading
+
+            def poll(rep):
+                with rep.lock:
+                    rep.health = "ok"
+
+            def start(rep):
+                t = threading.Thread(target=poll, args=(rep,))
+                t.daemon = True
+                t.start()
+        """, "R20")
+
+    def test_fresh_object_clean(self):
+        """Documented suppression boundary: an object the function
+        itself constructed cannot be shared yet — mutating it bare is
+        fine even on a thread."""
+        assert not findings("""
+            import threading
+
+            class Report:
+                pass
+
+            def poll(q):
+                rep = Report()
+                rep.health = "ok"
+                q.put(rep)
+
+            def start(q):
+                t = threading.Thread(target=poll, args=(q,))
+                t.daemon = True
+                t.start()
+        """, "R20")
+
+    def test_unreachable_helper_clean(self):
+        """A function no thread/callback/handler can reach is
+        single-threaded by construction — its bare foreign writes are
+        the caller's normal synchronous mutation."""
+        assert not findings("""
+            def tweak(cfg):
+                cfg.verbose = True
+        """, "R20")
+
+
+class TestR21:
+    def test_blocking_get_under_lock_flagged(self):
+        found = findings("""
+            import threading
+
+            class Pump:
+                def __init__(self, q):
+                    self._lock = threading.Lock()
+                    self._q = q
+
+                def drain(self):
+                    with self._lock:
+                        item = self._q.get()
+                        return item
+        """, "R21")
+        assert len(found) == 1
+        assert "block indefinitely" in found[0].message
+
+    def test_timeout_clean(self):
+        assert not findings("""
+            import threading
+
+            class Pump:
+                def __init__(self, q):
+                    self._lock = threading.Lock()
+                    self._q = q
+
+                def drain(self):
+                    with self._lock:
+                        return self._q.get(timeout=1.0)
+        """, "R21")
+
+    def test_condition_wait_idiom_exempt(self):
+        """Documented suppression: `with cond: cond.wait()` RELEASES
+        the lock while waiting — the one blocking-under-lock shape that
+        is not just correct but required by the API."""
+        assert not findings("""
+            import threading
+
+            class Gate:
+                def __init__(self):
+                    self._cond = threading.Condition()
+
+                def block_until_open(self):
+                    with self._cond:
+                        self._cond.wait()
+        """, "R21")
+
+
+class TestR22:
+    def test_unjoined_nondaemon_flagged(self):
+        found = findings("""
+            import threading
+
+            def work():
+                pass
+
+            def start():
+                t = threading.Thread(target=work)
+                t.start()
+                return t
+        """, "R22")
+        assert len(found) == 1
+        assert "never" in found[0].message
+
+    def test_daemon_clean(self):
+        assert not findings("""
+            import threading
+
+            def work():
+                pass
+
+            def start():
+                t = threading.Thread(target=work, daemon=True)
+                t.start()
+        """, "R22")
+
+    def test_joined_clean(self):
+        assert not findings("""
+            import threading
+
+            def work():
+                pass
+
+            def run():
+                t = threading.Thread(target=work)
+                t.start()
+                t.join()
+        """, "R22")
+
+    def test_list_append_loop_join_clean(self):
+        """Documented suppression: threads appended to a list and
+        joined in a loop ARE joined — matching `list:xs` idents keeps
+        the fan-out/fan-in idiom quiet."""
+        assert not findings("""
+            import threading
+
+            def work(i):
+                pass
+
+            def fan_out():
+                ts = []
+                for i in range(4):
+                    t = threading.Thread(target=work, args=(i,))
+                    ts.append(t)
+                    t.start()
+                for t in ts:
+                    t.join()
+        """, "R22")
+
+
+# ---------------------------------------------------------------------
+# ratchet: per-rule shrink-only counts
+# ---------------------------------------------------------------------
+
+class TestRatchet:
+    def _findings(self, n):
+        return [Finding(rule="R20", file=f"f{i}.py", line=1, col=0,
+                        severity="warning", message="m", hint="h",
+                        symbol="s", snippet=f"x = {i}")
+                for i in range(n)]
+
+    def test_round_trip(self, tmp_path):
+        from estorch_tpu.analysis import (check_ratchet, count_findings,
+                                          load_ratchet, save_ratchet)
+
+        path = str(tmp_path / "ratchet.json")
+        save_ratchet(path, count_findings(self._findings(2), ["R20"]))
+        recorded = load_ratchet(path)
+        assert recorded == {"R20": 2}
+        assert check_ratchet(recorded, self._findings(2)).ok()
+
+    def test_growth_is_regression(self, tmp_path):
+        from estorch_tpu.analysis import check_ratchet
+
+        res = check_ratchet({"R20": 1}, self._findings(3))
+        assert res.regressions == [("R20", 1, 3)]
+        assert not res.ok()
+
+    def test_shrink_is_stale(self):
+        """Fixing a race without lowering the count reports STALE, so
+        the improvement gets locked in instead of silently regressable."""
+        from estorch_tpu.analysis import check_ratchet
+
+        res = check_ratchet({"R20": 3}, self._findings(1))
+        assert res.stale == [("R20", 3, 1)]
+        assert not res.ok()
+
+    def test_missing_file_checks_nothing(self, tmp_path):
+        from estorch_tpu.analysis import check_ratchet, load_ratchet
+
+        recorded = load_ratchet(str(tmp_path / "nope.json"))
+        assert recorded == {}
+        assert check_ratchet(recorded, self._findings(5)).ok()
+
+    def test_cli_regression_exits_one(self, tmp_path, capsys):
+        from estorch_tpu.analysis.__main__ import main
+
+        dirty = tmp_path / "dirty.py"
+        dirty.write_text(textwrap.dedent("""
+            import threading
+
+            def poll(rep):
+                rep.health = "ok"
+
+            def start(rep):
+                t = threading.Thread(target=poll, args=(rep,), daemon=True)
+                t.start()
+        """))
+        ratchet = tmp_path / "ratchet.json"
+        ratchet.write_text('{"version": 1, "counts": {"R20": 0}}\n')
+        code = main([str(dirty), "--no-baseline",
+                     "--ratchet", str(ratchet)])
+        assert code == 1
+        assert "RATCHET regression" in capsys.readouterr().out
+
+    def test_cli_write_then_clean_then_stale(self, tmp_path, capsys):
+        from estorch_tpu.analysis.__main__ import main
+
+        dirty = tmp_path / "dirty.py"
+        dirty.write_text(textwrap.dedent("""
+            import threading
+
+            def poll(rep):
+                rep.health = "ok"
+
+            def start(rep):
+                t = threading.Thread(target=poll, args=(rep,), daemon=True)
+                t.start()
+        """))
+        ratchet = tmp_path / "ratchet.json"
+        # pin current counts; baseline suppression is separate, so run
+        # with --no-baseline and rely on the ratchet alone
+        assert main([str(dirty), "--no-baseline", "--select", "R20",
+                     "--ratchet", str(ratchet), "--write-ratchet"]) == 0
+        capsys.readouterr()
+        # still 1: ratchet bounds total debt; the finding itself is
+        # unsuppressed without a baseline
+        assert main([str(dirty), "--no-baseline", "--select", "R20",
+                     "--ratchet", str(ratchet)]) == 1
+        capsys.readouterr()
+        # fix the race -> count shrinks -> STALE (exit 2) until re-pinned
+        dirty.write_text("def poll(rep):\n    return rep\n")
+        assert main([str(dirty), "--no-baseline", "--select", "R20",
+                     "--ratchet", str(ratchet)]) == 2
+        assert "STALE ratchet" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------
+# CLI: --changed, --format=json, --jobs
+# ---------------------------------------------------------------------
+
+class TestChangedMode:
+    def _git(self, *args, cwd):
+        subprocess.run(["git", *args], cwd=cwd, check=True,
+                       capture_output=True, timeout=30,
+                       env={**os.environ,
+                            "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+                            "GIT_COMMITTER_NAME": "t",
+                            "GIT_COMMITTER_EMAIL": "t@t"})
+
+    def test_changed_analyzes_only_touched_files(self, tmp_path,
+                                                 monkeypatch, capsys):
+        from estorch_tpu.analysis.__main__ import main
+
+        self._git("init", "-q", cwd=tmp_path)
+        clean = tmp_path / "clean.py"
+        clean.write_text("def f(x):\n    return x\n")
+        dirty = tmp_path / "dirty.py"
+        dirty.write_text("def g(x):\n    return x\n")
+        self._git("add", ".", cwd=tmp_path)
+        self._git("commit", "-q", "-m", "base", cwd=tmp_path)
+        dirty.write_text(textwrap.dedent(SNIPPET_WITH_FINDING))
+        self._git("add", ".", cwd=tmp_path)
+        self._git("commit", "-q", "-m", "edit", cwd=tmp_path)
+
+        monkeypatch.chdir(tmp_path)
+        code = main(["--changed", "HEAD~1..HEAD", "--no-baseline"])
+        out = capsys.readouterr().out
+        assert code == 1
+        assert "dirty.py" in out and "clean.py" not in out
+
+    def test_changed_with_no_python_edits_exits_zero(self, tmp_path,
+                                                     monkeypatch, capsys):
+        from estorch_tpu.analysis.__main__ import main
+
+        self._git("init", "-q", cwd=tmp_path)
+        (tmp_path / "notes.txt").write_text("a\n")
+        self._git("add", ".", cwd=tmp_path)
+        self._git("commit", "-q", "-m", "base", cwd=tmp_path)
+        (tmp_path / "notes.txt").write_text("b\n")
+        self._git("add", ".", cwd=tmp_path)
+        self._git("commit", "-q", "-m", "edit", cwd=tmp_path)
+
+        monkeypatch.chdir(tmp_path)
+        assert main(["--changed", "HEAD~1..HEAD", "--no-baseline"]) == 0
+        assert "no changed python files" in capsys.readouterr().out
+
+    def test_bad_range_exits_three(self, tmp_path, monkeypatch, capsys):
+        from estorch_tpu.analysis.__main__ import main
+
+        self._git("init", "-q", cwd=tmp_path)
+        monkeypatch.chdir(tmp_path)
+        assert main(["--changed", "not-a-ref..HEAD",
+                     "--no-baseline"]) == 3
+        capsys.readouterr()
+
+
+class TestJsonFormat:
+    def test_format_json_includes_ratchet_block(self, tmp_path, capsys):
+        from estorch_tpu.analysis.__main__ import main
+
+        target = tmp_path / "clean.py"
+        target.write_text("def f(x):\n    return x\n")
+        ratchet = tmp_path / "ratchet.json"
+        ratchet.write_text('{"version": 1, "counts": {"R20": 0}}\n')
+        assert main(["--format=json", str(target), "--no-baseline",
+                     "--ratchet", str(ratchet)]) == 0
+        report = json.loads(capsys.readouterr().out)
+        assert report["findings"] == []
+        assert report["ratchet"]["regressions"] == []
+        assert report["ratchet"]["stale"] == []
+
+    def test_legacy_json_flag_still_works(self, tmp_path, capsys):
+        from estorch_tpu.analysis.__main__ import main
+
+        target = tmp_path / "clean.py"
+        target.write_text("def f(x):\n    return x\n")
+        assert main(["--json", str(target), "--no-baseline",
+                     "--no-ratchet"]) == 0
+        assert json.loads(capsys.readouterr().out)["findings"] == []
+
+
+class TestParallelEquivalence:
+    def test_pool_and_serial_agree(self, tmp_path, monkeypatch):
+        """The fork pool is an optimization, never a semantic change:
+        16+ files (the pool threshold) through jobs=2 and jobs=1 must
+        produce identical findings, including the project-scope pass
+        over summaries shipped back from workers."""
+        from estorch_tpu.analysis import analyze_paths, sort_findings
+
+        racy = textwrap.dedent("""
+            import threading
+
+            def poll(rep):
+                rep.health = "ok"
+
+            def start(rep):
+                t = threading.Thread(target=poll, args=(rep,), daemon=True)
+                t.start()
+        """)
+        for i in range(17):
+            (tmp_path / f"m{i:02d}.py").write_text(
+                racy if i % 3 == 0 else "def f(x):\n    return x\n")
+        monkeypatch.chdir(tmp_path)
+        serial = sort_findings(analyze_paths([str(tmp_path)], jobs=1))
+        pooled = sort_findings(analyze_paths([str(tmp_path)], jobs=2))
+        assert [f.to_dict() for f in serial] == [
+            f.to_dict() for f in pooled]
+        assert any(f.rule == "R20" for f in serial)
+
+
+class TestRuleTableSync:
+    def test_docs_table_matches_registry(self):
+        """docs/analysis.md embeds the generated rule table between
+        markers; regenerating must be a no-op or the catalog drifted."""
+        from estorch_tpu.analysis import render_rule_table
+
+        doc = os.path.join(os.path.dirname(__file__), "..", "docs",
+                           "analysis.md")
+        text = open(doc, encoding="utf-8").read()
+        begin, end = "<!-- BEGIN RULE TABLE -->", "<!-- END RULE TABLE -->"
+        assert begin in text and end in text
+        embedded = text.split(begin)[1].split(end)[0].strip()
+        assert embedded == render_rule_table().strip()

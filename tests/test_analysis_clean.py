@@ -3,10 +3,12 @@
 This is the self-application contract of the analyzer — every PR runs
 the same rules CI would run on user code against estorch_tpu's own
 ``algo/``, ``parallel/``, ``envs/``, ``host/``, ``ops/``, ``utils/``,
-with the repo's checked-in pyproject config and baseline.  Three things
+with the repo's checked-in pyproject config and baseline.  Four things
 fail it: a new unsuppressed finding, a stale baseline entry (the bug it
-suppressed was fixed — delete the entry), and a baseline entry with no
-justification.
+suppressed was fixed — delete the entry), a baseline entry with no
+justification, and a ratchet mismatch (more R18–R22 findings than the
+committed ceiling = new race debt; fewer = lower the ceiling so the
+improvement locks in).
 """
 
 from __future__ import annotations
@@ -15,7 +17,8 @@ import functools
 import os
 
 from estorch_tpu.analysis import (Baseline, all_rules, analyze_paths,
-                                  load_baseline, load_config,
+                                  check_ratchet, load_baseline,
+                                  load_config, load_ratchet,
                                   sort_findings)
 
 REPO_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
@@ -70,3 +73,21 @@ def test_baseline_entries_are_justified():
     assert not unjust, (
         "baseline entries need a `reason`: "
         + ", ".join(f"{e.rule}:{e.file}" for e in unjust))
+
+
+def test_ratchet_matches_current_counts():
+    """The committed per-rule ceiling must equal today's totals exactly:
+    growth is new race debt, shrink means someone fixed a race and must
+    re-pin (`--write-ratchet`) so the win cannot silently regress."""
+    cfg = load_config(os.path.join(REPO_ROOT, "pyproject.toml"))
+    ratchet_path = cfg.ratchet_path()
+    assert ratchet_path and os.path.exists(ratchet_path), (
+        "esguard_ratchet.json missing — the lockset debt ceiling must "
+        "be checked in")
+    _, res = _run_repo_analysis()
+    all_findings = res.unsuppressed + res.suppressed
+    check = check_ratchet(load_ratchet(ratchet_path), all_findings)
+    assert check.ok(), (
+        f"ratchet drift — regressions={check.regressions} "
+        f"stale={check.stale}; fix new races or re-pin with "
+        f"--write-ratchet")
