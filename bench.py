@@ -2185,6 +2185,13 @@ def measure_fleet_one(cfg):
         if not fleet.wait_ready(180):
             return {"error": "fleet did not come up",
                     "status": fleet.status()}
+        # INITIAL spawns carry the same warmth proof as respawns:
+        # wait_ready() pinned each slot's cold_start from /stats
+        initial_cold = [
+            {"replica": s.name,
+             "compiles_at_load": (s.cold_start or {}).get(
+                 "compiles_at_load")}
+            for s in fleet.slots]
         # declare the chaos only once the fleet serves: kill_at_s means
         # seconds into SERVING, not into the replicas' jax import
         os.environ[CHAOS_ENV] = json.dumps({
@@ -2225,6 +2232,7 @@ def measure_fleet_one(cfg):
             "counters": st["counters"],
             "respawned": respawned,
             "respawn_cold_start": cold,
+            "initial_cold_starts": initial_cold,
             "events": [e["event"] for e in fleet.events],
             "capacity": sweep,
             "platform": "cpu", "cfg": cfg,
@@ -2285,6 +2293,14 @@ def stage_fleet(selfcheck=False):
         if not c.get("router_breaker_opens_total"):
             problems.append("breaker never opened for the killed "
                             "replica")
+        # INITIAL spawns are judged by the same warmth bar as respawns
+        # (today's bundles make the first load free too)
+        for ic in row.get("initial_cold_starts") or []:
+            if ic.get("compiles_at_load") != 0:
+                problems.append(
+                    f"initial spawn {ic.get('replica')} was not warm: "
+                    f"compiles_at_load={ic.get('compiles_at_load')} "
+                    f"(want 0)")
         if not row["respawned"]:
             problems.append("fleet did not respawn the killed replica "
                             "(or its breaker never re-closed)")
@@ -2305,6 +2321,279 @@ def stage_fleet(selfcheck=False):
     ok = not problems
     print(json.dumps({"label": "fleet", **row, "problems": problems,
                       "pass": ok}), flush=True)
+    return 0 if ok else 1
+
+
+def measure_autoscale_one(cfg):
+    """Child body for --stage-autoscale-one: the full closed control
+    loop on loopback — warm bundle, 2-replica fleet, in-process
+    collector scraping the router into a store, capacity artifact from
+    a real sweep, and the autoscaler actuating over HTTP POST /scale.
+    Offered load triples mid-run, a declared ``kill_replica`` chaos
+    event lands during the scale-up, then traffic drops to a trickle so
+    the low-watermark path retires a replica.  Returns one JSON row;
+    stage_autoscale gates it."""
+    import threading
+
+    from estorch_tpu.utils import force_cpu_backend
+
+    force_cpu_backend(1)
+    import jax
+    import optax
+
+    from estorch_tpu import ES, JaxAgent
+    from estorch_tpu.envs.pendulum import Pendulum
+    from estorch_tpu.models import MLPPolicy
+    from estorch_tpu.obs.agg import autoscale as azmod
+    from estorch_tpu.obs.agg.collector import Collector, Target
+    from estorch_tpu.obs.agg.store import SeriesStore
+    from estorch_tpu.resilience.chaos import CHAOS_ENV
+    from estorch_tpu.serve.fleet import Fleet
+    from estorch_tpu.serve.loadgen import (capacity_sweep, run_load,
+                                           write_capacity_artifact)
+
+    hidden = int(cfg.get("hidden", 48))
+    max_batch = int(cfg.get("max_batch", 4))
+    slo_ms = float(cfg.get("slo_ms", 2000.0))
+    base_rps = float(cfg.get("base_rps", 25.0))
+    es = ES(
+        MLPPolicy, JaxAgent(Pendulum(), horizon=8), optax.adam,
+        population_size=4, sigma=0.05, seed=0,
+        policy_kwargs={"action_dim": 1, "hidden": (hidden, hidden),
+                       "discrete": False, "action_scale": 2.0},
+        optimizer_kwargs={"learning_rate": 0.01},
+        table_size=1 << 14, device=jax.devices()[0],
+    )
+    es.train(1, verbose=False)
+
+    import shutil
+
+    workdir = tempfile.mkdtemp(prefix="autoscale_bench_")
+    fleet = scaler = None
+    col_stop = threading.Event()
+    col_thread = None
+    try:
+        bundle = es.export_bundle(os.path.join(workdir, "bundle"),
+                                  warm=True, warm_max_batch=max_batch)
+        fleet = Fleet(
+            {"schema": 1, "bundle": bundle, "replicas": 2,
+             "serve": {"max_batch": max_batch, "cpu_devices": 1},
+             "router": {"retry_budget": 2, "breaker_open_s": 0.5},
+             "respawn": {"backoff_s": 0.2},
+             "autoscale": {"min_replicas": 2, "max_replicas": 4}},
+            os.path.join(workdir, "run"), port=0)
+        fleet.start()
+        if not fleet.wait_ready(180):
+            return {"error": "fleet did not come up",
+                    "status": fleet.status()}
+        addr = f"{fleet.router.host}:{fleet.router.port}"
+        # per-replica capacity model from a REAL sweep against one
+        # replica (not the router): the artifact the policy trusts
+        sweep = capacity_sweep(
+            fleet.slots[0].address, slo_ms=slo_ms,
+            rps_ladder=[float(cfg.get("cap_rps", 40.0))], conns=8,
+            rung_duration_s=float(cfg.get("cap_rung_s", 1.0)),
+            obs=[0.1, 0.2, 0.3])
+        if sweep.get("max_rps_at_slo") is None:
+            return {"error": f"capacity sweep saturated: {sweep}"}
+        cap_path = os.path.join(workdir, "capacity.json")
+        write_capacity_artifact(sweep, cap_path, bundle=bundle)
+        # in-process collector: scrape the router into the store the
+        # autoscaler reads — the daemon never sees the fleet directly
+        store_dir = os.path.join(workdir, "store")
+        col = Collector([Target("fleet", url=f"http://{addr}/metrics",
+                                timeout_s=5.0)],
+                        SeriesStore(store_dir), None, serve_http=False)
+
+        def scrape_loop():
+            while not col_stop.is_set():
+                col.tick()
+                col_stop.wait(0.4)
+
+        col_thread = threading.Thread(target=scrape_loop,
+                                      name="bench-collector",
+                                      daemon=True)
+        col_thread.start()
+        scaler = azmod.Autoscaler(
+            store_dir, capacity=cap_path, fleet_admin=addr,
+            interval_s=float(cfg.get("scaler_interval_s", 0.5)),
+            policy={"min_replicas": 2, "max_replicas": 4,
+                    "headroom": 1.2,
+                    "window_s": float(cfg.get("window_s", 5.0)),
+                    "up_cooldown_s": 3.0, "down_cooldown_s": 4.0,
+                    "low_watermark": 0.5,
+                    "low_hold_s": float(cfg.get("low_hold_s", 3.0))})
+        scaler.start_background()
+        # chaos declared now: at_s counts from arm — the kill lands in
+        # the high-load phase, i.e. during/just after the scale-up
+        os.environ[CHAOS_ENV] = json.dumps({
+            "events": [{"kind": "kill_replica",
+                        "at_s": float(cfg.get("kill_at_s", 8.0)),
+                        "replica": 1}],
+            "ledger": os.path.join(workdir, "chaos_ledger")})
+        fleet.arm_chaos()
+        phases = {}
+        # phase A: baseline load the min fleet absorbs (target < min)
+        phases["base"] = run_load(
+            addr, mode="open", target_rps=base_rps,
+            duration_s=float(cfg.get("base_s", 5.0)),
+            conns=8, obs=[0.1, 0.2, 0.3])
+        # phase B: offered load TRIPLES — demand math wants 3 replicas
+        phases["spike"] = run_load(
+            addr, mode="open", target_rps=base_rps * 3,
+            duration_s=float(cfg.get("spike_s", 10.0)),
+            conns=16, obs=[0.1, 0.2, 0.3])
+        # the scale-up may still be spawning when the spike ends: wait
+        # for desired AND actual to converge above the floor
+        scaled_up = False
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 120:
+            sc = fleet.status()["scale"]
+            if sc["desired"] > 2 and sc["actual"] >= sc["desired"]:
+                scaled_up = True
+                break
+            time.sleep(0.2)
+        up_status = fleet.status()
+        # phase C: trickle — utilization sits under the low watermark
+        # until the sustained window retires a replica, drained
+        phases["trickle"] = run_load(
+            addr, mode="open", target_rps=float(cfg.get("trickle_rps",
+                                                        4.0)),
+            duration_s=float(cfg.get("trickle_s", 14.0)),
+            conns=4, obs=[0.1, 0.2, 0.3])
+        scaled_down = False
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 60:
+            sc = fleet.status()["scale"]
+            if sc["desired"] < up_status["scale"]["desired"] \
+                    and sc["actual"] == sc["desired"]:
+                scaled_down = True
+                break
+            time.sleep(0.2)
+        scaler.stop()
+        col_stop.set()
+        rep = azmod.replay(scaler.log_path)
+        events = [e["event"] for e in fleet.events]
+        scale_events = [e for e in fleet.events
+                        if e["event"].startswith("scale_")
+                        or e["event"].startswith("replica_retir")]
+        return {
+            "phases": {k: {kk: v[kk] for kk in
+                           ("requests", "errors", "shed",
+                            "throughput_rps", "latency_ms")}
+                       for k, v in phases.items()},
+            "capacity": {"max_rps_at_slo": sweep["max_rps_at_slo"],
+                         "slo_ms": sweep["slo_ms"]},
+            "scaled_up": scaled_up,
+            "scaled_down": scaled_down,
+            "scale_status": fleet.status()["scale"],
+            "scale_events": scale_events,
+            "events": events,
+            "counters": fleet.router.stats()["counters"],
+            "replay": {"ok": rep["ok"], "decisions": rep["decisions"],
+                       "mismatches": rep["mismatches"][:3]},
+            "platform": "cpu", "cfg": cfg,
+        }
+    finally:
+        if scaler is not None:
+            scaler.stop()
+        col_stop.set()
+        if col_thread is not None:
+            col_thread.join(timeout=10)
+        if fleet is not None:
+            fleet.shutdown()
+        os.environ.pop(CHAOS_ENV, None)
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+def stage_autoscale(selfcheck=False):
+    """Autoscaler E2E gate (obs/agg/autoscale.py + serve/fleet.py,
+    docs/serving.md "Autoscaling"); the selfcheck form is the
+    run_lint.sh gate.  Gates: offered load triples mid-run and the
+    replica count demonstrably tracks it (up past the floor, back down
+    after the trickle), p99 stays inside the SLO through every phase,
+    ZERO client errors/shed including through a declared kill_replica
+    during the scale-up, every scale-up replica loads warm
+    (compiles_at_load == 0), the retirement drains cleanly, and the
+    decision log replays bit-exactly."""
+    cfg = ({"hidden": 48, "base_rps": 25.0, "base_s": 5.0,
+            "spike_s": 10.0, "trickle_s": 14.0, "kill_at_s": 8.0}
+           if selfcheck else
+           {"hidden": 256, "base_rps": 40.0, "base_s": 8.0,
+            "spike_s": 15.0, "trickle_s": 20.0, "kill_at_s": 12.0,
+            "cap_rps": 60.0, "cap_rung_s": 2.0})
+    argv = [sys.executable, __file__, "--stage-autoscale-one",
+            json.dumps(cfg)]
+    child_env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    try:
+        r = subprocess.run(argv, timeout=900, capture_output=True,
+                           text=True, env=child_env)
+    except subprocess.TimeoutExpired:
+        print(json.dumps({"label": "autoscale",
+                          "error": "timeout after 900s"}), flush=True)
+        return 1
+    try:
+        last = [ln for ln in r.stdout.strip().splitlines()
+                if ln.startswith("{")][-1]
+        row = json.loads(last)
+    except (IndexError, ValueError):
+        print(json.dumps({"label": "autoscale", "error":
+                          f"stage exited {r.returncode}",
+                          "stderr_tail": r.stderr[-800:]}), flush=True)
+        return 1
+    problems = []
+    if row.get("error"):
+        problems.append(row["error"])
+    else:
+        slo_ms = row["capacity"]["slo_ms"]
+        for name, load in row["phases"].items():
+            if load["errors"] or load["shed"]:
+                problems.append(
+                    f"{name}: lost client answers: {load['errors']} "
+                    f"errors, {load['shed']} shed of "
+                    f"{load['requests']}")
+            if load["latency_ms"]["p99"] > slo_ms:
+                problems.append(
+                    f"{name}: p99 {load['latency_ms']['p99']}ms "
+                    f"breached the {slo_ms}ms SLO")
+        if row["phases"]["spike"]["requests"] < 100:
+            problems.append("spike phase too thin to prove tracking")
+        if not row["scaled_up"]:
+            problems.append(
+                f"replica count never tracked the 3x load spike: "
+                f"{row['scale_status']}")
+        if not row["scaled_down"]:
+            problems.append(
+                f"no scale-down after the trickle window: "
+                f"{row['scale_status']}")
+        # every scale_up must be matched by a scale_up_warm proof
+        # (compiles_at_load == 0 read off the new replica's /stats)
+        for ev in row["scale_events"]:
+            if ev["event"] == "scale_up_cold":
+                problems.append(f"scale-up spawned COLD: {ev}")
+        ups = [e for e in row["scale_events"]
+               if e["event"] == "scale_up"]
+        warm = [e for e in row["scale_events"]
+                if e["event"] == "scale_up_warm"]
+        if row["scaled_up"] and not ups:
+            problems.append("scale-up left no added-replica evidence")
+        if len(warm) < len(ups):
+            problems.append(f"{len(ups)} scale-up(s) but only "
+                            f"{len(warm)} warm proof(s)")
+        retired = [e for e in row["scale_events"]
+                   if e["event"] == "replica_retired"]
+        if row["scaled_down"] and not any(e.get("drained")
+                                          for e in retired):
+            problems.append(f"retirement did not drain: {retired}")
+        if "chaos_kill_replica" not in row["events"]:
+            problems.append("declared kill_replica chaos never fired")
+        if not row["replay"]["ok"] or not row["replay"]["decisions"]:
+            problems.append(
+                f"decision log did not replay bit-exactly: "
+                f"{row['replay']}")
+    ok = not problems
+    print(json.dumps({"label": "autoscale", **row,
+                      "problems": problems, "pass": ok}), flush=True)
     return 0 if ok else 1
 
 
@@ -2705,6 +2994,13 @@ no arguments        full headline benchmark (device probe decides the
                     loses zero client answers, breaker opens/closes,
                     warm respawn (compiles_at_load==0), capacity-sweep
                     max-RPS-at-SLO ladder
+  --autoscale [--selfcheck]  autoscaler E2E gate: collector store +
+                    capacity artifact + POST /scale close the loop —
+                    load triples mid-run, gates p99-in-SLO, zero client
+                    errors/shed (including through a declared
+                    kill_replica during the scale-up), replica count
+                    tracking load both directions, warm scale-ups,
+                    drained retirement, bit-exact decision-log replay
   --coldstart [--selfcheck]  warm-bundle vs cold-start A/B + bf16
                     steady-state throughput (gates zero-fresh-builds
                     warm loads, warm-beats-cold TTFR beyond the learned
@@ -2727,7 +3023,8 @@ no arguments        full headline benchmark (device probe decides the
   --regress [BASELINE] [--repeats N] [--cpu]   gate vs newest BENCH_r*.json
 (--stage-one/--stage-chaos-one/--stage-async-one/--stage-elastic-one/
  --stage-elastic-worker/--stage-serve-one/--stage-fleet-one/
- --stage-shard-ab-one/--stage-scenario-one are internal child modes)
+ --stage-autoscale-one/--stage-shard-ab-one/--stage-scenario-one are
+ internal child modes)
 """
 
 
@@ -2802,6 +3099,16 @@ if __name__ == "__main__":
     elif "--stage-fleet-one" in sys.argv:
         cfg = json.loads(sys.argv[sys.argv.index("--stage-fleet-one") + 1])
         print(json.dumps(measure_fleet_one(cfg)))
+    elif "--stage-autoscale-one" in sys.argv:
+        cfg = json.loads(
+            sys.argv[sys.argv.index("--stage-autoscale-one") + 1])
+        print(json.dumps(measure_autoscale_one(cfg)))
+    elif "--autoscale" in sys.argv:
+        # the selfcheck form runs inside run_lint.sh (tiny policy, CPU,
+        # loopback only): skip the evidence lock a full measurement takes
+        if "--selfcheck" not in sys.argv:
+            _lock_or_warn()
+        sys.exit(stage_autoscale(selfcheck="--selfcheck" in sys.argv))
     elif "--fleet" in sys.argv:
         # the selfcheck form runs inside run_lint.sh (tiny policy, CPU,
         # loopback only): skip the evidence lock a full measurement takes

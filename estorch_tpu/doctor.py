@@ -1221,6 +1221,85 @@ def check_collector() -> dict:
         return {"ok": False, "error": repr(e)}
 
 
+def check_autoscaler() -> dict:
+    """Can this host close the serving control loop?  (obs/agg/
+    autoscale.py, docs/serving.md "Autoscaling")
+
+    Loopback decision dry-run: seed a synthetic store with a demand
+    ramp, write a matching capacity artifact, and run one control cycle
+    with ``dry_run`` — the decision must be a scale-up, logged to the
+    append-only decision log, and the log must replay bit-exactly.  A
+    mismatched capacity model (wrong bundle sha) must be REFUSED.
+    Stdlib only, never touches jax, never crashes the report."""
+    import json as _json
+    import os
+    import tempfile
+
+    try:
+        from .obs.agg import autoscale as _az
+        from .obs.agg.store import SeriesStore
+
+        problems = []
+        with tempfile.TemporaryDirectory() as d:
+            store = SeriesStore(os.path.join(d, "store"))
+            t0 = 1_000_000.0
+            for ts, total in ((t0, 0.0), (t0 + 10, 100.0)):
+                store.append([
+                    {"name": "estorch_router_requests_total",
+                     "labels": {"target": "probe"}, "value": total},
+                    {"name": "estorch_router_replica_up",
+                     "labels": {"target": "probe", "replica": "r0"},
+                     "value": 1.0},
+                ], ts=ts)
+            cap_path = os.path.join(d, "capacity.json")
+            capacity = {"schema": _az.CAPACITY_SCHEMA, "kind": "capacity",
+                        "created_ts": t0, "slo_ms": 50.0,
+                        "quantile": "p99", "max_rps_at_slo": 5.0,
+                        "saturated": False,
+                        "rungs": [{"offered_rps": 5.0, "ok": True}],
+                        "bundle_sha": "ab" * 32, "bundle_version": 1,
+                        "platform": "cpu"}
+            with open(cap_path, "w") as f:
+                _json.dump(capacity, f)
+            bad = _az.validate_capacity(capacity)
+            if bad:
+                problems.append(f"capacity artifact rejected: {bad}")
+            az = _az.Autoscaler(
+                os.path.join(d, "store"), capacity=cap_path,
+                fleet_identity={"bundle_sha": "ab" * 32,
+                                "platform": "cpu"},
+                policy={"min_replicas": 1, "max_replicas": 8,
+                        "window_s": 10.0}, dry_run=True)
+            # 10 rps against 5 rps/replica: the only sane verdict is up
+            ev = az.tick(now=t0 + 10)
+            if ev is None or ev["verdict"]["action"] != "up":
+                problems.append(f"dry-run decision not a scale-up: "
+                                f"{ev and ev['verdict']}")
+            elif ev["actuation"] != {"attempted": False,
+                                     "dry_run": True}:
+                problems.append(f"dry-run actuated: {ev['actuation']}")
+            rep = _az.replay(az.log_path)
+            if not rep["ok"]:
+                problems.append(f"decision log replay mismatch: "
+                                f"{rep['mismatches'][:2]}")
+            try:
+                _az.Autoscaler(
+                    os.path.join(d, "store"), capacity=cap_path,
+                    fleet_identity={"bundle_sha": "cd" * 32,
+                                    "platform": "cpu"},
+                    dry_run=True)
+                problems.append("mismatched capacity model accepted")
+            except _az.AutoscaleError as e:
+                # the refusal IS the pass; gate that it names both shas
+                if "cd" * 6 not in str(e):
+                    problems.append(
+                        f"mismatch refusal names neither sha: {e}")
+        return {"ok": not problems,
+                **({"problems": problems} if problems else {})}
+    except Exception as e:  # diagnostic tool: never crash the report
+        return {"ok": False, "error": repr(e)}
+
+
 def report(timeout_s: float = 45.0, run_dir: str | None = None,
            resilience_probe: bool = False,
            serve_bundle: str | None = None) -> dict:
@@ -1259,6 +1338,7 @@ def report(timeout_s: float = 45.0, run_dir: str | None = None,
         "resilience": check_resilience(probe=resilience_probe),
         "serve": check_serve(bundle=serve_bundle),
         "router": check_router(),
+        "autoscaler": check_autoscaler(),
     }
     cpu_recipe = (
         "run on the virtual CPU mesh instead — jax.config.update("

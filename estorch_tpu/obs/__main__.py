@@ -1,5 +1,5 @@
 """obs CLI: summarize / trace / profile / regress / hist / serve-metrics
-/ collect / dash.
+/ collect / dash / autoscale.
 
 Subcommands (docs/observability.md):
 
@@ -66,8 +66,17 @@ Subcommands (docs/observability.md):
   dash --store DIR [--once | --watch SECS] [--window S] [--json]
       Terminal fleet console over a collector store: per-target up/down,
       stored-history request/dispatch quantiles, queue depth, recompile
-      increase, active alerts.  File form:
-      ``python estorch_tpu/obs/agg/dash.py``.
+      increase, active alerts, autoscaler desired-vs-actual + decision
+      age.  File form: ``python estorch_tpu/obs/agg/dash.py``.
+
+  autoscale --store DIR --capacity capacity.json --fleet-admin H:P
+      Autoscaler daemon (obs/agg/autoscale.py, docs/serving.md
+      "Autoscaling"): read the collector store + persisted capacity
+      model, decide desired replicas via the documented policy, actuate
+      the fleet's ``POST /scale``, log every decision append-only;
+      ``--replay LOG`` re-derives decisions bit-exactly, ``--selfcheck``
+      is the run_lint.sh gate.  Wedged-host file form:
+      ``python estorch_tpu/obs/agg/autoscale.py``.
 
 Exit codes: 0 ok; 1 selfcheck problems / unreadable input / regression;
 2 bad run dir / bad targets or rules file; 3 bad usage.
@@ -197,6 +206,10 @@ def build_parser() -> argparse.ArgumentParser:
     sub.add_parser("dash", add_help=False,
                    help="terminal fleet console over a collector store "
                         "(obs/agg/dash.py owns the flags)")
+    sub.add_parser("autoscale", add_help=False,
+                   help="autoscaler daemon: store + capacity model -> "
+                        "fleet POST /scale (obs/agg/autoscale.py owns "
+                        "the flags)")
     return p
 
 
@@ -505,6 +518,10 @@ def main(argv: list[str] | None = None) -> int:
         from .agg import dash as _dash
 
         return _dash.main(argv[1:])
+    if argv[:1] == ["autoscale"]:
+        from .agg import autoscale as _autoscale
+
+        return _autoscale.main(argv[1:])
     args = build_parser().parse_args(argv)
     if args.cmd == "summarize":
         return _cmd_summarize(args)

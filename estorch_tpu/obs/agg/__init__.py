@@ -18,7 +18,12 @@ the plane that watches N of them at once and remembers what it saw
   firing/resolved transitions appended to an alerts ledger;
 - **dash** — ``obs dash``: the fleet as one terminal table (per-target
   up/down, stored-history latency quantiles, queue depth, recompiles,
-  active alerts).
+  desired-vs-actual replica convergence, active alerts);
+- **autoscale** — ``obs autoscale``: the closed control loop
+  (docs/serving.md, "Autoscaling"): a pure policy step over the
+  store's signals + a measured capacity artifact, actuating the
+  fleet's ``POST /scale`` and appending every decision to a
+  bit-exactly replayable log.
 
 Every module is stdlib-only and file-runnable without the package (the
 sidecar's wedged-jax discipline): the fleet plane must keep answering
@@ -27,11 +32,20 @@ while the runtime it watches is hung.
 
 from .collector import (Collector, Target, load_targets, scrape_prometheus,
                         scrape_run_dir, validate_targets)
+from .autoscale import (Autoscaler, AutoscaleError, decide, load_capacity,
+                        read_decisions, replay, validate_capacity)
 from .dash import fleet_snapshot, render
 from .rules import RulesEngine, load_rules, read_ledger, validate_rules
 from .store import SeriesStore
 
 __all__ = [
+    "Autoscaler",
+    "AutoscaleError",
+    "decide",
+    "load_capacity",
+    "read_decisions",
+    "replay",
+    "validate_capacity",
     "Collector",
     "Target",
     "load_targets",

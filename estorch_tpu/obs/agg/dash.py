@@ -19,6 +19,13 @@ ledger.  Missing metrics render as ``-`` — a training run has no
 request latencies and a serve replica has no generations, and the dash
 must say so rather than fabricate.
 
+Autoscaled router targets (obs/agg/autoscale.py) get two more columns,
+derived from the store + the append-only decision log alone: ``scale``
+is desired-vs-actual replicas (``3→5`` while the fleet converges, a
+bare count once it has), ``scale age`` is seconds since the last
+decision event in ``autoscale_decisions.jsonl``.  Non-autoscaled
+targets render ``-`` in both.
+
 ``--once`` prints one frame (scriptable, CI-friendly); ``--watch N``
 redraws every N seconds until interrupted.
 
@@ -35,6 +42,7 @@ import sys
 import time
 
 if __package__:
+    from .autoscale import DECISIONS_FILENAME, read_decisions
     from .rules import (LEDGER_FILENAME, LEDGER_MAX_TRANSITIONS,
                         read_ledger)
     from .store import SeriesStore
@@ -51,10 +59,13 @@ else:  # file-run: load siblings without any package init
 
     _store = _load("_estorch_obs_agg_store", "store.py")
     _rules = _load("_estorch_obs_agg_rules", "rules.py")
+    _autoscale = _load("_estorch_obs_agg_autoscale", "autoscale.py")
     SeriesStore = _store.SeriesStore
     read_ledger = _rules.read_ledger
     LEDGER_FILENAME = _rules.LEDGER_FILENAME
     LEDGER_MAX_TRANSITIONS = _rules.LEDGER_MAX_TRANSITIONS
+    DECISIONS_FILENAME = _autoscale.DECISIONS_FILENAME
+    read_decisions = _autoscale.read_decisions
 
 REQUEST_HIST = "estorch_serve_request_s"
 DISPATCH_HIST = "estorch_async_fold_latency_s"
@@ -95,6 +106,13 @@ def fleet_snapshot(store_root: str, *, window_s: float = 60.0,
             active[key] = t
         elif t.get("event") == "resolved":
             active.pop(key, None)
+    # autoscaler state: last decision event per target from the
+    # append-only log — the dash needs no live autoscaler, the log +
+    # store ARE the source of truth (obs/agg/autoscale.py)
+    last_decision: dict[str, dict] = {}
+    for ev in read_decisions(os.path.join(store_root,
+                                          DECISIONS_FILENAME)):
+        last_decision[str(ev.get("target"))] = ev
     rows = []
     for name in targets:
         labels = {"target": name}
@@ -143,6 +161,27 @@ def fleet_snapshot(store_root: str, *, window_s: float = 60.0,
                     (r["p99_s"] for r in replicas.values()
                      if "p99_s" in r), default=None),
             }
+        # autoscale columns: desired from the router's exported gauge
+        # (falls back to the last decision's verdict), actual from the
+        # replica_up gauges, age from the decision log — None for
+        # non-autoscaled targets so render shows '-'
+        autoscale = None
+        dec = last_decision.get(name)
+        desired_g = latest("estorch_router_desired_replicas")
+        if router is not None and (dec is not None
+                                   or desired_g is not None):
+            desired = (int(desired_g) if desired_g is not None
+                       else (dec.get("verdict") or {}).get("desired"))
+            autoscale = {
+                "desired": desired,
+                "actual": sum(1 for _ts, _lab, v in replica_up.values()
+                              if v == 1.0),
+                "last_decision_ts": dec["ts"] if dec else None,
+                "decision_age_s": (round(now - float(dec["ts"]), 3)
+                                   if dec else None),
+                "last_action": ((dec.get("verdict") or {}).get("action")
+                                if dec else None),
+            }
         rows.append({
             "target": name,
             "up": bool(up == 1.0),
@@ -173,6 +212,7 @@ def fleet_snapshot(store_root: str, *, window_s: float = 60.0,
             "hosts_lost": store.increase("estorch_hosts_lost", labels,
                                          window_s, now),
             "router": router,
+            "autoscale": autoscale,
             "alerts": sorted(rule for (rule, tgt) in active
                              if tgt == name),
         })
@@ -191,7 +231,8 @@ def render(store_root: str, *, window_s: float = 60.0,
                           store=store)
     header = ("target", "up", "gen", "cold", "req p50/p99 ms",
               "disp p99 ms", "hosts", "host p99 ms", "queue", "recomp",
-              "brk", "retry", "hedge", "repl p99", "alerts")
+              "brk", "retry", "hedge", "repl p99", "scale", "scale age",
+              "alerts")
     table = [header]
     for row in snap["targets"]:
         # cold: startup seconds, suffixed ! when the replica paid fresh
@@ -221,6 +262,16 @@ def render(store_root: str, *, window_s: float = 60.0,
             repl_p99 = _fmt_ms(ro["worst_p99_s"])
         else:
             brk = retry = hedge = repl_p99 = "-"
+        # scale: desired vs actual replicas — `3→5` while converging, a
+        # bare count once converged; scale age: seconds since the last
+        # autoscaler decision — non-autoscaled targets honestly show '-'
+        az = row.get("autoscale")
+        scale = scale_age = "-"
+        if az and az.get("desired") is not None:
+            scale = (f"{az['actual']}" if az["actual"] == az["desired"]
+                     else f"{az['actual']}→{az['desired']}")
+        if az and az.get("decision_age_s") is not None:
+            scale_age = f"{az['decision_age_s']:.0f}s"
         # hosts: elastic membership count, suffixed !N when N host
         # deaths landed inside the window (a shrinking fleet should
         # jump out of the table the way open breakers do)
@@ -241,7 +292,7 @@ def render(store_root: str, *, window_s: float = 60.0,
             _fmt_ms(row["host_fold_p99_s"]),
             _fmt_num(row["queue_depth"]),
             _fmt_num(row["recompiles"]),
-            brk, retry, hedge, repl_p99,
+            brk, retry, hedge, repl_p99, scale, scale_age,
             ",".join(row["alerts"]) or "-",
         ))
     widths = [max(len(str(r[i])) for r in table)

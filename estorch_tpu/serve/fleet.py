@@ -39,6 +39,17 @@ once-semantics ledger — resilience/chaos.py) are fired by the monitor
 loop, so a fleet test schedules its SIGKILL instead of ad-hoc
 ``os.kill``.
 
+Scaling (docs/serving.md "Autoscaling"): ``POST /scale {"replicas": N}``
+on the router is the fleet's admin surface — the autoscaler daemon
+(obs/agg/autoscale.py) actuates here.  Slot ADD is a warm spawn from
+the incumbent bundle, gated on ``compiles_at_load == 0`` (the PR-12
+warmth proof, recorded per slot).  Slot REMOVE is drain-then-retire:
+the router deselects the least-loaded replica FIRST, in-flight answers
+complete, THEN the replica gets SIGTERM (its own drain path) — a
+retirement costs zero client errors.  ``--autoscale`` embeds the
+autoscaler loop in this supervisor (fleet.json ``autoscale`` block:
+``store``, ``capacity``, policy knobs).
+
 Stdlib-only, jax-free, file-runnable (``python
 estorch_tpu/serve/fleet.py``): replicas are subprocesses that pay the
 jax import; the supervisor that must outlive them never does.
@@ -82,6 +93,11 @@ else:  # file-run (wedged-jax host): load siblings without any package init
 
 FLEET_SCHEMA = 1
 START_TIMEOUT_S = 180.0
+# scale-down: bound on waiting for router-side in-flight to a retiring
+# replica to reach zero, and on the SIGTERMed replica's own drain
+# (server.py DRAIN_GRACE_S=15 + margin)
+RETIRE_INFLIGHT_WAIT_S = 20.0
+RETIRE_REAP_S = 25.0
 
 ROLLOUT_DEFAULTS = {
     "shadow_fraction": 0.5,
@@ -108,9 +124,21 @@ def validate_fleet_config(obj) -> list[str]:
     n = obj.get("replicas")
     if not isinstance(n, int) or isinstance(n, bool) or n < 1:
         problems.append("replicas: required, integer >= 1")
-    for section in ("serve", "router", "respawn", "rollout"):
+    for section in ("serve", "router", "respawn", "rollout", "autoscale"):
         if section in obj and not isinstance(obj[section], dict):
             problems.append(f"{section}: must be an object")
+    az = obj.get("autoscale")
+    if isinstance(az, dict):
+        mn, mx = az.get("min_replicas", 1), az.get("max_replicas", 64)
+        for key, v in (("min_replicas", mn), ("max_replicas", mx)):
+            if not isinstance(v, int) or isinstance(v, bool) or v < 1:
+                problems.append(
+                    f"autoscale.{key}: must be an integer >= 1")
+        if (isinstance(mn, int) and isinstance(mx, int)
+                and not isinstance(mn, bool) and not isinstance(mx, bool)
+                and mn > mx):
+            problems.append(
+                "autoscale.min_replicas: must be <= max_replicas")
     ro = obj.get("rollout") or {}
     frac = ro.get("shadow_fraction",
                   ROLLOUT_DEFAULTS["shadow_fraction"])
@@ -131,6 +159,11 @@ def load_fleet_config(path: str) -> dict:
     base = os.path.dirname(os.path.abspath(path))
     if not os.path.isabs(obj["bundle"]):
         obj["bundle"] = os.path.join(base, obj["bundle"])
+    az = obj.get("autoscale")
+    if isinstance(az, dict):
+        for key in ("store", "capacity"):
+            if isinstance(az.get(key), str) and not os.path.isabs(az[key]):
+                az[key] = os.path.join(base, az[key])
     return obj
 
 
@@ -141,7 +174,7 @@ class _Slot:
 
     __slots__ = ("index", "name", "proc", "port_file", "log_path",
                  "address", "state", "started_at", "restarts",
-                 "next_spawn_at", "down_since", "wedged")
+                 "next_spawn_at", "down_since", "wedged", "cold_start")
 
     def __init__(self, index: int, workdir: str):
         self.index = index
@@ -150,12 +183,15 @@ class _Slot:
         self.port_file = os.path.join(workdir, f"{self.name}_port.json")
         self.log_path = os.path.join(workdir, f"{self.name}.log")
         self.address: str | None = None
-        self.state = "down"  # down | starting | up
+        self.state = "down"  # down | starting | up | retiring
         self.started_at = 0.0
         self.restarts = 0
         self.next_spawn_at = 0.0
         self.down_since: float | None = None
         self.wedged = False
+        # last recorded /stats cold_start facts (warmth proof for the
+        # INITIAL spawn and every scale-up: compiles_at_load == 0)
+        self.cold_start: dict | None = None
 
 
 class Fleet:
@@ -180,6 +216,9 @@ class Fleet:
                                                  start_timeout_s))
         self.rollout_cfg = {**ROLLOUT_DEFAULTS,
                             **(config.get("rollout") or {})}
+        self.autoscale_cfg = (dict(config["autoscale"])
+                              if isinstance(config.get("autoscale"), dict)
+                              else None)
         rc = config.get("router") or {}
         self.router = Router(
             [], host=host, port=port,
@@ -192,9 +231,18 @@ class Fleet:
             breaker_failures=int(rc.get("breaker_failures", 3)),
             breaker_open_s=float(rc.get("breaker_open_s", 1.0)),
             rollout_cb=self._rollout_cb,
+            scale_cb=self._scale_cb,
         )
         self.slots = [_Slot(i, self.workdir)
                       for i in range(int(config["replicas"]))]
+        # scaling state: slot indices only grow (a retired r2 never
+        # comes back — a fresh slot gets a fresh name, so breaker and
+        # log history never alias across lives)
+        self._next_index = int(config["replicas"])
+        self.desired = int(config["replicas"])
+        self.router.desired_replicas = self.desired
+        self._scale_lock = threading.Lock()  # one scale op in flight
+        self._last_scale: dict | None = None
         # slot state machine fields (state/proc/timers) are written by
         # BOTH the monitor thread (_tick) and the rollout thread
         # (rollback kills) — every mutation holds this lock; process
@@ -217,6 +265,12 @@ class Fleet:
         with self._events_lock:
             self.events.append({"ts": time.time(), "event": kind, **extra})
             del self.events[:-500]
+
+    def _slots_snapshot(self) -> list[_Slot]:
+        """Point-in-time copy: the slot LIST is mutated by the scale
+        thread (add/retire), so every iterator takes a snapshot."""
+        with self._slots_lock:
+            return list(self.slots)
 
     # -------------------------------------------------------------- spawn
 
@@ -312,13 +366,14 @@ class Fleet:
 
     def _tick(self) -> None:
         now = time.monotonic()
+        slots = self._slots_snapshot()
         # declared serving chaos (ESTORCH_CHAOS): same plan + ledger as
         # training faults, keyed on seconds since the fleet armed
         for ev in _chaos.serve_faults(now - self._armed_mono):
             idx = int(ev.get("replica", 0))
-            if not 0 <= idx < len(self.slots):
+            if not 0 <= idx < len(slots):
                 continue
-            slot = self.slots[idx]
+            slot = slots[idx]
             proc = slot.proc
             if proc is None or proc.poll() is not None:
                 continue
@@ -332,7 +387,9 @@ class Fleet:
                             pid=proc.pid)
         router_health = {r.name: r.health
                         for r in self.router.replicas()}
-        for slot in self.slots:
+        for slot in slots:
+            if slot.state == "retiring":
+                continue  # the scale thread owns its drain + reap
             if slot.state == "starting":
                 if slot.proc is not None and slot.proc.poll() is not None:
                     self._event("replica_died", replica=slot.name,
@@ -401,21 +458,59 @@ class Fleet:
         self._armed_mono = time.monotonic()
 
     def wait_ready(self, timeout_s: float = START_TIMEOUT_S) -> bool:
-        """Block until every slot is up (True) or the timeout passes."""
+        """Block until every slot is up (True) or the timeout passes.
+        On readiness, each slot's ``/stats`` cold-start facts are
+        recorded (``slot.cold_start``): the INITIAL spawn gets the same
+        warmth proof as respawns — ``compiles_at_load == 0``."""
         deadline = time.monotonic() + float(timeout_s)
+        ready = False
         while time.monotonic() < deadline:
-            if all(s.state == "up" for s in self.slots):
-                return True
+            if all(s.state == "up" for s in self._slots_snapshot()):
+                ready = True
+                break
             if self._stop.wait(0.1):
                 return False
-        return all(s.state == "up" for s in self.slots)
+        ready = ready or all(s.state == "up"
+                             for s in self._slots_snapshot())
+        if ready:
+            for slot in self._slots_snapshot():
+                if slot.cold_start is None:
+                    self._record_cold_start(slot)
+        return ready
+
+    def _record_cold_start(self, slot: _Slot) -> dict | None:
+        """Pin the replica's ``/stats`` ``cold_start`` block on its slot
+        (best-effort: a momentarily-slow replica is still up)."""
+        addr = slot.address
+        if addr is None:
+            return None
+        host, _, port = addr.partition(":")
+        try:
+            conn = http.client.HTTPConnection(host, int(port),
+                                              timeout=10.0)
+        except ValueError:
+            return None
+        try:
+            conn.request("GET", "/stats")
+            stats = json.loads(conn.getresponse().read().decode())
+            cold = stats.get("cold_start")
+        except (OSError, ValueError, http.client.HTTPException):
+            return None
+        finally:
+            conn.close()
+        if not isinstance(cold, dict):
+            return None
+        with self._slots_lock:
+            slot.cold_start = cold
+        return cold
 
     def shutdown(self) -> dict:
         self._stop.set()
         if self._monitor_thread is not None:
             self._monitor_thread.join(timeout=10)
         final = self.router.shutdown(drain=True)
-        for slot in self.slots:
+        slots = self._slots_snapshot()
+        for slot in slots:
             proc = slot.proc
             if proc is not None and proc.poll() is None:
                 # SIGCONT first: a chaos-SIGSTOPped replica cannot drain
@@ -425,7 +520,7 @@ class Fleet:
                     pass
                 proc.terminate()
         deadline = time.monotonic() + 30.0
-        for slot in self.slots:
+        for slot in slots:
             proc = slot.proc
             if proc is None:
                 continue
@@ -443,16 +538,217 @@ class Fleet:
     def status(self) -> dict:
         with self._ro_lock:
             ro = {"state": self._ro_state, "last": self._ro_result}
+        snap = self._slots_snapshot()
         return {
             "bundle": self.bundle,
             "replicas": [{
                 "name": s.name, "state": s.state, "address": s.address,
                 "restarts": s.restarts,
                 "pid": s.proc.pid if s.proc else None,
-            } for s in self.slots],
+                "cold_start": s.cold_start,
+            } for s in snap],
+            "scale": {"desired": self.desired,
+                      "actual": sum(1 for s in snap
+                                    if s.state == "up")},
             "rollout": ro,
             "events": self.events[-50:],
         }
+
+    # ------------------------------------------------------------- scaling
+
+    def scale_bounds(self) -> tuple[int, int]:
+        az = self.autoscale_cfg or {}
+        return (int(az.get("min_replicas", 1)),
+                int(az.get("max_replicas", 64)))
+
+    def _bundle_identity(self) -> dict:
+        """The incumbent bundle's identity facts (MANIFEST.json, read
+        jax-free) — what the autoscaler compares its capacity model
+        against before touching the fleet."""
+        out = {"bundle": self.bundle, "bundle_sha": None,
+               "bundle_version": None, "platform": None}
+        try:
+            with open(os.path.join(self.bundle, "MANIFEST.json")) as f:
+                man = json.load(f)
+        except (OSError, ValueError):
+            return out
+        out["bundle_version"] = man.get("version")
+        out["bundle_sha"] = (man.get("sha256") or {}).get("arrays.npz")
+        out["platform"] = (man.get("warm") or {}).get("platform")
+        return out
+
+    def scale_status(self) -> dict:
+        snap = self._slots_snapshot()
+        lo, hi = self.scale_bounds()
+        return {
+            "autoscale": bool(self.autoscale_cfg),
+            "desired": self.desired,
+            "actual": sum(1 for s in snap if s.state == "up"),
+            "slots": [{"name": s.name, "state": s.state} for s in snap],
+            "min": lo, "max": hi,
+            "in_progress": self._scale_lock.locked(),
+            "last": self._last_scale,
+            **self._bundle_identity(),
+        }
+
+    def _scale_cb(self, op: str, data: dict | None) -> dict:
+        """The router's /scale delegate: validate, then actuate on a
+        dedicated thread — the admin POST answers immediately (the
+        autoscaler's decision log records ACCEPTANCE; convergence is
+        observable via GET /scale and the store's gauges)."""
+        if op == "status":
+            return self.scale_status()
+        try:
+            n = int((data or {})["replicas"])
+        except (KeyError, TypeError, ValueError):
+            return {"ok": False,
+                    "error": "scale needs {'replicas': <int >= 1>}"}
+        if self._scale_lock.locked():
+            return {"ok": False, "error": "scale already in progress",
+                    "desired": self.desired}
+        lo, hi = self.scale_bounds()
+        clamped = min(max(n, lo), hi)
+        cur = len(self._slots_snapshot())
+        if clamped == cur and clamped == self.desired:
+            return {"ok": True, "noop": True, "desired": clamped,
+                    "from": cur}
+        reason = str((data or {}).get("reason") or "api")
+        t = threading.Thread(target=self.scale_to, args=(clamped,),
+                             kwargs={"reason": reason},
+                             name="fleet-scale", daemon=True)
+        t.start()
+        return {"ok": True, "accepted": True, "desired": clamped,
+                "from": cur, "clamped": clamped != n}
+
+    def scale_to(self, replicas: int, *, reason: str = "api") -> dict:
+        """Converge the fleet to ``replicas`` slots (clamped to the
+        autoscale bounds).  Synchronous: returns once added slots are up
+        (with their warmth proof) and removed slots are drained, dead
+        and forgotten."""
+        lo, hi = self.scale_bounds()
+        n = min(max(int(replicas), lo), hi)
+        t0 = time.monotonic()
+        with self._scale_lock:
+            with self._ro_lock:
+                ro_busy = self._ro_state != "idle"
+            if ro_busy:
+                # a rollout owns replica membership semantics (canary
+                # quarantine); scaling under it could retire the canary
+                return {"ok": False, "error": "rollout in progress"}
+            cur = len(self._slots_snapshot())
+            self.desired = n
+            self.router.desired_replicas = n
+            result: dict = {"ok": True, "desired": n, "from": cur,
+                            "requested": int(replicas), "reason": reason,
+                            "added": [], "retired": [],
+                            "ts": time.time()}
+            if n > cur:
+                new_slots = []
+                with self._slots_lock:
+                    for _ in range(n - cur):
+                        slot = _Slot(self._next_index, self.workdir)
+                        self._next_index += 1
+                        self.slots.append(slot)
+                        new_slots.append(slot)
+                for slot in new_slots:
+                    self._event("scale_up", replica=slot.name,
+                                reason=reason)
+                    self._spawn(slot)
+                # warm gate: every added slot must arrive with ZERO
+                # fresh XLA builds (the bundle ships a warm cache —
+                # scale-up capacity that compiles on arrival is late)
+                deadline = time.monotonic() + self.start_timeout_s
+                for slot in new_slots:
+                    while (slot.state != "up"
+                           and time.monotonic() < deadline):
+                        if self._stop.wait(0.1):
+                            break
+                    cold = (self._record_cold_start(slot)
+                            if slot.state == "up" else None)
+                    compiles = (cold or {}).get("compiles_at_load")
+                    result["added"].append({
+                        "replica": slot.name, "state": slot.state,
+                        "compiles_at_load": compiles})
+                    if compiles == 0:
+                        self._event("scale_up_warm", replica=slot.name)
+                    else:
+                        self.router.counters.inc(
+                            "fleet_cold_scale_ups_total")
+                        self._event("scale_up_cold", replica=slot.name,
+                                    compiles_at_load=compiles)
+            elif n < cur:
+                for _ in range(cur - n):
+                    res = self._retire_one(reason)
+                    result["retired"].append(res)
+                    if not res.get("ok"):
+                        result["ok"] = False
+                        break
+            result["duration_s"] = round(time.monotonic() - t0, 3)
+            self._last_scale = result
+            self._event("scale_done", desired=n,
+                        ok=result["ok"],
+                        added=[a["replica"] for a in result["added"]],
+                        retired=[r.get("replica")
+                                 for r in result["retired"]])
+            return result
+
+    def _retire_one(self, reason: str) -> dict:
+        """Drain-then-retire the least-loaded up replica: deselect in
+        the router FIRST (no new request can reach it), wait for
+        router-side in-flight to hit zero, SIGTERM (the replica's own
+        drain answers its internal queue and exits 0), reap, forget."""
+        import contextlib
+
+        up = [s for s in self._slots_snapshot() if s.state == "up"]
+        if len(up) <= 1:
+            return {"ok": False, "error": "nothing retirable "
+                                          "(<= 1 replica up)"}
+        reps = {r.name: r for r in self.router.replicas()}
+
+        def load_of(slot: _Slot) -> float:
+            rep = reps.get(slot.name)
+            if rep is None:
+                return 0.0
+            q = rep.health.get("queue_depth")
+            return (0.0 if q is None else float(q)) + rep.inflight
+
+        slot = min(up, key=load_of)
+        with self._slots_lock:
+            slot.state = "retiring"
+        self.router.retire_replica(slot.name)
+        self._event("replica_retiring", replica=slot.name, reason=reason)
+        rep = reps.get(slot.name)
+        drained = True
+        deadline = time.monotonic() + RETIRE_INFLIGHT_WAIT_S
+        while rep is not None and rep.inflight > 0:
+            if time.monotonic() > deadline or self._stop.wait(0.05):
+                drained = False
+                break
+        proc = slot.proc
+        exitcode = None
+        if proc is not None and proc.poll() is None:
+            with contextlib.suppress(OSError):
+                os.kill(proc.pid, signal.SIGCONT)  # a wedged corpse
+                # cannot run its SIGTERM drain handler
+            proc.terminate()
+            try:
+                proc.wait(timeout=RETIRE_REAP_S)
+            except subprocess.TimeoutExpired:
+                drained = False
+                proc.kill()
+                with contextlib.suppress(subprocess.TimeoutExpired):
+                    proc.wait(timeout=10)
+        if proc is not None:
+            exitcode = proc.returncode
+        self.router.remove_replica(slot.name)
+        with self._slots_lock:
+            if slot in self.slots:
+                self.slots.remove(slot)
+        drained = drained and exitcode == 0
+        self._event("replica_retired", replica=slot.name,
+                    exitcode=exitcode, drained=drained)
+        return {"ok": True, "replica": slot.name, "exitcode": exitcode,
+                "drained": drained}
 
     # ------------------------------------------------------------- rollout
 
@@ -499,7 +795,7 @@ class Fleet:
             conn.close()
 
     def _pick_canary(self) -> _Slot | None:
-        up = [s for s in self.slots if s.state == "up"]
+        up = [s for s in self._slots_snapshot() if s.state == "up"]
         if len(up) < 2:
             return None  # shadow comparison needs a live incumbent
         return up[0]
@@ -536,7 +832,8 @@ class Fleet:
                 result = {"ok": False, "aborted": True,
                           "reason": "insufficient_fleet",
                           "evidence": {"up": sum(
-                              1 for s in self.slots if s.state == "up")},
+                              1 for s in self._slots_snapshot()
+                              if s.state == "up")},
                           "ts": time.time()}
                 self.router.counters.inc("fleet_rollouts_aborted_total")
                 self._event("rollout_aborted",
@@ -621,7 +918,7 @@ class Fleet:
                 return
             # promote fleet-wide (the canary already serves the new one)
             failures = {}
-            for slot in self.slots:
+            for slot in self._slots_snapshot():
                 if slot is canary or slot.state != "up":
                     continue
                 err = self._reload_replica(slot, path)
@@ -630,7 +927,7 @@ class Fleet:
             if failures:
                 # partial fleets are worse than either bundle: roll
                 # everything (canary included) back to the incumbent
-                for slot in self.slots:
+                for slot in self._slots_snapshot():
                     if slot.state != "up":
                         continue
                     if self._reload_replica(slot, incumbent) is not None:
@@ -686,6 +983,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="router port (0 = ephemeral, see --port-file)")
     p.add_argument("--port-file", default=None, metavar="PATH",
                    help="atomically write the ROUTER's {host,port,pid}")
+    p.add_argument("--autoscale", action="store_true",
+                   help="embed the autoscaler loop (obs/agg/autoscale.py)"
+                        " in this supervisor; needs fleet.json's "
+                        "autoscale block with 'store' and 'capacity'")
     return p
 
 
@@ -707,7 +1008,37 @@ def main(argv: list[str] | None = None) -> int:
 
     signal.signal(signal.SIGTERM, _on_signal)
     signal.signal(signal.SIGINT, _on_signal)
+    scaler = None
+    if args.autoscale:
+        az = config.get("autoscale") or {}
+        if not az.get("store") or not az.get("capacity"):
+            print("fleet: --autoscale needs fleet.json's autoscale block "
+                  "with 'store' and 'capacity'", file=sys.stderr)
+            return 2
+        if __package__:
+            from ..obs.agg import autoscale as _autoscale
+        else:
+            _autoscale = _load("_estorch_obs_autoscale", os.pardir,
+                               "obs", "agg", "autoscale.py")
+        policy = {k: v for k, v in az.items()
+                  if k in _autoscale.POLICY_DEFAULTS}
+        try:
+            scaler = _autoscale.Autoscaler(
+                az["store"], capacity=az["capacity"],
+                actuate=lambda n, reason: fleet.scale_to(n,
+                                                         reason=reason),
+                fleet_identity=fleet._bundle_identity(),
+                target=az.get("target"),
+                interval_s=float(az.get("interval_s", 2.0)),
+                policy=policy)
+        except _autoscale.AutoscaleError as e:
+            # the capacity-model refusal (mismatched bundle/platform,
+            # unreadable artifact): never supervise with a wrong model
+            print(f"fleet: autoscale refused: {e}", file=sys.stderr)
+            return 2
     fleet.start()
+    if scaler is not None:
+        scaler.start_background()
     router = fleet.router
     print(json.dumps({
         "ready": True, "role": "fleet",
@@ -715,11 +1046,14 @@ def main(argv: list[str] | None = None) -> int:
         "pid": os.getpid(),
         "replicas": [s.name for s in fleet.slots],
         "bundle": fleet.bundle,
+        "autoscale": scaler is not None,
     }), flush=True)
     if args.port_file:
         write_port_file(args.port_file, router.host, router.port)
     while not stop.wait(0.5):
         pass
+    if scaler is not None:
+        scaler.stop()
     final = fleet.shutdown()
     print(json.dumps(final, default=float), flush=True)
     return 0 if final["clean"] else 1

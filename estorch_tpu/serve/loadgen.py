@@ -381,6 +381,54 @@ def capacity_sweep(
     }
 
 
+CAPACITY_SCHEMA = 1
+
+
+def write_capacity_artifact(sweep: dict, path: str, *,
+                            bundle: str | None = None,
+                            platform: str | None = None) -> dict:
+    """Persist a :func:`capacity_sweep` result as the VERSIONED capacity
+    model the autoscaler consumes (obs/agg/autoscale.py owns the
+    validator — the two keep ``schema`` in lockstep).
+
+    ``bundle`` stamps identity from the bundle's MANIFEST.json (arrays
+    sha256, version, warm platform — read jax-free): the autoscaler
+    refuses a model whose bundle/platform mismatches the fleet it is
+    about to scale, naming both sides.  ``platform`` overrides the
+    manifest's warm platform (a cold-exported bundle has none)."""
+    import os
+
+    art = {
+        "schema": CAPACITY_SCHEMA,
+        "kind": "capacity",
+        "created_ts": time.time(),
+        "slo_ms": sweep["slo_ms"],
+        "quantile": sweep["quantile"],
+        "max_rps_at_slo": sweep["max_rps_at_slo"],
+        "saturated": sweep["saturated"],
+        "rungs": sweep["rungs"],
+        "bundle_sha": None,
+        "bundle_version": None,
+        "platform": platform,
+    }
+    if bundle:
+        try:
+            with open(os.path.join(bundle, "MANIFEST.json")) as f:
+                man = json.load(f)
+        except (OSError, ValueError) as e:
+            raise ValueError(
+                f"{bundle}: unreadable bundle MANIFEST.json: {e}") from e
+        art["bundle_version"] = man.get("version")
+        art["bundle_sha"] = (man.get("sha256") or {}).get("arrays.npz")
+        if platform is None:
+            art["platform"] = (man.get("warm") or {}).get("platform")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(art, f, indent=1)
+    os.replace(tmp, path)
+    return art
+
+
 def write_latency_rows(latencies_s: list, path: str,
                        endpoint: str = "/predict") -> str:
     """Per-request latency rows as JSONL (``{"endpoint", "latency_s"}``)
@@ -500,6 +548,16 @@ def main(argv=None) -> int:
                         "from --start-rps)")
     p.add_argument("--start-rps", type=float, default=25.0)
     p.add_argument("--rung-duration", type=float, default=2.0)
+    p.add_argument("--out", default=None, metavar="PATH",
+                   help="persist the --capacity-sweep result as the "
+                        "versioned capacity.json artifact the "
+                        "autoscaler consumes")
+    p.add_argument("--bundle", default=None, metavar="DIR",
+                   help="stamp --out with this bundle's identity "
+                        "(MANIFEST.json sha256/version/warm platform)")
+    p.add_argument("--platform", default=None,
+                   help="platform stamp for --out (overrides the "
+                        "bundle manifest's warm platform)")
     p.add_argument("--latencies-out", default=None, metavar="PATH",
                    help="also write per-request latency rows as JSONL "
                         "({'endpoint', 'latency_s'}) — the obs regress "
@@ -520,6 +578,15 @@ def main(argv=None) -> int:
             start_rps=args.start_rps, rung_duration_s=args.rung_duration,
             conns=args.conns,
             obs=json.loads(args.obs) if args.obs else None)
+        if args.out:
+            try:
+                write_capacity_artifact(res, args.out,
+                                        bundle=args.bundle,
+                                        platform=args.platform)
+            except ValueError as e:
+                print(f"loadgen: {e}", file=sys.stderr)
+                return 2
+            res["artifact"] = args.out
         print(json.dumps(res))
         return 0
     if args.coldstart:

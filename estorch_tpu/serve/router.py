@@ -23,7 +23,10 @@ Routes:
   gauges and true ``route_s``/``upstream_s`` histograms;
 * ``POST /rollout {"path": bundle}`` / ``GET /rollout`` — canary
   rollout, delegated to the fleet supervisor when one is attached
-  (serve/fleet.py owns the state machine; a bare router answers 409).
+  (serve/fleet.py owns the state machine; a bare router answers 409);
+* ``POST /scale {"replicas": N}`` / ``GET /scale`` — the fleet's
+  autoscaler admin surface (obs/agg/autoscale.py actuates here),
+  delegated to the fleet like /rollout; a bare router answers 409.
 
 Per-replica circuit breakers (docs/serving.md "Fleet"): consecutive
 failures open the breaker (no traffic), a timed half-open probe admits
@@ -167,6 +170,9 @@ class Replica:
         self.inflight = 0
         self.requests = 0
         self.failures = 0
+        # set by retire_replica: out of selection immediately (the
+        # fleet notifies the router BEFORE killing a retiring replica)
+        self.retiring = False
 
     def snapshot(self) -> dict:
         h = dict(self.health)
@@ -175,6 +181,7 @@ class Replica:
             "address": self.address,
             "breaker": self.breaker.state,
             "breaker_opens": self.breaker.opens_total,
+            "retiring": self.retiring,
             "inflight": self.inflight,
             "requests": self.requests,
             "failures": self.failures,
@@ -237,6 +244,7 @@ class Router:
         hedge_quantile: float = 0.99,
         shadow_queue: int = 64,
         rollout_cb=None,
+        scale_cb=None,
         serve_http: bool = True,
     ):
         self.counters = Counters()
@@ -253,6 +261,11 @@ class Router:
         self.hedge_min_ms = float(hedge_min_ms)
         self.hedge_quantile = float(hedge_quantile)
         self._rollout_cb = rollout_cb
+        self._scale_cb = scale_cb
+        # desired fleet size, set by the supervisor on every scale
+        # decision — exported as a gauge so the dash can show
+        # desired-vs-actual from the store alone
+        self.desired_replicas: int | None = None
         self._replicas: dict[str, Replica] = {}
         self._replicas_lock = threading.Lock()
         for name, addr in replicas:
@@ -301,6 +314,28 @@ class Router:
                 return
             rep.address = _strip_scheme(address)
             rep.health = {"polled": False}
+            rep.retiring = False
+
+    def retire_replica(self, name: str) -> bool:
+        """Take ``name`` out of selection IMMEDIATELY (scale-down step
+        one): no new request reaches it, in-flight answers complete, the
+        health poll keeps watching it drain.  The fleet calls this
+        BEFORE sending SIGTERM — the ordering that makes a retirement
+        cost zero client errors."""
+        with self._replicas_lock:
+            rep = self._replicas.get(name)
+            if rep is None:
+                return False
+            rep.retiring = True
+        self.counters.inc("router_replicas_retired_total")
+        return True
+
+    def remove_replica(self, name: str) -> bool:
+        """Forget ``name`` entirely (the retired process is dead): its
+        breaker, histogram and health facts go with it — a future slot
+        reusing the name starts clean."""
+        with self._replicas_lock:
+            return self._replicas.pop(name, None) is not None
 
     def replicas(self) -> list[Replica]:
         with self._replicas_lock:
@@ -420,7 +455,7 @@ class Router:
         canary = c["name"] if c else None
         healthy, probes = [], []
         for rep in self.replicas():
-            if rep.name in exclude or rep.name == canary:
+            if rep.name in exclude or rep.name == canary or rep.retiring:
                 continue
             h = rep.health
             down = h.get("polled") and (not h.get("ok")
@@ -815,6 +850,11 @@ class Router:
             return {"supported": False}
         return {"supported": True, **self._rollout_cb("status", None)}
 
+    def scale_status(self) -> dict:
+        if self._scale_cb is None:
+            return {"supported": False}
+        return {"supported": True, **self._scale_cb("status", None)}
+
     def stats(self) -> dict:
         lat = {}
         h = self.hists.get("router/route_s")
@@ -832,6 +872,7 @@ class Router:
                                      "parity")}
                        if snap else None),
             "rollout": self.rollout_status(),
+            "scale": self.scale_status(),
             "collector_target": self._collector_target(),
         }
 
@@ -849,13 +890,16 @@ class Router:
         """Prometheus exposition: flat counters + route/upstream
         histograms through the shared encoder, then per-replica labeled
         gauges (the collector-idiom blocks the fleet dash reads)."""
+        extra = {
+            "uptime_seconds": round(
+                time.monotonic() - self._started_mono, 3),
+            "draining": 1.0 if self.draining else 0.0,
+        }
+        if self.desired_replicas is not None:
+            extra["router_desired_replicas"] = float(self.desired_replicas)
         body = render_exposition(
             self.counters.snapshot(), None, up=not self.draining,
-            extra_gauges={
-                "uptime_seconds": round(
-                    time.monotonic() - self._started_mono, 3),
-                "draining": 1.0 if self.draining else 0.0,
-            },
+            extra_gauges=extra,
             histograms=self.hists.export() or None)
         lines = [body.rstrip("\n")]
         gauges = (
@@ -949,6 +993,8 @@ def _make_handler(router: Router):
                             "text/plain; version=0.0.4; charset=utf-8")
             elif self.path == "/rollout":
                 self._reply_json(200, router.rollout_status())
+            elif self.path == "/scale":
+                self._reply_json(200, router.scale_status())
             else:
                 self._reply_json(404, {"error": f"no route {self.path!r}"})
 
@@ -965,6 +1011,8 @@ def _make_handler(router: Router):
                 return
             if self.path == "/rollout":
                 self._rollout(data)
+            elif self.path == "/scale":
+                self._scale(data)
             else:
                 self._reply_json(404, {"error": f"no route {self.path!r}"})
 
@@ -1000,6 +1048,20 @@ def _make_handler(router: Router):
             res = router._rollout_cb("start", data)
             self._reply_json(200 if res.get("ok") else 409, res)
 
+        def _scale(self, data: dict) -> None:
+            if router._scale_cb is None:
+                self._reply_json(409, {
+                    "error": "no fleet attached — scaling needs the fleet "
+                             "supervisor (serve/fleet.py)"})
+                return
+            n = data.get("replicas")
+            if not isinstance(n, int) or isinstance(n, bool) or n < 1:
+                self._reply_json(400, {
+                    "error": "scale needs {'replicas': <int >= 1>}"})
+                return
+            res = router._scale_cb("set", data)
+            self._reply_json(200 if res.get("ok") else 409, res)
+
     return RouterHandler
 
 
@@ -1033,6 +1095,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--poll-interval", type=float, default=0.25)
     p.add_argument("--breaker-failures", type=int, default=3)
     p.add_argument("--breaker-open-s", type=float, default=1.0)
+    p.add_argument("--autoscale", action="store_true",
+                   help="with --fleet: embed the autoscaler loop "
+                        "(obs/agg/autoscale.py) in the supervisor; "
+                        "needs fleet.json's autoscale block with "
+                        "'store' and 'capacity'")
     p.add_argument("--port-file", default=None, metavar="PATH",
                    help="atomically write {host,port,pid} JSON once bound")
     return p
@@ -1090,7 +1157,14 @@ def main(argv: list[str] | None = None) -> int:
             fleet_argv += ["--port-file", args.port_file]
         if args.workdir:
             fleet_argv += ["--workdir", args.workdir]
+        if args.autoscale:
+            fleet_argv += ["--autoscale"]
         return fleet_main(fleet_argv)
+    if args.autoscale:
+        # replicas managed elsewhere: nothing to spawn or retire
+        print("route: --autoscale needs --fleet (a supervisor that "
+              "owns the replica lifecycle)", file=sys.stderr)
+        return 2
     try:
         replicas = parse_replica_spec(args.replicas)
     except ValueError as e:

@@ -158,6 +158,28 @@ echo "== fleet selfcheck =="
 # capacity-sweep ladder (max RPS at a p99 SLO).  CPU only, ~60s.
 python bench.py --fleet --selfcheck
 
+echo "== autoscale policy selfcheck =="
+# autoscaler policy/log/refusal gate (obs/agg/autoscale.py,
+# docs/serving.md "Autoscaling") against a synthetic store: demand
+# scale-up, cooldown suppression, burn-rate step, sustained
+# low-watermark scale-down, bit-exact decision-log replay + tamper
+# detection, and the mismatched-capacity refusal naming both sides.
+# Run as a FILE (the wedged-host contract): stdlib only, no jax,
+# milliseconds.
+python estorch_tpu/obs/agg/autoscale.py --selfcheck
+
+echo "== autoscale selfcheck =="
+# closed-control-loop E2E gate (obs/agg/autoscale.py + serve/fleet.py,
+# docs/serving.md "Autoscaling"): a 2-replica fleet + in-process
+# collector + real capacity sweep + autoscaler actuating over HTTP
+# POST /scale — offered load TRIPLES mid-run and the replica count
+# must track it (up past the floor, back down after the trickle), p99
+# stays inside the SLO, zero client errors/shed including through a
+# declared kill_replica during the scale-up, every scale-up replica
+# loads warm (compiles_at_load == 0), the retirement drains cleanly,
+# and the decision log replays bit-exactly.  CPU only, ~90s.
+python bench.py --autoscale --selfcheck
+
 echo "== coldstart selfcheck =="
 # warm-bundle + quantized-serving gate (serve/warm.py, docs/serving.md
 # "Cold start & quantized serving"): a warm bundle must load with ZERO

@@ -1,0 +1,795 @@
+"""Autoscaler: the serving fleet closes its own control loop
+(obs/agg/autoscale.py + serve/fleet.py, docs/serving.md "Autoscaling").
+
+THE acceptance demo: an autoscaled 2-replica fleet under open-loop load
+that TRIPLES mid-run scales up (warm — ``compiles_at_load == 0``),
+keeps p99 inside the SLO with zero client errors/shed, survives a
+declared ``kill_replica`` chaos event during the scale-up, scales back
+down after the sustained low-watermark window with a DRAINED
+retirement, and the append-only decision log replays bit-exactly from
+its recorded inputs.
+
+Around the demo: the pure policy step (:func:`decide` — demand
+formula, per-direction cooldowns, burn-rate bypass/step, low-watermark
+hysteresis), the capacity-artifact contract (loadgen writes what the
+autoscaler validates; a bundle/platform mismatch is refused naming
+both sides), decision-log replay + tamper detection + restart
+adoption, the dash's desired-vs-actual columns, the fleet admin
+``POST /scale`` surface, and drain-then-retire semantics pinned under
+concurrent load against stdlib toy replicas.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from estorch_tpu.obs.agg import autoscale as azmod
+from estorch_tpu.obs.agg.autoscale import (AutoscaleError, Autoscaler,
+                                           POLICY_DEFAULTS, decide,
+                                           read_decisions, replay,
+                                           validate_capacity)
+from estorch_tpu.obs.agg.dash import fleet_snapshot, render
+from estorch_tpu.obs.agg.store import SeriesStore
+from estorch_tpu.resilience.chaos import CHAOS_ENV
+from estorch_tpu.serve.fleet import Fleet
+from estorch_tpu.serve.loadgen import (CAPACITY_SCHEMA, capacity_sweep,
+                                       run_load, write_capacity_artifact)
+from estorch_tpu.serve.router import Router
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _policy(**kw) -> dict:
+    p = {**POLICY_DEFAULTS, "max_rps_at_slo": 10.0, "min_replicas": 1,
+         "max_replicas": 8, "headroom": 1.3, "window_s": 10.0,
+         "up_cooldown_s": 5.0, "down_cooldown_s": 5.0,
+         "low_watermark": 0.5, "low_hold_s": 4.0}
+    p.update(kw)
+    return p
+
+
+def _inputs(ts=1000.0, offered=None, actual=2, burn=(), **kw) -> dict:
+    d = {"ts": ts, "target": "t", "window_s": 10.0,
+         "offered_rps": offered, "p99_ms": None, "queue_depth": 0.0,
+         "actual_replicas": actual, "replicas_known": actual,
+         "reported_desired": None, "alerts_active": list(burn),
+         "burn_firing": list(burn)}
+    d.update(kw)
+    return d
+
+
+def _fresh():
+    return dict(azmod.FRESH_STATE)
+
+
+# =====================================================================
+# the pure policy step
+# =====================================================================
+
+class TestDecide:
+    def test_demand_formula_scales_to_target(self):
+        # 30 rps, 10 rps/replica, headroom 1.3 -> ceil(3.9) = 4
+        v, s = decide(_inputs(offered=30.0, actual=2), _policy(),
+                      _fresh())
+        assert (v["action"], v["desired"], v["target"]) == ("up", 4, 4)
+        assert v["reason"] == "demand"
+        assert s["desired"] == 4 and s["last_up_ts"] == 1000.0
+
+    def test_clamped_to_max_and_min(self):
+        v, _ = decide(_inputs(offered=1000.0, actual=2),
+                      _policy(max_replicas=5), _fresh())
+        assert v["desired"] == 5
+        v, _ = decide(_inputs(offered=0.1, actual=4),
+                      _policy(min_replicas=3, low_hold_s=0.0),
+                      {**_fresh(), "low_since": 900.0,
+                       "desired": 4})
+        assert v["desired"] >= 3
+
+    def test_no_signal_holds(self):
+        # offered None = the counter never reported in the window: a
+        # controller with no signal must not move the fleet
+        v, s = decide(_inputs(offered=None, actual=3), _policy(),
+                      _fresh())
+        assert v["action"] == "hold" and v["desired"] == 3
+        assert v["utilization"] is None
+
+    def test_up_cooldown_suppresses_but_state_remembers(self):
+        st = {**_fresh(), "desired": 2, "last_up_ts": 998.0}
+        v, s = decide(_inputs(offered=30.0, actual=2), _policy(), st)
+        assert (v["action"], v["reason"]) == ("hold", "up_cooldown")
+        assert s["desired"] == 2  # no phantom progress
+
+    def test_burn_bypasses_up_cooldown_when_demand_agrees(self):
+        st = {**_fresh(), "desired": 2, "last_up_ts": 999.5}
+        v, _ = decide(_inputs(offered=30.0, actual=2,
+                              burn=["p99-burn"]), _policy(), st)
+        assert v["action"] == "up" and v["desired"] == 4
+        assert v["reason"] == "demand+burn:p99-burn"
+
+    def test_pure_burn_steps_one_per_cooldown_window(self):
+        # demand satisfied (target <= cur) but the SLO burns: +1
+        pol = _policy()
+        v, s = decide(_inputs(offered=30.0, actual=6,
+                              burn=["p99-burn"]),
+                      pol, {**_fresh(), "desired": 6})
+        assert (v["action"], v["desired"]) == ("up", 7)
+        assert v["reason"] == "burn:p99-burn"
+        # within the cooldown the next breach cannot add another
+        v2, _ = decide(_inputs(ts=1002.0, offered=30.0, actual=7,
+                               burn=["p99-burn"]), pol, s)
+        assert (v2["action"], v2["reason"]) == ("hold", "burn_cooldown")
+        # and at the ceiling it must hold, loudly
+        v3, _ = decide(_inputs(offered=30.0, actual=8,
+                               burn=["p99-burn"]),
+                       pol, {**_fresh(), "desired": 8})
+        assert (v3["action"], v3["reason"]) == ("hold", "burn_at_max")
+
+    def test_low_watermark_needs_a_sustained_window(self):
+        pol = _policy()
+        st = {**_fresh(), "desired": 4}
+        # tick 1: low utilization arms the timer, nothing moves
+        v, st = decide(_inputs(ts=1000.0, offered=2.0, actual=4), pol,
+                       st)
+        assert (v["action"], v["reason"]) == ("hold",
+                                              "low_watermark_arming")
+        # tick 2: still inside low_hold_s -> holding
+        v, st = decide(_inputs(ts=1002.0, offered=2.0, actual=4), pol,
+                       st)
+        assert (v["action"], v["reason"]) == ("hold",
+                                              "low_watermark_holding")
+        # tick 3: sustained past low_hold_s -> ONE step down
+        v, st = decide(_inputs(ts=1005.0, offered=2.0, actual=4), pol,
+                       st)
+        assert (v["action"], v["desired"]) == ("down", 3)
+        assert st["last_down_ts"] == 1005.0
+        # the step re-armed the window: an immediate repeat must hold
+        v, st = decide(_inputs(ts=1006.0, offered=2.0, actual=3), pol,
+                       st)
+        assert v["action"] == "hold"
+
+    def test_utilization_blip_resets_the_low_window(self):
+        pol = _policy()
+        st = {**_fresh(), "desired": 4}
+        _, st = decide(_inputs(ts=1000.0, offered=2.0, actual=4), pol,
+                       st)
+        assert st["low_since"] == 1000.0
+        # a burst above the watermark clears the armed timer
+        _, st = decide(_inputs(ts=1002.0, offered=25.0, actual=4), pol,
+                       st)
+        assert st["low_since"] is None
+        v, st = decide(_inputs(ts=1006.0, offered=2.0, actual=4), pol,
+                       st)
+        assert v["reason"] == "low_watermark_arming"  # from scratch
+
+    def test_hysteresis_dead_band_holds(self):
+        # target says 3 < cur 4, but utilization (0.55) sits ABOVE the
+        # low watermark: inside the dead band nothing moves — this gap
+        # is what keeps flapping from thrashing the fleet
+        v, s = decide(_inputs(offered=22.0, actual=4), _policy(),
+                      {**_fresh(), "desired": 4})
+        assert (v["action"], v["reason"]) == ("hold", "steady")
+        assert s["low_since"] is None
+
+    def test_down_cooldown_gates_consecutive_steps(self):
+        pol = _policy()
+        st = {**_fresh(), "desired": 4, "last_down_ts": 1001.0,
+              "low_since": 990.0}
+        v, _ = decide(_inputs(ts=1003.0, offered=2.0, actual=4), pol,
+                      st)
+        assert (v["action"], v["reason"]) == ("hold", "down_cooldown")
+
+    def test_decide_is_pure_and_json_stable(self):
+        inp, pol, st = _inputs(offered=30.0), _policy(), _fresh()
+        a = decide(inp, pol, st)
+        b = decide(json.loads(json.dumps(inp)),
+                   json.loads(json.dumps(pol)),
+                   json.loads(json.dumps(st)))
+        assert json.dumps(a, sort_keys=True) == \
+            json.dumps(b, sort_keys=True)
+
+
+# =====================================================================
+# capacity artifact: loadgen writes, autoscale validates
+# =====================================================================
+
+def _sweep(max_rps=40.0):
+    return {"slo_ms": 50.0, "quantile": "p99", "max_rps_at_slo": max_rps,
+            "saturated": False,
+            "rungs": [{"offered_rps": max_rps, "requests": 10,
+                       "ok": True}]}
+
+
+class TestCapacityArtifact:
+    def test_schema_constants_locked(self):
+        # the writer (serve/loadgen.py) and the validator
+        # (obs/agg/autoscale.py) must move their schema together
+        assert CAPACITY_SCHEMA == azmod.CAPACITY_SCHEMA
+
+    def test_writer_output_passes_the_validator(self, tmp_path):
+        path = str(tmp_path / "capacity.json")
+        art = write_capacity_artifact(_sweep(), path)
+        assert validate_capacity(art) == []
+        with open(path) as f:
+            on_disk = json.load(f)
+        assert validate_capacity(on_disk) == []
+        assert on_disk["max_rps_at_slo"] == 40.0
+        assert azmod.load_capacity(path)["kind"] == "capacity"
+
+    def test_bundle_identity_stamped_from_manifest(self, tmp_path):
+        bundle = tmp_path / "bundle"
+        bundle.mkdir()
+        (bundle / "MANIFEST.json").write_text(json.dumps({
+            "version": 3, "sha256": {"arrays.npz": "ab" * 32},
+            "warm": {"platform": "cpu"}}))
+        art = write_capacity_artifact(_sweep(),
+                                      str(tmp_path / "c.json"),
+                                      bundle=str(bundle))
+        assert art["bundle_sha"] == "ab" * 32
+        assert art["bundle_version"] == 3
+        assert art["platform"] == "cpu"
+
+    def test_unreadable_bundle_manifest_is_an_error(self, tmp_path):
+        with pytest.raises(ValueError, match="MANIFEST"):
+            write_capacity_artifact(_sweep(), str(tmp_path / "c.json"),
+                                    bundle=str(tmp_path / "nope"))
+
+    def test_saturated_model_is_refused(self, tmp_path):
+        path = str(tmp_path / "capacity.json")
+        write_capacity_artifact(_sweep(max_rps=None), path)
+        with pytest.raises(AutoscaleError, match="saturated"):
+            azmod.load_capacity(path)
+
+    def test_mismatch_refusal_names_both_sides(self, tmp_path):
+        store = str(tmp_path / "store")
+        SeriesStore(store).append(
+            [{"name": "estorch_up", "labels": {"target": "t"},
+              "value": 1.0}], ts=1000.0)
+        cap = {"schema": CAPACITY_SCHEMA, "kind": "capacity",
+               "created_ts": 0.0, "slo_ms": 50.0, "quantile": "p99",
+               "max_rps_at_slo": 40.0, "saturated": False,
+               "rungs": [{}], "bundle_sha": "ab" * 32,
+               "bundle_version": 1, "platform": "cpu"}
+        cap_path = tmp_path / "capacity.json"
+        cap_path.write_text(json.dumps(cap))
+        with pytest.raises(AutoscaleError) as ei:
+            Autoscaler(store, capacity=str(cap_path),
+                       fleet_identity={"bundle_sha": "cd" * 32,
+                                       "platform": "cpu",
+                                       "bundle": "/f"}, dry_run=True)
+        msg = str(ei.value)
+        assert ("ab" * 6)[:12] in msg and ("cd" * 6)[:12] in msg
+        # platform mismatch names both platforms
+        with pytest.raises(AutoscaleError) as ei:
+            Autoscaler(store, capacity=str(cap_path),
+                       fleet_identity={"bundle_sha": "ab" * 32,
+                                       "platform": "tpu"},
+                       dry_run=True)
+        assert "'cpu'" in str(ei.value) and "'tpu'" in str(ei.value)
+        # a matching identity constructs cleanly
+        Autoscaler(store, capacity=str(cap_path),
+                   fleet_identity={"bundle_sha": "ab" * 32,
+                                   "platform": "cpu"}, dry_run=True)
+
+
+# =====================================================================
+# decision log: replay, tamper, restart adoption
+# =====================================================================
+
+def _seed(store, ts, total, replicas, target="t"):
+    rows = [{"name": "estorch_router_requests_total",
+             "labels": {"target": target}, "value": float(total)}]
+    for i in range(replicas):
+        rows.append({"name": "estorch_router_replica_up",
+                     "labels": {"target": target, "replica": f"r{i}"},
+                     "value": 1.0})
+    store.append(rows, ts=ts)
+
+
+def _cap_file(tmp_path, max_rps=10.0):
+    path = tmp_path / "capacity.json"
+    path.write_text(json.dumps({
+        "schema": CAPACITY_SCHEMA, "kind": "capacity", "created_ts": 0.0,
+        "slo_ms": 50.0, "quantile": "p99",
+        "max_rps_at_slo": float(max_rps), "saturated": False,
+        "rungs": [{}]}))
+    return str(path)
+
+
+class TestDecisionLog:
+    def test_replay_is_bit_exact_and_detects_tampering(self, tmp_path):
+        store = SeriesStore(str(tmp_path / "store"))
+        t0 = 1000.0
+        _seed(store, t0, 0.0, 2)
+        _seed(store, t0 + 10, 300.0, 2)
+        acts = []
+        az = Autoscaler(str(tmp_path / "store"),
+                        capacity=_cap_file(tmp_path),
+                        actuate=lambda n, r: acts.append((n, r))
+                        or {"ok": True},
+                        policy={"window_s": 10.0, "min_replicas": 2})
+        ev = az.tick(now=t0 + 10)
+        assert ev["verdict"]["action"] == "up"
+        assert acts == [(4, "demand")]
+        rep = replay(az.log_path)
+        assert rep["ok"] and rep["decisions"] == 1
+        # flip one recorded verdict: replay must flag exactly it
+        rows = [json.loads(ln) for ln in open(az.log_path)]
+        rows[0]["verdict"]["desired"] = 99
+        bad = tmp_path / "tampered.jsonl"
+        bad.write_text("".join(json.dumps(r) + "\n" for r in rows))
+        rep = replay(str(bad))
+        assert not rep["ok"]
+        assert rep["mismatches"][0]["kind"] == "verdict"
+
+    def test_restart_adopts_logged_state(self, tmp_path):
+        store = SeriesStore(str(tmp_path / "store"))
+        t0 = 1000.0
+        _seed(store, t0, 0.0, 2)
+        _seed(store, t0 + 10, 300.0, 2)
+        az = Autoscaler(str(tmp_path / "store"),
+                        capacity=_cap_file(tmp_path),
+                        actuate=lambda n, r: {"ok": True},
+                        policy={"window_s": 10.0, "min_replicas": 2})
+        az.tick(now=t0 + 10)
+        state = dict(az.state)
+        assert state["last_up_ts"] == t0 + 10
+        # a fresh daemon over the same log resumes the SAME controller:
+        # cooldowns survive the restart, and the replayed state chain
+        # stays unbroken
+        az2 = Autoscaler(str(tmp_path / "store"),
+                         capacity=_cap_file(tmp_path),
+                         actuate=lambda n, r: {"ok": True},
+                         policy={"window_s": 10.0, "min_replicas": 2})
+        assert az2.state == state
+        _seed(store, t0 + 12, 700.0, 4)
+        ev = az2.tick(now=t0 + 12)
+        assert ev["verdict"]["reason"] == "up_cooldown"
+        rep = replay(az2.log_path)
+        assert rep["ok"] and rep["decisions"] == 2
+
+    def test_torn_tail_line_is_skipped(self, tmp_path):
+        log = tmp_path / "autoscale_decisions.jsonl"
+        ev = {"schema": 1, "ts": 1.0, "event": "decision", "target": "t",
+              "inputs": _inputs(), "policy": _policy(),
+              "state_before": _fresh(),
+              "verdict": decide(_inputs(), _policy(), _fresh())[0],
+              "state_after": decide(_inputs(), _policy(), _fresh())[1]}
+        log.write_text(json.dumps(ev) + "\n" + '{"schema": 1, "ev')
+        assert len(read_decisions(str(log))) == 1
+        assert replay(str(log))["ok"]
+
+
+# =====================================================================
+# dash columns from the store + decision log alone
+# =====================================================================
+
+class TestDashColumns:
+    def _store_with_router(self, root, desired=5.0, up=3):
+        s = SeriesStore(root)
+        rows = [{"name": "estorch_up", "labels": {"target": "fleet"},
+                 "value": 1.0},
+                {"name": "estorch_router_desired_replicas",
+                 "labels": {"target": "fleet"}, "value": desired}]
+        for i in range(up):
+            rows.append({"name": "estorch_router_replica_up",
+                         "labels": {"target": "fleet",
+                                    "replica": f"r{i}"}, "value": 1.0})
+        s.append(rows, ts=1000.0)
+        s.append([{"name": "estorch_up", "labels": {"target": "plain"},
+                   "value": 1.0}], ts=1000.0)
+        return s
+
+    def test_desired_vs_actual_and_decision_age(self, tmp_path):
+        root = str(tmp_path / "store")
+        self._store_with_router(root)
+        with open(os.path.join(root, azmod.DECISIONS_FILENAME),
+                  "a") as f:
+            f.write(json.dumps({
+                "schema": 1, "ts": 997.0, "event": "decision",
+                "target": "fleet", "verdict": {"action": "up",
+                                               "desired": 5}}) + "\n")
+        snap = fleet_snapshot(root, window_s=60.0, now=1001.0)
+        rows = {r["target"]: r for r in snap["targets"]}
+        assert rows["fleet"]["autoscale"] == {
+            "desired": 5, "actual": 3, "last_decision_ts": 997.0,
+            "decision_age_s": 4.0, "last_action": "up"}
+        # a target with no router gauges and no decisions: honest None
+        assert rows["plain"]["autoscale"] is None
+        out = render(root, window_s=60.0, now=1001.0)
+        assert "3→5" in out and "4s" in out
+        plain_line = next(ln for ln in out.splitlines()
+                          if ln.startswith("plain"))
+        assert "→" not in plain_line
+
+    def test_converged_fleet_shows_bare_count(self, tmp_path):
+        root = str(tmp_path / "store")
+        self._store_with_router(root, desired=3.0, up=3)
+        snap = fleet_snapshot(root, window_s=60.0, now=1001.0)
+        row = next(r for r in snap["targets"]
+                   if r["target"] == "fleet")
+        assert row["autoscale"]["desired"] == 3
+        assert row["autoscale"]["actual"] == 3
+        # no decision log at all: age honestly unknown
+        assert row["autoscale"]["decision_age_s"] is None
+        assert "→" not in render(root, window_s=60.0, now=1001.0)
+
+
+# =====================================================================
+# toy replicas: /scale surface + drain-then-retire under load
+# =====================================================================
+
+def make_toy_replica(*, delay_s: float = 0.0):
+    state = {"requests": 0}
+
+    class Toy(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def _j(self, code, obj):
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._j(200, {"ok": True, "draining": False,
+                              "queue_depth": 0})
+            else:
+                self._j(200, {"queue_depth": 0,
+                              "request_ms": {"p99": 1.0}})
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            data = json.loads(self.rfile.read(n))
+            state["requests"] += 1
+            if delay_s:
+                time.sleep(delay_s)
+            self._j(200, {"action": [v * 2.0 for v in data["obs"]]})
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Toy)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, state
+
+
+def _post(url, payload, timeout=15):
+    req = urllib.request.Request(url, json.dumps(payload).encode(),
+                                 {"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read()), dict(r.headers)
+
+
+class TestScaleSurface:
+    def _router(self, replicas, **kw):
+        kw.setdefault("port", 0)
+        kw.setdefault("poll_interval_s", 0.1)
+        r = Router(replicas, **kw)
+        r.start_background()
+        return r
+
+    def test_scale_without_a_fleet_is_409(self):
+        srv, _ = make_toy_replica()
+        router = self._router(
+            [("ra", f"127.0.0.1:{srv.server_address[1]}")])
+        try:
+            url = f"http://{router.host}:{router.port}"
+            with urllib.request.urlopen(url + "/scale",
+                                        timeout=10) as r:
+                assert json.loads(r.read()) == {"supported": False}
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(url + "/scale", {"replicas": 3})
+            assert ei.value.code == 409
+            assert "fleet" in json.loads(ei.value.read())["error"]
+        finally:
+            router.shutdown(drain=False)
+            srv.shutdown()
+
+    def test_scale_payload_validation(self):
+        srv, _ = make_toy_replica()
+        calls = []
+        router = self._router(
+            [("ra", f"127.0.0.1:{srv.server_address[1]}")],
+            scale_cb=lambda op, data: calls.append((op, data))
+            or {"ok": True, "accepted": True})
+        try:
+            url = f"http://{router.host}:{router.port}"
+            for bad in ({"replicas": "three"}, {"replicas": 0},
+                        {"replicas": True}, {}):
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    _post(url + "/scale", bad)
+                assert ei.value.code == 400, bad
+            assert calls == []  # junk never reached the fleet
+            out, _ = _post(url + "/scale", {"replicas": 3})
+            assert out["ok"] and calls[-1][0] == "set"
+        finally:
+            router.shutdown(drain=False)
+            srv.shutdown()
+
+    def test_retire_deselects_before_kill_under_load(self):
+        """Satellite: the router stops selecting a retiring replica
+        BEFORE the kill, everything in flight is answered, and the
+        concurrent load sees zero errors."""
+        srv_a, state_a = make_toy_replica(delay_s=0.02)
+        srv_b, state_b = make_toy_replica(delay_s=0.02)
+        router = self._router(
+            [("ra", f"127.0.0.1:{srv_a.server_address[1]}"),
+             ("rb", f"127.0.0.1:{srv_b.server_address[1]}")])
+        errors = []
+        stop = threading.Event()
+
+        def loader():
+            url = f"http://{router.host}:{router.port}/predict"
+            while not stop.is_set():
+                try:
+                    out, _ = _post(url, {"obs": [1.0]})
+                    if out.get("action") != [2.0]:
+                        errors.append(out)
+                except Exception as e:  # noqa: BLE001 — the assertion
+                    errors.append(repr(e))
+        threads = [threading.Thread(target=loader) for _ in range(6)]
+        try:
+            time.sleep(0.25)  # health poll marks both replicas up
+            for t in threads:
+                t.start()
+            time.sleep(0.5)
+            assert state_b["requests"] > 0  # rb carries load pre-retire
+            assert router.retire_replica("rb")
+            # wait for rb's in-flight to drain, then freeze its count
+            rep = {r.name: r for r in router.replicas()}["rb"]
+            deadline = time.monotonic() + 10
+            while rep.inflight > 0 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert rep.inflight == 0
+            drained_count = state_b["requests"]
+            time.sleep(0.6)  # load keeps hammering the router
+            # deselected: NOTHING new reached the retiring replica,
+            # while the survivor kept answering
+            assert state_b["requests"] == drained_count
+            before_a = state_a["requests"]
+            time.sleep(0.3)
+            assert state_a["requests"] > before_a
+            # only now would the fleet kill the process; forget it
+            assert router.remove_replica("rb")
+            assert "rb" not in {r.name for r in router.replicas()}
+            time.sleep(0.3)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=10)
+            router.shutdown(drain=False)
+            srv_a.shutdown()
+            srv_b.shutdown()
+        assert not errors, errors[:5]
+        snap = router.stats()
+        assert snap["counters"].get("router_replicas_retired_total") == 1
+
+
+# =====================================================================
+# file-run probe: the autoscaler is stdlib-only and jax-free
+# =====================================================================
+
+class TestFileRun:
+    def test_autoscale_file_run_never_imports_package_or_jax(self):
+        path = os.path.join(REPO, "estorch_tpu", "obs", "agg",
+                            "autoscale.py")
+        probe = (
+            "import importlib.util, sys\n"
+            f"spec = importlib.util.spec_from_file_location('a', "
+            f"{path!r})\n"
+            "m = importlib.util.module_from_spec(spec)\n"
+            "spec.loader.exec_module(m)\n"
+            "assert 'jax' not in sys.modules, 'autoscale imported jax'\n"
+            "assert 'estorch_tpu' not in sys.modules, 'package init "
+            "ran'\n"
+            "assert m.selfcheck() == 0\n"
+            "assert 'jax' not in sys.modules\n"
+        )
+        r = subprocess.run([sys.executable, "-c", probe],
+                           capture_output=True, text=True, timeout=120,
+                           cwd=REPO)
+        assert r.returncode == 0, r.stdout + r.stderr
+
+
+# =====================================================================
+# THE acceptance demo: the loop closes end to end
+# =====================================================================
+
+SMALL_PK = {"action_dim": 1, "hidden": (24, 24), "discrete": False,
+            "action_scale": 2.0}
+
+
+@pytest.fixture(scope="module")
+def warm_bundle(tmp_path_factory):
+    import jax
+    import optax
+
+    from estorch_tpu import ES, JaxAgent, MLPPolicy
+    from estorch_tpu.envs.pendulum import Pendulum
+
+    root = tmp_path_factory.mktemp("autoscale_bundle")
+    es = ES(MLPPolicy, JaxAgent(Pendulum(), horizon=10), optax.adam,
+            population_size=8, sigma=0.05, seed=0,
+            policy_kwargs=dict(SMALL_PK),
+            optimizer_kwargs={"learning_rate": 1e-2},
+            table_size=1 << 14, device=jax.devices()[0])
+    es.train(1, verbose=False)
+    return es.export_bundle(str(root / "bundle"), warm=True,
+                            warm_max_batch=4)
+
+
+class TestAutoscaleDemo:
+    def test_load_triples_fleet_tracks_and_log_replays(
+            self, warm_bundle, tmp_path, monkeypatch):
+        from estorch_tpu.obs.agg.collector import Collector, Target
+
+        slo_ms = 2000.0
+        fleet = Fleet(
+            {"schema": 1, "bundle": warm_bundle, "replicas": 2,
+             "serve": {"max_batch": 4, "cpu_devices": 8},
+             "router": {"retry_budget": 2, "breaker_open_s": 0.5},
+             "respawn": {"backoff_s": 0.2},
+             "autoscale": {"min_replicas": 2, "max_replicas": 4}},
+            str(tmp_path / "run"), port=0)
+        store_dir = str(tmp_path / "store")
+        col_stop = threading.Event()
+        col_thread = scaler = None
+        try:
+            fleet.start()
+            assert fleet.wait_ready(180), fleet.status()
+            # INITIAL spawns carry the warmth proof (satellite: the
+            # same bar the respawn path is held to)
+            for slot in fleet.slots:
+                assert (slot.cold_start or {}).get(
+                    "compiles_at_load") == 0, fleet.status()
+            addr = f"{fleet.router.host}:{fleet.router.port}"
+
+            # capacity model from a REAL sweep against one replica
+            sweep = capacity_sweep(fleet.slots[0].address,
+                                   slo_ms=slo_ms, rps_ladder=[40.0],
+                                   conns=8, rung_duration_s=1.0,
+                                   obs=[0.1, 0.2, 0.3])
+            assert sweep["max_rps_at_slo"] == 40.0, sweep
+            cap_path = str(tmp_path / "capacity.json")
+            write_capacity_artifact(sweep, cap_path,
+                                    bundle=warm_bundle)
+
+            # in-process collector: the autoscaler reads the STORE,
+            # never the fleet
+            col = Collector(
+                [Target("fleet", url=f"http://{addr}/metrics",
+                        timeout_s=5.0)],
+                SeriesStore(store_dir), None, serve_http=False)
+
+            def scrape():
+                while not col_stop.is_set():
+                    col.tick()
+                    col_stop.wait(0.3)
+            col_thread = threading.Thread(target=scrape, daemon=True)
+            col_thread.start()
+
+            scaler = Autoscaler(
+                store_dir, capacity=cap_path, fleet_admin=addr,
+                interval_s=0.4,
+                policy={"min_replicas": 2, "max_replicas": 4,
+                        "headroom": 1.2, "window_s": 5.0,
+                        "up_cooldown_s": 3.0, "down_cooldown_s": 4.0,
+                        "low_watermark": 0.5, "low_hold_s": 3.0})
+            scaler.start_background()
+
+            # chaos declared once the fleet serves: the kill lands in
+            # the spike phase, i.e. during/just after the scale-up
+            monkeypatch.setenv(CHAOS_ENV, json.dumps({
+                "events": [{"kind": "kill_replica", "at_s": 6.5,
+                            "replica": 1}],
+                "ledger": str(tmp_path / "chaos_ledger")}))
+            fleet.arm_chaos()
+
+            # baseline the floor absorbs -> load TRIPLES -> trickle
+            base = run_load(addr, mode="open", target_rps=25.0,
+                            duration_s=4.0, conns=8,
+                            obs=[0.1, 0.2, 0.3])
+            spike = run_load(addr, mode="open", target_rps=75.0,
+                             duration_s=9.0, conns=16,
+                             obs=[0.1, 0.2, 0.3])
+            scaled_up = False
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                sc = fleet.status()["scale"]
+                if sc["desired"] > 2 and sc["actual"] >= sc["desired"]:
+                    scaled_up = True
+                    break
+                time.sleep(0.2)
+            assert scaled_up, fleet.status()["scale"]
+            trickle = run_load(addr, mode="open", target_rps=4.0,
+                               duration_s=12.0, conns=4,
+                               obs=[0.1, 0.2, 0.3])
+            scaled_down = False
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                sc = fleet.status()["scale"]
+                if sc["desired"] == 2 and sc["actual"] == 2:
+                    scaled_down = True
+                    break
+                time.sleep(0.2)
+            assert scaled_down, fleet.status()["scale"]
+            scaler.stop()
+
+            # zero client errors/shed and p99 inside the SLO through
+            # every phase — including across the kill and the retire
+            for name, load in (("base", base), ("spike", spike),
+                               ("trickle", trickle)):
+                assert load["errors"] == 0, (name, load)
+                assert load["shed"] == 0, (name, load)
+                assert load["latency_ms"]["p99"] <= slo_ms, (name, load)
+            assert spike["requests"] >= 300
+
+            events = [e["event"] for e in fleet.events]
+            assert "chaos_kill_replica" in events  # the kill DID land
+            assert "scale_up_warm" in events
+            assert "scale_up_cold" not in events
+            retired = [e for e in fleet.events
+                       if e["event"] == "replica_retired"]
+            assert retired and retired[-1]["drained"], retired
+            assert retired[-1]["exitcode"] == 0
+
+            # the decision log replays bit-exactly from its inputs
+            rep = replay(scaler.log_path)
+            assert rep["ok"], rep["mismatches"][:3]
+            assert rep["decisions"] >= 10
+
+            # and the dash sees it all from the store + log alone
+            snap = fleet_snapshot(store_dir, window_s=60.0)
+            row = next(r for r in snap["targets"]
+                       if r["target"] == "fleet")
+            assert row["autoscale"] is not None
+            assert row["autoscale"]["desired"] == 2
+            assert row["autoscale"]["decision_age_s"] is not None
+        finally:
+            if scaler is not None:
+                scaler.stop()
+            col_stop.set()
+            if col_thread is not None:
+                col_thread.join(timeout=10)
+            fleet.shutdown()
+
+
+# =====================================================================
+# fleet config: the autoscale block validates
+# =====================================================================
+
+class TestAutoscaleConfig:
+    def test_autoscale_block_validates(self, tmp_path):
+        from estorch_tpu.serve.fleet import validate_fleet_config
+
+        base = {"schema": 1, "bundle": str(tmp_path), "replicas": 2}
+        assert validate_fleet_config(
+            {**base, "autoscale": {"min_replicas": 2,
+                                   "max_replicas": 4}}) == []
+        assert any("min_replicas" in p for p in validate_fleet_config(
+            {**base, "autoscale": {"min_replicas": 0}}))
+        assert any("max_replicas" in p for p in validate_fleet_config(
+            {**base, "autoscale": {"min_replicas": 3,
+                                   "max_replicas": 2}}))
+
+    def test_cli_autoscale_flag_requires_store_and_capacity(
+            self, tmp_path):
+        cfg = tmp_path / "fleet.json"
+        cfg.write_text(json.dumps(
+            {"schema": 1, "bundle": str(tmp_path), "replicas": 2}))
+        r = subprocess.run(
+            [sys.executable, "-m", "estorch_tpu.serve.fleet",
+             "--fleet", str(cfg), "--autoscale"],
+            capture_output=True, text=True, timeout=60,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert r.returncode == 2
+        assert "autoscale block" in r.stderr, r.stdout + r.stderr
