@@ -1,7 +1,7 @@
 """Host-side robustness rules: R05 untimed-subprocess-wait,
 R06 signature-probe-default, R11 blocking-wait-in-scheduler,
 R13 untimed-network-call, R15 unbounded-retry,
-R17 unfenced-cross-host-barrier.
+R17 unfenced-cross-host-barrier, R23 dropped-trace-context.
 
 R05 is the wedge class ``doctor.py`` exists to detect after the fact:
 a ``proc.wait()`` / ``proc.communicate()`` with no timeout turns a hung
@@ -58,6 +58,20 @@ syntactic: the network call must be visible inside the loop's try body
 its calls), and a handler that contains any ``raise`` is treated as
 escalating, not retrying — the single stale-keep-alive reconnect idiom
 (serve/client.py) raises on its second failure and stays clean.
+
+R23 is trace-context PROPAGATION as a static contract
+(docs/observability.md "Distributed tracing"): a handler that read the
+inbound ``X-Trace-Id`` header (``self.headers.get`` — the
+BaseHTTPRequestHandler receiver; a client reading a RESPONSE header is
+the opposite direction and out of scope) and then makes an outbound
+HTTP hop (``urlopen`` / ``conn.request``) in the same scope must put
+the header on that hop; otherwise every process behind this one mints
+fresh trace ids and the fleet-wide assembly (``obs trace --fleet``)
+ends here with no arrow out.  Forwarding sites: the header as a
+dict-literal key, an ``add_header``/``putheader``/``setdefault`` first
+argument, or a subscript-store key.  The front router's
+``_upstream_predict`` headers dict (serve/router.py) is the prescribed
+shape.
 """
 
 from __future__ import annotations
@@ -631,4 +645,105 @@ def check_signature_probe(ctx: ModuleContext):
                 "probe once at build time instead: call the zero-arg form "
                 "under `except TypeError` and record which form worked",
                 parent_symbol.get(node, "<module>")))
+    return out
+
+
+# ---------------------------------------------------------------------
+# R23 dropped-trace-context
+# ---------------------------------------------------------------------
+
+_TRACE_HEADER_LITERAL = "X-Trace-Id"
+# header-constant names from obs/tracing.py: a resolved name ending in
+# one of these IS the trace header, however the module imported it
+_TRACE_HEADER_NAMES = {"TRACE_HEADER"}
+
+
+def _is_trace_token(ctx: ModuleContext, node: ast.AST) -> bool:
+    """Is this expression the trace-id header key — the literal
+    "X-Trace-Id" or the TRACE_HEADER constant (any import spelling)?"""
+    if isinstance(node, ast.Constant):
+        return node.value == _TRACE_HEADER_LITERAL
+    resolved = ctx.resolve(node)
+    return bool(resolved) and \
+        resolved.rsplit(".", 1)[-1] in _TRACE_HEADER_NAMES
+
+
+def _reads_inbound_trace(ctx: ModuleContext, node: ast.AST) -> bool:
+    """``self.headers.get(<trace token>)`` / ``self.headers[<token>]`` —
+    the BaseHTTPRequestHandler read that makes this scope a RECEIVER of
+    trace context (a ``resp.headers.get`` on a client response is the
+    opposite direction and stays out of scope)."""
+    def _self_headers(base: ast.AST) -> bool:
+        return (isinstance(base, ast.Attribute) and base.attr == "headers"
+                and isinstance(base.value, ast.Name)
+                and base.value.id == "self")
+
+    if (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "get" and _self_headers(node.func.value)
+            and node.args and _is_trace_token(ctx, node.args[0])):
+        return True
+    return (isinstance(node, ast.Subscript) and _self_headers(node.value)
+            and _is_trace_token(ctx, node.slice))
+
+
+def _is_outbound_http(ctx: ModuleContext, call: ast.Call) -> bool:
+    """An outbound HTTP hop: ``urllib.request.urlopen`` or the
+    ``conn.request(method, path, ...)`` HTTPConnection idiom."""
+    if ctx.resolve(call.func) == "urllib.request.urlopen":
+        return True
+    return (isinstance(call.func, ast.Attribute)
+            and call.func.attr == "request" and len(call.args) >= 2)
+
+
+def _scope_forwards_trace(ctx: ModuleContext, nodes) -> bool:
+    """Any forwarding site in the scope: the trace header as a dict-
+    literal key, an ``add_header``/``putheader``/``setdefault`` first
+    argument, or a subscript-store key (``headers[TRACE_HEADER] = ...``)."""
+    for node in nodes:
+        if isinstance(node, ast.Dict):
+            if any(k is not None and _is_trace_token(ctx, k)
+                   for k in node.keys):
+                return True
+        elif (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("add_header", "putheader",
+                                       "setdefault")
+                and node.args and _is_trace_token(ctx, node.args[0])):
+            return True
+        elif isinstance(node, ast.Assign):
+            if any(isinstance(t, ast.Subscript)
+                   and _is_trace_token(ctx, t.slice)
+                   for t in node.targets):
+                return True
+    return False
+
+
+@rule("R23", "dropped-trace-context", "warning",
+      "handler received X-Trace-Id but its outbound HTTP hop does not "
+      "forward it — the assembled trace ends here")
+def check_dropped_trace_context(ctx: ModuleContext):
+    r = get_rule("R23")
+    out = []
+    for symbol, scope in iter_scopes(ctx):
+        nodes = scope_nodes(scope)
+        if not any(_reads_inbound_trace(ctx, n) for n in nodes):
+            continue
+        outbound = [n for n in nodes
+                    if isinstance(n, ast.Call)
+                    and _is_outbound_http(ctx, n)]
+        if not outbound or _scope_forwards_trace(ctx, nodes):
+            continue
+        for call in outbound:
+            out.append(make_finding(
+                ctx, r, call,
+                "this scope read the inbound `X-Trace-Id` header but "
+                "its outbound HTTP call never forwards it — every hop "
+                "behind this one becomes a separate, unjoinable trace",
+                "put the trace id on the outbound request (a "
+                '`{"X-Trace-Id": trace}` headers entry or '
+                "`add_header(TRACE_HEADER, trace)`) — and forward "
+                "`X-Parent-Span` beside it so the assembly keeps "
+                "parentage (docs/observability.md 'Distributed "
+                "tracing')",
+                symbol))
     return out

@@ -1119,6 +1119,137 @@ def check_router() -> dict:
         return {"ok": False, "error": repr(e)}
 
 
+def check_tracing() -> dict:
+    """Can this host assemble a CROSS-PROCESS distributed trace?
+    (obs/tracing.py + obs/agg/traces.py, docs/observability.md
+    "Distributed tracing")
+
+    Loopback end-to-end probe, jax-free: a real :class:`Router` with a
+    run dir routes one forced-sampled request (``X-Trace-Sampled: 1``)
+    to a toy stdlib replica that keeps its OWN :class:`ProcessTracer`
+    and records a ``request`` segment parented on the router's
+    forwarded ``X-Parent-Span``.  Both processes' tracers flush, then
+    assembly (``obs trace --fleet``'s engine) must join the trace
+    across both, with at least one cross-process parent→child hop, and
+    the Perfetto export must validate.  Never crashes the report: any
+    failure comes back as ``{"ok": False, ...}``."""
+    import json as _json
+    import os
+    import tempfile
+    import threading
+    import time as _time
+    import urllib.request
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    try:
+        from .obs.agg import traces as traces_agg
+        from .obs.export.traceevent import validate_trace
+        from .obs.tracing import (PARENT_SPAN_HEADER, SAMPLED_HEADER,
+                                  TRACE_HEADER, TRACES_FILENAME,
+                                  ProcessTracer, make_segment)
+        from .serve.router import Router
+
+        problems: list[str] = []
+        trace_id = "doctor-trace-1"
+        with tempfile.TemporaryDirectory() as td:
+            replica_dir = os.path.join(td, "replica")
+            os.makedirs(replica_dir)
+            tracer = ProcessTracer(
+                "replica", head_every=1,
+                path=os.path.join(replica_dir, TRACES_FILENAME))
+
+            class Toy(BaseHTTPRequestHandler):
+                protocol_version = "HTTP/1.1"
+
+                def log_message(self, *a):
+                    pass
+
+                def _j(self, obj):
+                    body = _json.dumps(obj).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+
+                def do_GET(self):
+                    if self.path == "/healthz":
+                        self._j({"ok": True, "draining": False,
+                                 "queue_depth": 0})
+                    else:
+                        self._j({"queue_depth": 0,
+                                 "request_ms": {"p99": 1.0}})
+
+                def do_POST(self):
+                    t0 = _time.monotonic()
+                    trace = self.headers.get(TRACE_HEADER) or ""
+                    parent = self.headers.get(PARENT_SPAN_HEADER) or None
+                    forced = self.headers.get(SAMPLED_HEADER) == "1"
+                    n = int(self.headers.get("Content-Length", 0))
+                    data = _json.loads(self.rfile.read(n))
+                    self._j({"action": [v * 2.0 for v in data["obs"]]})
+                    if trace:
+                        dt = _time.monotonic() - t0
+                        tracer.add(make_segment(
+                            trace, tracer.span_id(), parent, "replica",
+                            "request", t0, dt, {"status": 200}))
+                        tracer.finish(trace, dt, forced=forced)
+
+            srv = ThreadingHTTPServer(("127.0.0.1", 0), Toy)
+            threading.Thread(target=srv.serve_forever, daemon=True).start()
+            router_dir = os.path.join(td, "router")
+            router = Router(
+                [("ra", f"127.0.0.1:{srv.server_address[1]}")],
+                port=0, poll_interval_s=30.0, upstream_timeout_s=5.0,
+                run_dir=router_dir)
+            router.start_background()
+            try:
+                req = urllib.request.Request(
+                    f"http://{router.host}:{router.port}/predict",
+                    _json.dumps({"obs": [1.0]}).encode(),
+                    {"Content-Type": "application/json",
+                     TRACE_HEADER: trace_id, SAMPLED_HEADER: "1"})
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    got = _json.loads(r.read())
+                    echoed = r.headers.get(TRACE_HEADER)
+                if got.get("action") != [2.0]:
+                    problems.append(f"routed predict answered wrong: {got}")
+                if echoed != trace_id:
+                    problems.append(
+                        f"router did not echo {TRACE_HEADER}: {echoed!r}")
+            finally:
+                router.shutdown(drain=False)
+                srv.shutdown()
+                srv.server_close()
+            tracer.flush()
+
+            segs = traces_agg.load_segments(traces_agg.trace_files([td]))
+            asm = traces_agg.assemble(segs)
+            trace = asm.get(trace_id)
+            if trace is None:
+                problems.append(
+                    f"trace {trace_id!r} did not assemble "
+                    f"(got {sorted(asm)})")
+                return {"ok": False, "problems": problems}
+            if len(trace["procs"]) < 2:
+                problems.append(
+                    f"trace did not cross processes: {trace['procs']}")
+            hops = traces_agg.cross_process_edges(trace)
+            if not hops:
+                problems.append("no cross-process parent->child hop — "
+                                "X-Parent-Span not propagated")
+            export = traces_agg.export_fleet_trace([trace])
+            errs = validate_trace(export)
+            if errs:
+                problems.append(f"perfetto export invalid: {errs[:3]}")
+            return {"ok": not problems, "procs": trace["procs"],
+                    "segments": len(trace["segments"]),
+                    "cross_hops": len(hops),
+                    "sampled": trace.get("sampled"),
+                    **({"problems": problems} if problems else {})}
+    except Exception as e:  # diagnostic tool: never crash the report
+        return {"ok": False, "error": repr(e)}
+
+
 def check_collector() -> dict:
     """Can this host run the fleet-aggregation plane?  (obs/agg/,
     docs/observability.md "Fleet aggregation")
@@ -1338,6 +1469,7 @@ def report(timeout_s: float = 45.0, run_dir: str | None = None,
         "resilience": check_resilience(probe=resilience_probe),
         "serve": check_serve(bundle=serve_bundle),
         "router": check_router(),
+        "tracing": check_tracing(),
         "autoscaler": check_autoscaler(),
     }
     cpu_recipe = (

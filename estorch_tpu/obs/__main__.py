@@ -20,6 +20,22 @@ Subcommands (docs/observability.md):
       lanes keyed by manifest provenance.  ``manifest.json`` /
       ``heartbeat.json`` beside the JSONL are auto-discovered.
 
+  trace --fleet DIR... | --store DIR [-o fleet_trace.json] [--print]
+      Distributed-trace assembly (obs/agg/traces.py, docs/
+      observability.md "Distributed tracing"): join the fleet's sampled
+      per-hop segments (router ``route``/``upstream`` legs, replica
+      ``request`` + batcher children) by trace id into one Perfetto
+      timeline — per-process lanes, cross-process flow arrows, hedges
+      with the loser marked cancelled.  ``--store`` assembles from the
+      collector's scraped ``traces-<target>.jsonl`` instead of fleet
+      disks.  ``trace --fleet --selfcheck`` is the run_lint.sh gate.
+
+  slow --store DIR [--quantile Q] [--limit N]
+      Name the worst stored traces: the stored request histograms carry
+      per-bucket trace-id exemplars, so the traces at/above the chosen
+      quantile are listed with a per-hop breakdown assembled from the
+      store alone (obs/agg/traces.py owns the flags).
+
   profile <run.jsonl> [--platform auto|cpu|tpu] [--json]
       Per-phase performance attribution (docs/observability.md
       "Profiling"): time share, achieved FLOP/s and bytes/s against the
@@ -210,6 +226,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="autoscaler daemon: store + capacity model -> "
                         "fleet POST /scale (obs/agg/autoscale.py owns "
                         "the flags)")
+    sub.add_parser("slow", add_help=False,
+                   help="worst stored traces via histogram exemplars "
+                        "(obs/agg/traces.py owns the flags)")
     return p
 
 
@@ -522,6 +541,17 @@ def main(argv: list[str] | None = None) -> int:
         from .agg import autoscale as _autoscale
 
         return _autoscale.main(argv[1:])
+    if argv[:1] == ["slow"]:
+        from .agg import traces as _traces
+
+        return _traces.main_slow(argv[1:])
+    if argv[:1] == ["trace"] and any(
+            f in argv for f in ("--fleet", "--store", "--selfcheck")):
+        # the DISTRIBUTED form (obs/agg owns the flags); the positional
+        # run-JSONL export below keeps its surface untouched
+        from .agg import traces as _traces
+
+        return _traces.main(argv[1:])
     args = build_parser().parse_args(argv)
     if args.cmd == "summarize":
         return _cmd_summarize(args)

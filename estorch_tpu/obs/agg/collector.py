@@ -53,9 +53,11 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import sys
 import threading
 import time
+import urllib.parse
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -66,6 +68,7 @@ if __package__:
                                   read_published_counters)
     from ..hist import export_snapshots, snapshot_from_export
     from ..recorder import STALE_AFTER_S, read_heartbeat
+    from ..tracing import valid_segment
     from .rules import (LEDGER_FILENAME, RulesEngine, load_rules,
                         read_ledger)
     from .store import SeriesStore
@@ -86,6 +89,7 @@ else:  # file-run (wedged-jax host): load siblings without any package init
                      "sidecar.py")
     _hist = _load("_estorch_obs_hist", os.pardir, "hist.py")
     _recorder = _load("_estorch_obs_recorder", os.pardir, "recorder.py")
+    _tracing = _load("_estorch_obs_tracing", os.pardir, "tracing.py")
     _store = _load("_estorch_obs_agg_store", "store.py")
     _rules = _load("_estorch_obs_agg_rules", "rules.py")
     histogram_series = _prom.histogram_series
@@ -99,6 +103,7 @@ else:  # file-run (wedged-jax host): load siblings without any package init
     snapshot_from_export = _hist.snapshot_from_export
     STALE_AFTER_S = _recorder.STALE_AFTER_S
     read_heartbeat = _recorder.read_heartbeat
+    valid_segment = _tracing.valid_segment
     SeriesStore = _store.SeriesStore
     RulesEngine = _rules.RulesEngine
     load_rules = _rules.load_rules
@@ -108,6 +113,61 @@ else:  # file-run (wedged-jax host): load siblings without any package init
 TARGETS_SCHEMA = 1
 DEFAULT_INTERVAL_S = 2.0
 DEFAULT_TIMEOUT_S = 2.0
+# collector-side per-target trace segment files (store root):
+# traces-<target>.jsonl, joined by `obs trace --store` / `obs slow`
+TRACE_FILE_PREFIX = "traces-"
+TRACE_FILE_MAX_LINES = 20_000
+
+
+def trace_file_path(store_root: str, target: str) -> str:
+    safe = re.sub(r"[^A-Za-z0-9._-]", "_", target)
+    return os.path.join(store_root, f"{TRACE_FILE_PREFIX}{safe}.jsonl")
+
+
+def append_segments(path: str, segments: list[dict],
+                    max_lines: int = TRACE_FILE_MAX_LINES) -> int:
+    """Append valid segments to a per-target trace file, atomically
+    (tmp + replace), capped to the newest ``max_lines`` — a reader mid-
+    scrape sees the old file or the new one, never a torn middle.
+    Returns how many segments were kept."""
+    rows = [json.dumps(s, sort_keys=True) for s in segments
+            if valid_segment(s)]
+    if not rows:
+        return 0
+    try:
+        with open(path) as f:
+            old = f.read().splitlines()
+    except OSError:
+        old = []
+    keep = (old + rows)[-max_lines:]
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write("\n".join(keep) + "\n")
+    os.replace(tmp, path)
+    return len(rows)
+
+
+def traces_url(metrics_url: str) -> str:
+    """The fleet convention: a target exposing ``/metrics`` exposes its
+    sampled trace segments at ``/traces`` on the same listener."""
+    parts = urllib.parse.urlsplit(metrics_url)
+    return urllib.parse.urlunsplit(
+        (parts.scheme, parts.netloc, "/traces", "", ""))
+
+
+def scrape_traces(metrics_url: str, since: int,
+                  timeout_s: float = DEFAULT_TIMEOUT_S) -> dict:
+    """One ``/traces?since=<cursor>`` scrape → the payload dict
+    (``obs/tracing.py`` :func:`traces_payload` shape).  Raises on any
+    failure — the CALLER decides that traces are best-effort."""
+    url = f"{traces_url(metrics_url)}?since={int(since)}"
+    with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+        payload = json.loads(resp.read().decode(errors="replace"))
+    if not isinstance(payload, dict) \
+            or not isinstance(payload.get("segments"), list):
+        raise ValueError("malformed /traces payload")
+    return payload
 
 
 class Target:
@@ -242,7 +302,7 @@ def scrape_run_dir(run_dir: str, target: str,
 
 class _TargetState:
     __slots__ = ("consecutive_failures", "last_error", "last_scrape_s",
-                 "last_ok_ts", "inflight")
+                 "last_ok_ts", "inflight", "trace_cursor")
 
     def __init__(self):
         self.consecutive_failures = 0
@@ -250,6 +310,9 @@ class _TargetState:
         self.last_scrape_s: float | None = None
         self.last_ok_ts: float | None = None
         self.inflight = False
+        # /traces?since= high-water mark; reset to 0 when the target's
+        # cursor goes BACKWARD (process restart — seq starts over)
+        self.trace_cursor = 0
 
 
 class Collector:
@@ -259,14 +322,17 @@ class Collector:
                  rules: RulesEngine | None = None, *,
                  interval_s: float = DEFAULT_INTERVAL_S,
                  host: str = "127.0.0.1", port: int = 0,
-                 serve_http: bool = True):
+                 serve_http: bool = True, scrape_traces: bool = True):
         self.targets = list(targets)
         self.store = store
         self.rules = rules
         self.interval_s = float(interval_s)
+        self.scrape_traces = bool(scrape_traces)
         self.counters: dict[str, float] = {
             "agg_ticks_total": 0, "agg_samples_stored_total": 0,
             "agg_scrape_errors_total": 0, "agg_alert_transitions_total": 0,
+            "agg_trace_segments_total": 0,
+            "agg_trace_scrape_errors_total": 0,
         }
         self._states = {t.name: _TargetState() for t in self.targets}
         self._lock = threading.Lock()
@@ -283,6 +349,40 @@ class Collector:
             return scrape_prometheus(t.url, t.name, timeout_s=t.timeout_s)
         return scrape_run_dir(t.run_dir, t.name,
                               stale_after_s=t.stale_after_s)
+
+    def _land_traces(self, t: Target, state: _TargetState,
+                     r: dict) -> int:
+        """Land one successful scrape's /traces payload: segments append
+        to the store root's ``traces-<target>.jsonl`` (what ``obs trace
+        --store`` / ``obs slow`` assemble), bucket exemplars are grafted
+        onto this tick's stored histogram snapshots (Prometheus text
+        cannot carry them), and the cursor advances — backward movement
+        means the target restarted, so restart from 0.  Returns how many
+        segments landed."""
+        if r.get("trace_error"):
+            self.counters["agg_trace_scrape_errors_total"] += 1
+            return 0
+        payload = r.get("traces")
+        if not payload:
+            return 0
+        kept = 0
+        segs = payload.get("segments") or []
+        if segs:
+            kept = append_segments(
+                trace_file_path(self.store.root, t.name), segs)
+            self.counters["agg_trace_segments_total"] += kept
+        cursor = int(payload.get("cursor") or 0)
+        state.trace_cursor = cursor if cursor >= state.trace_cursor else 0
+        exemplars = payload.get("exemplars") or {}
+        if isinstance(exemplars, dict):
+            by_metric = {metric_name(name): ex
+                         for name, ex in exemplars.items()
+                         if isinstance(ex, dict)}
+            for sample in r["samples"]:
+                ex = by_metric.get(sample["name"])
+                if ex is not None and isinstance(sample.get("hist"), dict):
+                    sample["hist"]["exemplars"] = ex
+        return kept
 
     def tick(self, now: float | None = None) -> dict:
         """One collection round: scrape every target (bounded, parallel),
@@ -302,9 +402,21 @@ class Collector:
                 # (refused, timeout, garbage, missing files) is the same
                 # verdict: this target did not produce a scrape
                 samples, err = None, f"{type(e).__name__}: {e}"
+            traces, terr = None, None
+            if (err is None and self.scrape_traces
+                    and t.kind == "prometheus"):
+                # best-effort second fetch on the same listener: trace
+                # segments + histogram exemplars ride /traces?since= —
+                # a missing endpoint degrades tracing, never the scrape
+                try:
+                    traces = scrape_traces(t.url, state.trace_cursor,
+                                           timeout_s=t.timeout_s)
+                except Exception as e:  # noqa: BLE001 — same envelope
+                    terr = f"{type(e).__name__}: {e}"
             dt = time.perf_counter() - t0
             with res_lock:
                 results[t.name] = {"samples": samples, "error": err,
+                                   "traces": traces, "trace_error": terr,
                                    "elapsed_s": dt}
             # handshake with the next tick's skip-if-stuck check — the
             # collector lock orders this against tick's read+set
@@ -355,9 +467,11 @@ class Collector:
                 state.last_error = None
                 state.last_ok_ts = now
                 state.last_scrape_s = r["elapsed_s"]
+                segs = self._land_traces(t, state, r)
                 batch.extend(r["samples"])
                 results[t.name] = {"ok": True,
                                    "samples": len(r["samples"]),
+                                   "segments": segs,
                                    "elapsed_s": round(r["elapsed_s"], 4)}
         with self._lock:
             self.store.append(batch, ts=now)

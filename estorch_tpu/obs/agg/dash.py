@@ -17,7 +17,11 @@ training runs), ``estorch_queue_depth``, ``estorch_recompiles``
 (windowed increase, reset-aware), plus the active alerts from the
 ledger.  Missing metrics render as ``-`` — a training run has no
 request latencies and a serve replica has no generations, and the dash
-must say so rather than fabricate.
+must say so rather than fabricate.  The ``slowest`` column names the
+worst in-window trace id from the latency histogram's bucket exemplars
+(docs/observability.md "Distributed tracing") — paste it into ``obs
+slow --store`` for the per-hop breakdown; targets exporting no
+exemplars render ``-``.
 
 Autoscaled router targets (obs/agg/autoscale.py) get two more columns,
 derived from the store + the append-only decision log alone: ``scale``
@@ -74,6 +78,17 @@ ROUTE_HIST = "estorch_router_route_s"
 
 def _fmt_ms(v: float | None) -> str:
     return f"{v * 1e3:.1f}" if v is not None else "-"
+
+
+def _slowest_trace(store, metric: str, labels: dict, window_s: float,
+                   now: float) -> str | None:
+    """Worst exemplar trace id at/above the stored p99 bucket, or None
+    when the window's histogram carries no exemplars."""
+    h = store.hist_window(metric, labels, window_s, now)
+    if h is None or h.count == 0:
+        return None
+    ids = h.slow_exemplars(q=0.99)
+    return ids[0] if ids else None
 
 
 def _fmt_num(v: float | None) -> str:
@@ -200,6 +215,13 @@ def fleet_snapshot(store_root: str, *, window_s: float = 60.0,
                 window_s, now),
             "dispatch_p99_s": store.quantile(DISPATCH_HIST, 0.99, labels,
                                              window_s, now),
+            # the worst in-window trace id from the latency histogram's
+            # bucket exemplars (obs/hist.py) — `obs slow --store` turns
+            # it into a per-hop breakdown; None when the target exports
+            # no exemplars (old process, tracing off)
+            "slowest_trace": _slowest_trace(
+                store, ROUTE_HIST if router else REQUEST_HIST, labels,
+                window_s, now),
             "queue_depth": latest("estorch_queue_depth"),
             "recompiles": store.increase("estorch_recompiles", labels,
                                          window_s, now),
@@ -232,7 +254,7 @@ def render(store_root: str, *, window_s: float = 60.0,
     header = ("target", "up", "gen", "cold", "req p50/p99 ms",
               "disp p99 ms", "hosts", "host p99 ms", "queue", "recomp",
               "brk", "retry", "hedge", "repl p99", "scale", "scale age",
-              "alerts")
+              "slowest", "alerts")
     table = [header]
     for row in snap["targets"]:
         # cold: startup seconds, suffixed ! when the replica paid fresh
@@ -293,6 +315,10 @@ def render(store_root: str, *, window_s: float = 60.0,
             _fmt_num(row["queue_depth"]),
             _fmt_num(row["recompiles"]),
             brk, retry, hedge, repl_p99, scale, scale_age,
+            # worst in-window trace id — feed it to `obs slow --store`
+            # / `obs trace --store --trace-id` for the per-hop story;
+            # '-' for targets exporting no exemplars
+            row.get("slowest_trace") or "-",
             ",".join(row["alerts"]) or "-",
         ))
     widths = [max(len(str(r[i])) for r in table)

@@ -92,6 +92,11 @@ def _subtract_snapshots(last: dict, anchor: dict | None) -> dict:
     h_last._count = sum(h_last._counts)
     h_last._sum = max(0.0, h_last.sum - h_anchor.sum)
     h_last._exact = None
+    # exemplars survive only in buckets the window actually touched — a
+    # bucket whose in-window delta is zero must not keep naming a trace
+    # id from before the window
+    h_last._exemplars = {i: ids for i, ids in h_last._exemplars.items()
+                         if h_last._counts[i] > 0}
     return h_last.to_dict()
 
 
@@ -368,11 +373,18 @@ class SeriesStore:
         prev: dict[tuple, dict] = {}
         contributions: list[dict] = []
 
-        def finalize(key: tuple) -> None:
+        def finalize(key: tuple, buried: bool = False) -> None:
             last = last_in.pop(key, None)
             if last is not None:
-                contributions.append(
-                    _subtract_snapshots(last, anchor.get(key)))
+                contrib = _subtract_snapshots(last, anchor.get(key))
+                if buried and "exemplars" in contrib:
+                    # a restart invalidated the source process's trace
+                    # rings — its exemplar ids name traces nobody can
+                    # assemble anymore, and they must NOT resurrect
+                    # into the merged window
+                    contrib = {k: v for k, v in contrib.items()
+                               if k != "exemplars"}
+                contributions.append(contrib)
             anchor.pop(key, None)
 
         for key, ts, snap in self.hist_series(name, labels,
@@ -382,7 +394,7 @@ class SeriesStore:
             p = prev.get(key)
             if p is not None and int(snap.get("count", 0)) < int(
                     p.get("count", 0)):
-                finalize(key)  # restart: close the buried incarnation
+                finalize(key, buried=True)  # restart: close buried incarnation
             prev[key] = snap
             if ts <= start:
                 anchor[key] = snap

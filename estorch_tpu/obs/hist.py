@@ -50,6 +50,10 @@ DEFAULT_LO = 1e-5
 DEFAULT_DECADES = 8
 DEFAULT_PER_DECADE = 12
 DEFAULT_EXACT_CAP = 256
+# per-bucket exemplar capacity: the last K trace ids observed into each
+# bucket (docs/observability.md "Distributed tracing") — enough to name
+# a tail sample, small enough to ride every snapshot
+DEFAULT_EXEMPLAR_K = 4
 
 
 class Histogram:
@@ -76,6 +80,9 @@ class Histogram:
         self._count = 0
         self._sum = 0.0
         self._exact: list[float] | None = []
+        # bucket index → last K exemplar trace ids (newest last); only
+        # buckets that ever saw an exemplar have a key
+        self._exemplars: dict[int, list[str]] = {}
 
     # ------------------------------------------------------------ ladder
 
@@ -99,9 +106,13 @@ class Histogram:
 
     # ----------------------------------------------------------- observe
 
-    def observe(self, value: float, n: int = 1) -> None:
+    def observe(self, value: float, n: int = 1,
+                exemplar: str | None = None) -> None:
         """Record ``n`` observations of ``value`` (the weighted form
-        serves per-batch costs shared by every coalesced request)."""
+        serves per-batch costs shared by every coalesced request).
+        ``exemplar`` attaches a trace id to the value's bucket — the
+        last :data:`DEFAULT_EXEMPLAR_K` per bucket survive, so a tail
+        bucket can NAME recent requests that landed in it."""
         v = float(value)
         if not math.isfinite(v) or n < 1:
             return
@@ -115,6 +126,10 @@ class Histogram:
                     self._exact.extend([v] * n)
                 else:
                     self._exact = None  # past the cap: ladder-only
+            if exemplar:
+                ids = self._exemplars.setdefault(i, [])
+                ids.append(str(exemplar))
+                del ids[:-DEFAULT_EXEMPLAR_K]
 
     @property
     def count(self) -> int:
@@ -157,6 +172,41 @@ class Histogram:
                 return self.bound(self.n)
             return math.sqrt(self.bound(i - 1) * self.bound(i))
 
+    # --------------------------------------------------------- exemplars
+
+    def exemplars(self) -> dict[int, list[str]]:
+        """Copy of the per-bucket exemplar ids (bucket index → newest
+        last)."""
+        with self._lock:
+            return {i: list(ids) for i, ids in self._exemplars.items()
+                    if ids}
+
+    def slow_exemplars(self, q: float = 0.99) -> list[str]:
+        """Exemplar trace ids from buckets AT OR ABOVE the bucket holding
+        quantile ``q`` — slowest bucket first, newest first within a
+        bucket, deduplicated.  How a p99 breach gets a NAME."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"q must be in [0, 1], got {q}")
+        with self._lock:
+            if self._count == 0 or not self._exemplars:
+                return []
+            k = max(1, math.ceil(q * self._count))
+            cum = 0
+            qi = len(self._counts) - 1
+            for i, c in enumerate(self._counts):
+                cum += c
+                if cum >= k:
+                    qi = i
+                    break
+            out: list[str] = []
+            for i in sorted(self._exemplars, reverse=True):
+                if i < qi:
+                    break
+                for tid in reversed(self._exemplars[i]):
+                    if tid not in out:
+                        out.append(tid)
+            return out
+
     # ------------------------------------------------------------- merge
 
     def _same_ladder(self, other: "Histogram") -> bool:
@@ -176,6 +226,7 @@ class Histogram:
             o_counts = list(other._counts)
             o_count, o_sum = other._count, other._sum
             o_exact = None if other._exact is None else list(other._exact)
+            o_ex = {i: list(ids) for i, ids in other._exemplars.items()}
         with self._lock:
             for i, c in enumerate(o_counts):
                 self._counts[i] += c
@@ -186,6 +237,10 @@ class Histogram:
                 self._exact.extend(o_exact)
             else:
                 self._exact = None
+            for i, ids in o_ex.items():
+                mine = self._exemplars.setdefault(i, [])
+                mine.extend(ids)
+                del mine[:-DEFAULT_EXEMPLAR_K]
         return self
 
     # --------------------------------------------------------- serialize
@@ -210,6 +265,12 @@ class Histogram:
                            if c},
                 **({"exact": list(self._exact)}
                    if self._exact is not None and not compact else {}),
+                # exemplars ride BOTH shapes: ≤ K short ids per touched
+                # bucket is heartbeat-cheap, and the /traces scrape path
+                # only ever sees compact snapshots
+                **({"exemplars": {str(i): list(ids) for i, ids in
+                                  self._exemplars.items() if ids}}
+                   if self._exemplars else {}),
             }
 
     @classmethod
@@ -234,6 +295,16 @@ class Histogram:
         exact = data.get("exact")
         h._exact = ([float(x) for x in exact]
                     if isinstance(exact, list) else None)
+        ex = data.get("exemplars")
+        if isinstance(ex, dict):
+            for key, ids in ex.items():
+                try:
+                    i = int(key)
+                except (TypeError, ValueError):
+                    continue
+                if 0 <= i < len(h._counts) and isinstance(ids, list):
+                    h._exemplars[i] = [str(x) for x in
+                                       ids[-DEFAULT_EXEMPLAR_K:]]
         return h
 
     def to_export(self) -> dict:
@@ -266,12 +337,12 @@ class Histograms:
         self._hists: dict[str, Histogram] = {}
 
     def observe(self, name: str, value: float, n: int = 1,
-                **ladder) -> None:
+                exemplar: str | None = None, **ladder) -> None:
         h = self._hists.get(name)
         if h is None:
             with self._lock:
                 h = self._hists.setdefault(name, Histogram(**ladder))
-        h.observe(value, n)
+        h.observe(value, n, exemplar=exemplar)
 
     def get(self, name: str) -> Histogram | None:
         return self._hists.get(name)
@@ -309,7 +380,7 @@ class NullHistograms(Histograms):
     swallows)."""
 
     def observe(self, name: str, value: float, n: int = 1,
-                **ladder) -> None:
+                exemplar: str | None = None, **ladder) -> None:
         pass
 
 

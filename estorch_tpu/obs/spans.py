@@ -158,10 +158,11 @@ class Telemetry:
             self._tls.trace = prev
 
     def observe(self, name: str, value: float, n: int = 1,
-                **ladder) -> None:
+                exemplar: str | None = None, **ladder) -> None:
         """Record ``n`` observations into the named streaming histogram
-        (obs/hist.py; ladder kwargs apply on first observe only)."""
-        self.hists.observe(name, value, n, **ladder)
+        (obs/hist.py; ladder kwargs apply on first observe only;
+        ``exemplar`` attaches a trace id to the value's bucket)."""
+        self.hists.observe(name, value, n, exemplar=exemplar, **ladder)
 
     def take_phases(self) -> dict[str, float]:
         """Flush this generation's span accumulator (merged into the

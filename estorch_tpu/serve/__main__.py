@@ -59,6 +59,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "(docs/serving.md)")
     p.add_argument("--port-file", default=None, metavar="PATH",
                    help="atomically write {host,port,pid} JSON once bound")
+    p.add_argument("--run-dir", default=None, metavar="DIR",
+                   help="per-process observability dir: sampled trace "
+                        "segments flush to <DIR>/traces.jsonl "
+                        "(docs/observability.md 'Distributed tracing')")
     p.add_argument("--beat-interval", type=float, default=2.0,
                    help="idle heartbeat period (ESTORCH_OBS_HEARTBEAT)")
     p.add_argument("--supervised", action="store_true",

@@ -47,6 +47,7 @@ from typing import Callable, Sequence
 import numpy as np
 
 from ..obs.spans import NULL_TELEMETRY
+from ..obs.tracing import make_segment
 
 
 class BatcherClosed(RuntimeError):
@@ -73,15 +74,19 @@ class _Pending:
     ``serve/coalesce_wait_s``, ``serve/request_s``) and the flight
     recorder can tell WHICH request a tail sample belongs to."""
 
-    __slots__ = ("obs", "event", "result", "error", "trace", "t_submit",
-                 "t_taken")
+    __slots__ = ("obs", "event", "result", "error", "trace", "span",
+                 "t_submit", "t_taken")
 
-    def __init__(self, obs: np.ndarray, trace: str | None = None):
+    def __init__(self, obs: np.ndarray, trace: str | None = None,
+                 span: str | None = None):
         self.obs = obs
         self.event = threading.Event()
         self.result = None
         self.error: BaseException | None = None
         self.trace = trace
+        # the server's `request` span id: the parent the batcher's
+        # queue_wait/coalesce/compute child segments hang under
+        self.span = span
         self.t_submit = time.perf_counter()
         self.t_taken = 0.0
 
@@ -231,6 +236,7 @@ class DynamicBatcher:
         max_wait_ms: float = 4.0,
         max_queue: int = 256,
         telemetry=None,
+        tracer=None,
         verify: bool = True,
         quant_fn: Callable[[np.ndarray], np.ndarray] | None = None,
         quant_bound: float | None = None,
@@ -241,6 +247,10 @@ class DynamicBatcher:
         self.max_batch = int(max_batch)
         self.max_wait_s = float(max_wait_ms) / 1e3
         self.obs = telemetry if telemetry is not None else NULL_TELEMETRY
+        # optional per-process segment tracer (obs/tracing.py): the
+        # server assigns its own after construction so batcher child
+        # segments land in the SAME sampler deciding the request's fate
+        self.tracer = tracer
         ladder = bucket_sizes(self.max_batch)
         if quant_fn is not None:
             if quant_bound is None:
@@ -350,12 +360,14 @@ class DynamicBatcher:
 
     # ---------------------------------------------------------- intake
 
-    def submit(self, obs, trace: str | None = None) -> _Pending:
+    def submit(self, obs, trace: str | None = None,
+               span: str | None = None) -> _Pending:
         """Enqueue one observation; returns the pending slot to wait on.
         Sheds (:class:`BatcherSaturated`) when the queue is full.
         ``trace``: caller-assigned request id threaded through the
         recorder's shed/batch events (the server mints one per HTTP
-        request)."""
+        request); ``span``: the caller's request span id, parent of the
+        lifecycle child segments."""
         if self._closing:
             raise BatcherClosed("batcher is draining — no new requests")
         arr = np.asarray(obs, np.float32)
@@ -364,7 +376,7 @@ class DynamicBatcher:
                 f"observation shape {arr.shape} != bundle obs_shape "
                 f"{self.obs_shape}"
             )
-        item = _Pending(arr, trace=trace)
+        item = _Pending(arr, trace=trace, span=span)
         self.obs.counters.inc("requests_total")
         with self._close_lock:
             if self._closing:
@@ -382,9 +394,10 @@ class DynamicBatcher:
         return item
 
     def predict(self, obs, timeout: float | None = 30.0,
-                trace: str | None = None) -> np.ndarray:
+                trace: str | None = None,
+                span: str | None = None) -> np.ndarray:
         """submit + wait; raises the batch's error or TimeoutError."""
-        item = self.submit(obs, trace=trace)
+        item = self.submit(obs, trace=trace, span=span)
         if not item.event.wait(timeout):
             raise TimeoutError(f"no batch result within {timeout}s")
         if item.error is not None:
@@ -532,6 +545,22 @@ class DynamicBatcher:
             # ring is bounded, so high-RPS churn evicts, not grows)
             obs.event("batch_dispatch", bucket=bucket, n=n,
                       dur_ms=round(dt * 1e3, 3), traces=traces)
+        tracer = self.tracer
+        # one wall/mono pair: every segment of this dispatch rebases its
+        # perf_counter mark onto the same wall epoch (cross-process
+        # assembly aligns on wall `ts`; see obs/tracing.py)
+        wall = time.time() if tracer is not None else 0.0
+        mono = time.perf_counter()
+        if tracer is not None and traces:
+            # per-dispatch `batch` span linking the member request ids —
+            # bypasses the tail sampler (record): dispatch volume is
+            # already bounded by construction, and the span must survive
+            # for WHICHEVER member the sampler ends up keeping
+            tracer.record(make_segment(
+                traces[0], tracer.span_id(), None, tracer.proc, "batch",
+                t_dispatch, dt, attrs={"bucket": bucket, "n": n,
+                                       "traces": traces},
+                ts=wall - (mono - t_dispatch)))
         if err is None:
             # own the results before crossing threads: np.asarray on a jax
             # output is a ZERO-COPY view of the XLA buffer, and waiter
@@ -546,10 +575,26 @@ class DynamicBatcher:
                 item.result = out[i]
             else:
                 item.error = err
+            if tracer is not None and item.trace and item.span:
+                # lifecycle children under the server's request span,
+                # recorded BEFORE event.set() so they are buffered by the
+                # time the handler thread applies the tail verdict
+                for nm, t0s, ds in (
+                        ("queue_wait", item.t_submit,
+                         item.t_taken - item.t_submit),
+                        ("coalesce", item.t_taken,
+                         t_dispatch - item.t_taken),
+                        ("compute", t_predict, dt)):
+                    tracer.add(make_segment(
+                        item.trace, tracer.span_id(), item.span,
+                        tracer.proc, nm, t0s, ds,
+                        ts=wall - (mono - t0s)))
             # full in-batcher request latency (submit → result ready):
             # the quantity the server's tail SLO is about, and the one
-            # the quantile-honesty test reconciles against loadgen
-            obs.hists.observe("serve/request_s", t_done - item.t_submit)
+            # the quantile-honesty test reconciles against loadgen;
+            # the exemplar ties the bucket back to an assemblable trace
+            obs.hists.observe("serve/request_s", t_done - item.t_submit,
+                              exemplar=item.trace)
             item.event.set()
 
     # ----------------------------------------------------------- drain

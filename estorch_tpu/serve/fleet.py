@@ -232,6 +232,10 @@ class Fleet:
             breaker_open_s=float(rc.get("breaker_open_s", 1.0)),
             rollout_cb=self._rollout_cb,
             scale_cb=self._scale_cb,
+            # per-process trace dir (obs/tracing.py): the router's
+            # sampled segments land beside the replicas' so `obs trace
+            # --fleet <workdir>` assembles the whole hop chain
+            run_dir=os.path.join(self.workdir, "router"),
         )
         self.slots = [_Slot(i, self.workdir)
                       for i in range(int(config["replicas"]))]
@@ -279,6 +283,9 @@ class Fleet:
         argv = [sys.executable, "-m", "estorch_tpu.serve",
                 "--bundle", self.bundle, "--port", "0",
                 "--port-file", slot.port_file,
+                # per-slot trace dir: slot names are stable across
+                # respawns, so a replica's segments survive its restarts
+                "--run-dir", os.path.join(self.workdir, slot.name),
                 "--beat-interval", "0.5"]
         for flag, key in (("--max-batch", "max_batch"),
                           ("--max-wait-ms", "max_wait_ms"),

@@ -47,8 +47,11 @@ class _Conn:
 
 
 def _parse_responses(conn: _Conn):
-    """Yield (status, body bytes) for each complete HTTP response in the
-    buffer; leaves partial data buffered."""
+    """Yield (status, body bytes, trace id) for each complete HTTP
+    response in the buffer; leaves partial data buffered.  The trace id
+    is the server's ``X-Trace-Id`` response header ("" when absent) —
+    the join key between a latency row and the assembled distributed
+    trace (``obs trace --fleet`` / ``obs slow``)."""
     while True:
         head_end = conn.buf.find(b"\r\n\r\n")
         if head_end < 0:
@@ -56,15 +59,18 @@ def _parse_responses(conn: _Conn):
         head = conn.buf[:head_end]
         status = int(head.split(b" ", 2)[1])
         clen = 0
+        trace = ""
         for line in head.split(b"\r\n")[1:]:
             if line[:15].lower() == b"content-length:":
                 clen = int(line[15:])
+            elif line[:11].lower() == b"x-trace-id:":
+                trace = line[11:].strip().decode("ascii", "replace")
         total = head_end + 4 + clen
         if len(conn.buf) < total:
             return
         body = conn.buf[head_end + 4:total]
         conn.buf = conn.buf[total:]
-        yield status, body
+        yield status, body, trace
 
 
 def run_load(
@@ -124,6 +130,7 @@ def run_load(
     import collections
 
     latencies: list[float] = []
+    trace_ids: list[str] = []
     responses: list | None = [None] * len(obs_list) if collect_responses else None
     issued = completed = errors = shed = scheduled = 0
     t0 = time.perf_counter()
@@ -220,9 +227,10 @@ def run_load(
                     break
                 continue
             c.buf += chunk
-            for status, body in _parse_responses(c):
+            for status, body, trace in _parse_responses(c):
                 completed += 1
                 latencies.append(time.perf_counter() - c.sent_at)
+                trace_ids.append(trace)
                 if status == 503:
                     shed += 1
                 elif status != 200:
@@ -274,6 +282,9 @@ def run_load(
         out["responses"] = responses
     if collect_latencies:
         out["latencies_s"] = latencies
+        # same completion order as latencies_s: trace_ids[i] is the
+        # server's X-Trace-Id for the request latencies_s[i] measured
+        out["trace_ids"] = trace_ids
     return out
 
 
@@ -312,6 +323,8 @@ def coldstart_probe(
         "errors": first["errors"] + rest["errors"],
         "shed": first.get("shed", 0) + rest.get("shed", 0),
         "latencies_s": lats,
+        "trace_ids": list(first.get("trace_ids", [])) + list(
+            rest.get("trace_ids", [])),
     }
 
 
@@ -430,17 +443,25 @@ def write_capacity_artifact(sweep: dict, path: str, *,
 
 
 def write_latency_rows(latencies_s: list, path: str,
-                       endpoint: str = "/predict") -> str:
+                       endpoint: str = "/predict",
+                       trace_ids: list | None = None) -> str:
     """Per-request latency rows as JSONL (``{"endpoint", "latency_s"}``)
     — the measurement file shape ``obs regress --tail`` groups by
-    endpoint.  Atomic (tmp + rename), like every other artifact."""
+    endpoint.  When ``trace_ids`` is given (same completion order as
+    ``latencies_s``), each row that has one gains a ``trace_id`` column:
+    the server's ``X-Trace-Id``, so a tail outlier in the measurement
+    file can be looked up as an assembled distributed trace
+    (``obs trace --fleet`` / ``obs slow --store``).  Atomic (tmp +
+    rename), like every other artifact."""
     import os
 
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
-        for v in latencies_s:
-            f.write(json.dumps({"endpoint": endpoint,
-                                "latency_s": float(v)}) + "\n")
+        for i, v in enumerate(latencies_s):
+            row = {"endpoint": endpoint, "latency_s": float(v)}
+            if trace_ids is not None and i < len(trace_ids) and trace_ids[i]:
+                row["trace_id"] = str(trace_ids[i])
+            f.write(json.dumps(row) + "\n")
     os.replace(tmp, path)
     return path
 
@@ -456,13 +477,16 @@ def _selfcheck() -> int:
 
     class Echo(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
+        served = 0  # class-level: stamps each response's X-Trace-Id
 
         def do_POST(self):
             n = int(self.headers.get("Content-Length", 0))
             data = json.loads(self.rfile.read(n))
             body = json.dumps({"action": data["obs"]}).encode()
+            Echo.served += 1
             self.send_response(200)
             self.send_header("Content-Length", str(len(body)))
+            self.send_header("X-Trace-Id", f"t-{Echo.served:06d}")
             self.end_headers()
             self.wfile.write(body)
 
@@ -482,6 +506,21 @@ def _selfcheck() -> int:
             problems.append(f"closed loop lost requests: {closed}")
         if len(closed.get("latencies_s", [])) != 16:
             problems.append("per-request latencies not collected")
+        tids = closed.get("trace_ids", [])
+        if len(tids) != 16 or len(set(tids)) != 16 or not all(tids):
+            problems.append(f"X-Trace-Id response headers not captured "
+                            f"per request: {tids}")
+        import os
+        import tempfile
+        with tempfile.TemporaryDirectory() as td:
+            rows_path = write_latency_rows(
+                closed["latencies_s"], os.path.join(td, "lat.jsonl"),
+                trace_ids=tids)
+            with open(rows_path) as f:
+                rows = [json.loads(line) for line in f]
+            if ([r.get("trace_id") for r in rows] != tids
+                    or any("latency_s" not in r for r in rows)):
+                problems.append("latency rows lost the trace_id column")
         got = [r and r["action"] for r in closed["responses"]]
         if got != obs_list:
             problems.append("responses not matched to request indices")
@@ -518,7 +557,7 @@ def _selfcheck() -> int:
         print(f"loadgen selfcheck: {p}", file=sys.stderr)
     if not problems:
         print("loadgen selfcheck: OK (closed+open loop, percentiles, "
-              "response indexing, capacity sweep)")
+              "response indexing, trace-id capture, capacity sweep)")
     return 1 if problems else 0
 
 
@@ -560,8 +599,9 @@ def main(argv=None) -> int:
                         "bundle manifest's warm platform)")
     p.add_argument("--latencies-out", default=None, metavar="PATH",
                    help="also write per-request latency rows as JSONL "
-                        "({'endpoint', 'latency_s'}) — the obs regress "
-                        "--tail measurement format")
+                        "({'endpoint', 'latency_s', 'trace_id'}) — the "
+                        "obs regress --tail measurement format; trace_id "
+                        "joins a row to its assembled distributed trace")
     p.add_argument("--selfcheck", action="store_true",
                    help="validate the loadgen itself against an "
                         "in-process echo server (CI gate)")
@@ -594,8 +634,9 @@ def main(argv=None) -> int:
             args.address, total=args.coldstart, conns=args.conns,
             obs=json.loads(args.obs) if args.obs else None)
         lats = res.pop("latencies_s")
+        traces = res.pop("trace_ids", None)
         if args.latencies_out:
-            write_latency_rows(lats, args.latencies_out)
+            write_latency_rows(lats, args.latencies_out, trace_ids=traces)
             res["latencies_out"] = args.latencies_out
         print(json.dumps(res))
         return 0
@@ -606,7 +647,8 @@ def main(argv=None) -> int:
         collect_latencies=bool(args.latencies_out),
     )
     if args.latencies_out:
-        write_latency_rows(res.pop("latencies_s"), args.latencies_out)
+        write_latency_rows(res.pop("latencies_s"), args.latencies_out,
+                           trace_ids=res.pop("trace_ids", None))
         res["latencies_out"] = args.latencies_out
     print(json.dumps(res))
     return 0

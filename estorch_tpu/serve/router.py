@@ -65,6 +65,9 @@ if __package__:
     from ..obs.hist import Histogram, Histograms
     from ..obs.export.prometheus import (metric_name, render_exposition,
                                          _escape_label)
+    from ..obs.tracing import (PARENT_SPAN_HEADER, SAMPLED_HEADER,
+                               TRACES_FILENAME, ProcessTracer,
+                               make_segment, traces_payload)
 else:  # file-run (wedged-jax host): load siblings without any package init
     import importlib.util
 
@@ -81,12 +84,20 @@ else:  # file-run (wedged-jax host): load siblings without any package init
     _hist = _load("_estorch_obs_hist", os.pardir, "obs", "hist.py")
     _prom = _load("_estorch_obs_prometheus", os.pardir, "obs", "export",
                   "prometheus.py")
+    _tracing = _load("_estorch_obs_tracing", os.pardir, "obs",
+                     "tracing.py")
     Counters = _counters.Counters
     Histogram = _hist.Histogram
     Histograms = _hist.Histograms
     metric_name = _prom.metric_name
     render_exposition = _prom.render_exposition
     _escape_label = _prom._escape_label
+    PARENT_SPAN_HEADER = _tracing.PARENT_SPAN_HEADER
+    SAMPLED_HEADER = _tracing.SAMPLED_HEADER
+    TRACES_FILENAME = _tracing.TRACES_FILENAME
+    ProcessTracer = _tracing.ProcessTracer
+    make_segment = _tracing.make_segment
+    traces_payload = _tracing.traces_payload
 
 DRAIN_GRACE_S = 15.0
 
@@ -246,9 +257,19 @@ class Router:
         rollout_cb=None,
         scale_cb=None,
         serve_http: bool = True,
+        run_dir: str | None = None,
+        trace_head_every: int = 16,
     ):
         self.counters = Counters()
         self.hists = Histograms()
+        # distributed tracing (obs/tracing.py): per-hop segments,
+        # tail-sampled when the route span ends; ``run_dir`` enables the
+        # traces.jsonl flush beside the heartbeat/port files
+        self.tracer = ProcessTracer(
+            "router", counters=self.counters, hists=self.hists,
+            hist_name="router/route_s", head_every=trace_head_every,
+            path=(os.path.join(run_dir, TRACES_FILENAME)
+                  if run_dir else None))
         self.retry_budget = int(retry_budget)
         self.backoff_base_s = float(backoff_base_s)
         self.backoff_max_s = float(backoff_max_s)
@@ -366,6 +387,7 @@ class Router:
             self._inflight_zero.wait(DRAIN_GRACE_S)
         if self._httpd is not None:
             self._httpd.server_close()
+        self.tracer.flush()  # sampled segments outlive the process
         return {"drained": True, "clean": self._inflight_zero.is_set(),
                 "counters": self.counters.snapshot()}
 
@@ -498,18 +520,28 @@ class Router:
 
     # one upstream try; raises UpstreamError on any failed attempt
     def _upstream_predict(self, rep: Replica, body: bytes, trace: str,
-                          cancel_box: dict | None = None
-                          ) -> tuple[int, bytes]:
+                          cancel_box: dict | None = None,
+                          parent_span: str | None = None,
+                          sampled: bool = False) -> tuple[int, bytes]:
         conn = http.client.HTTPConnection(
             *_split(rep.address), timeout=self.upstream_timeout_s)
         if cancel_box is not None:
             cancel_box["conn"] = conn
         try:
             try:
-                conn.request("POST", "/predict", body, {
+                headers = {
                     "Content-Type": "application/json",
                     "X-Trace-Id": trace,
-                })
+                }
+                if parent_span:
+                    # the replica's request span parents to THIS leg, so
+                    # assembly can tell retry/hedge legs apart
+                    headers[PARENT_SPAN_HEADER] = parent_span
+                if sampled:
+                    # this hop already knows the trace is interesting
+                    # (retry/hedge leg): force the downstream sampler
+                    headers[SAMPLED_HEADER] = "1"
+                conn.request("POST", "/predict", body, headers)
                 resp = conn.getresponse()
                 data = resp.read()
             except (TimeoutError, OSError,
@@ -519,6 +551,20 @@ class Router:
                 # replica.  Counts toward the breaker.
                 raise UpstreamError(f"{type(e).__name__}: {e}",
                                     breaker=True) from e
+            except Exception as e:
+                # a hedge cancel races this read: the winner's thread
+                # calls conn.close() under us, and http.client's
+                # internals can surface that as errors outside the
+                # tuple above (e.g. AttributeError from a half-torn
+                # response object mid-read).  Only when WE cancelled is
+                # that expected — map it to the failed-attempt path so
+                # the loser records its cancelled leg instead of dying
+                # as an unhandled thread exception.
+                if cancel_box is not None and cancel_box.get("cancelled"):
+                    raise UpstreamError(
+                        f"cancelled mid-read ({type(e).__name__}: {e})",
+                        breaker=False) from e
+                raise
             if resp.status == 503:
                 # shed or draining: alive but refusing — try another
                 # replica, but don't open the breaker for backpressure
@@ -534,19 +580,34 @@ class Router:
             conn.close()
 
     def _attempt(self, rep: Replica, body: bytes, trace: str,
-                 cancel_box: dict | None = None) -> tuple[int, bytes]:
-        """One accounted attempt: breaker + latency + counters."""
+                 cancel_box: dict | None = None, *,
+                 parent_span: str | None = None, attempt: int = 0,
+                 hedge: bool = False,
+                 sampled: bool = False) -> tuple[int, bytes]:
+        """One accounted attempt: breaker + latency + counters + one
+        ``upstream`` trace leg (retry legs carry their attempt index,
+        hedge legs their flag, a cancelled loser its ``cancelled``)."""
         with rep.lock:
             rep.inflight += 1
             rep.requests += 1
         t0 = time.perf_counter()
+        leg_span = self.tracer.span_id()
         try:
-            status, data = self._upstream_predict(rep, body, trace,
-                                                  cancel_box)
+            status, data = self._upstream_predict(
+                rep, body, trace, cancel_box, parent_span=leg_span,
+                sampled=sampled or hedge)
         except UpstreamError as e:
             with rep.lock:
                 rep.inflight -= 1
-            if cancel_box is not None and cancel_box.get("cancelled"):
+            cancelled = bool(cancel_box is not None
+                             and cancel_box.get("cancelled"))
+            self.tracer.add(make_segment(
+                trace, leg_span, parent_span, "router", "upstream",
+                t0, time.perf_counter() - t0,
+                attrs={"replica": rep.name, "attempt": attempt,
+                       "hedge": hedge, "cancelled": cancelled,
+                       "error": str(e)}))
+            if cancelled:
                 # WE closed this connection (hedge loser): the replica
                 # is healthy-but-slow, not dead — charging its breaker
                 # would flap a slow replica out of rotation, the exact
@@ -563,7 +624,11 @@ class Router:
             rep.inflight -= 1
         rep.breaker.record_success()
         rep.hist.observe(dt)
-        self.hists.observe("router/upstream_s", dt)
+        self.hists.observe("router/upstream_s", dt, exemplar=trace)
+        self.tracer.add(make_segment(
+            trace, leg_span, parent_span, "router", "upstream", t0, dt,
+            attrs={"replica": rep.name, "attempt": attempt,
+                   "hedge": hedge, "status": status}))
         return status, data
 
     def _hedge_deadline_s(self) -> float | None:
@@ -577,12 +642,18 @@ class Router:
             return self.hedge_min_ms / 1e3
         return max(q, self.hedge_min_ms / 1e3)
 
-    def route_predict(self, body: bytes, trace: str
+    def route_predict(self, body: bytes, trace: str,
+                      parent_span: str | None = None,
+                      forced: bool = False
                       ) -> tuple[int, bytes, str | None]:
         """Forward one /predict; returns (status, body, replica name).
         Exhausted budget / no eligible replica answers 503 here — the
-        handler writes it; nothing is ever retried after that write."""
+        handler writes it; nothing is ever retried after that write.
+        The whole routing decision is one ``route`` trace span; its end
+        is where the tail sampler judges the trace."""
         t0 = time.perf_counter()
+        route_span = self.tracer.span_id()
+        flags = {"retried": False, "hedged": False, "breaker": False}
         tried: set[str] = set()
         last_err = "no eligible replica"
         for attempt in range(1 + self.retry_budget):
@@ -590,7 +661,10 @@ class Router:
             if rep is None:
                 break
             tried.add(rep.name)
+            if rep.breaker.state != BREAKER_CLOSED:
+                flags["breaker"] = True
             if attempt:
+                flags["retried"] = True
                 self.counters.inc("router_retries_total")
                 # exponential backoff + jitter: a mass failover must not
                 # stampede the survivors in lockstep
@@ -599,18 +673,32 @@ class Router:
                 time.sleep(base * (0.5 + self._rng.random()))
             try:
                 status, data, winner = self._attempt_maybe_hedged(
-                    rep, body, trace, first=(attempt == 0), tried=tried)
+                    rep, body, trace, first=(attempt == 0), tried=tried,
+                    route_span=route_span, attempt=attempt, flags=flags,
+                    sampled=forced)
             except UpstreamError as e:
+                flags["breaker"] = flags["breaker"] or e.breaker
                 last_err = str(e)
                 continue
             self.counters.inc("router_requests_total")
-            self._observe_live(winner, body, data, status,
-                               time.perf_counter() - t0)
-            self.hists.observe("router/route_s",
-                               time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            self._observe_live(winner, body, data, status, dt)
+            self.hists.observe("router/route_s", dt, exemplar=trace)
+            self.tracer.add(make_segment(
+                trace, route_span, parent_span, "router", "route", t0,
+                dt, attrs={"status": status, "replica": winner.name,
+                           "attempts": attempt + 1}))
+            self.tracer.finish(trace, dt, error=status >= 400,
+                               forced=forced, **flags)
             return status, data, winner.name
         self.counters.inc("router_no_upstream_total")
-        self.hists.observe("router/route_s", time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        self.hists.observe("router/route_s", dt, exemplar=trace)
+        self.tracer.add(make_segment(
+            trace, route_span, parent_span, "router", "route", t0, dt,
+            attrs={"status": 503, "attempts": len(tried),
+                   "error": last_err}))
+        self.tracer.finish(trace, dt, error=True, forced=forced, **flags)
         body_out = json.dumps({
             "error": f"no healthy upstream after {len(tried)} attempt(s)"
                      f" — last: {last_err}",
@@ -619,7 +707,10 @@ class Router:
         return 503, body_out, None
 
     def _attempt_maybe_hedged(self, rep: Replica, body: bytes, trace: str,
-                              *, first: bool, tried: set[str]
+                              *, first: bool, tried: set[str],
+                              route_span: str | None = None,
+                              attempt: int = 0, flags: dict | None = None,
+                              sampled: bool = False
                               ) -> tuple[int, bytes, Replica]:
         """First attempt with optional tail hedging: when the primary
         outlives the hedge deadline, duplicate onto a second replica and
@@ -630,16 +721,21 @@ class Router:
         the budget is already paying for them."""
         deadline = self._hedge_deadline_s() if first else None
         if deadline is None:
-            status, data = self._attempt(rep, body, trace)
+            status, data = self._attempt(rep, body, trace,
+                                         parent_span=route_span,
+                                         attempt=attempt, sampled=sampled)
             return status, data, rep
 
         results: list = []
         done = threading.Event()
         lock = threading.Lock()
 
-        def run(target: Replica, box: dict) -> None:
+        def run(target: Replica, box: dict, hedge_leg: bool) -> None:
             try:
-                out = self._attempt(target, body, trace, cancel_box=box)
+                out = self._attempt(target, body, trace, cancel_box=box,
+                                    parent_span=route_span,
+                                    attempt=attempt, hedge=hedge_leg,
+                                    sampled=sampled)
                 with lock:
                     results.append((target, out, None))
             except UpstreamError as e:
@@ -648,20 +744,23 @@ class Router:
             done.set()
 
         primary_box: dict = {}
-        t_p = threading.Thread(target=run, args=(rep, primary_box),
+        t_p = threading.Thread(target=run, args=(rep, primary_box, False),
                                name="router-primary", daemon=True)
         t_p.start()
         hedged = False
         hedge_rep = None
         hedge_box: dict = {}
+        t_h = None
         if not done.wait(deadline):
             hedge_rep = self.pick(exclude=tried | {rep.name})
             if hedge_rep is not None:
                 tried.add(hedge_rep.name)
                 hedged = True
+                if flags is not None:
+                    flags["hedged"] = True
                 self.counters.inc("router_hedged_total")
                 t_h = threading.Thread(target=run,
-                                       args=(hedge_rep, hedge_box),
+                                       args=(hedge_rep, hedge_box, True),
                                        name="router-hedge", daemon=True)
                 t_h.start()
         # wait until SOME attempt succeeds or all in flight have failed
@@ -680,9 +779,9 @@ class Router:
         if hedged:
             if winner is hedge_rep:
                 self.counters.inc("router_hedge_wins_total")
-                loser_box = primary_box
+                loser_box, loser_t = primary_box, t_p
             else:
-                loser_box = hedge_box
+                loser_box, loser_t = hedge_box, t_h
             # cancel the loser: mark FIRST (so its _attempt knows the
             # failure is ours, not the replica's — no breaker charge),
             # then close the socket to abandon the duplicate answer; an
@@ -694,6 +793,13 @@ class Router:
 
                 with contextlib.suppress(OSError):
                     conn.close()
+            # give the aborted loser a beat to record its cancelled leg
+            # BEFORE the route span ends and the tail sampler judges the
+            # trace — a closed socket raises immediately, so this join
+            # costs microseconds on the happy path and is best-effort
+            # (a straggler leg still lands via the decided-trace cache)
+            if loser_t is not None:
+                loser_t.join(0.25)
         return out[0], out[1], winner
 
     # ------------------------------------------------------------- canary
@@ -945,6 +1051,21 @@ def _split(address: str) -> tuple[str, int]:
     return host, int(port or 80)
 
 
+def _since_of(path: str) -> int:
+    """``since`` cursor of a ``/traces?since=N`` request path (0 when
+    absent/garbage — a bad cursor degrades to a full recent-window
+    answer, never a 400 on a scrape path)."""
+    query = path.partition("?")[2]
+    for part in query.split("&"):
+        key, _, value = part.partition("=")
+        if key == "since":
+            try:
+                return int(value)
+            except ValueError:
+                return 0
+    return 0
+
+
 def _action_of(data: bytes):
     try:
         return json.loads(data.decode()).get("action")
@@ -995,6 +1116,10 @@ def _make_handler(router: Router):
                 self._reply_json(200, router.rollout_status())
             elif self.path == "/scale":
                 self._reply_json(200, router.scale_status())
+            elif self.path.split("?", 1)[0] == "/traces":
+                self._reply_json(200, traces_payload(
+                    router.tracer, _since_of(self.path),
+                    hists=router.hists))
             else:
                 self._reply_json(404, {"error": f"no route {self.path!r}"})
 
@@ -1022,9 +1147,12 @@ def _make_handler(router: Router):
                 return
             trace = (self.headers.get("X-Trace-Id")
                      or f"r{next(router._req_seq)}")
+            parent_span = self.headers.get(PARENT_SPAN_HEADER) or None
+            forced = self.headers.get(SAMPLED_HEADER) == "1"
             router.track_request()
             try:
-                status, body, upstream = router.route_predict(raw, trace)
+                status, body, upstream = router.route_predict(
+                    raw, trace, parent_span=parent_span, forced=forced)
                 extra = {"X-Trace-Id": trace}
                 if upstream:
                     extra["X-Upstream"] = upstream
@@ -1102,6 +1230,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "'store' and 'capacity'")
     p.add_argument("--port-file", default=None, metavar="PATH",
                    help="atomically write {host,port,pid} JSON once bound")
+    p.add_argument("--run-dir", default=None, metavar="DIR",
+                   help="flush tail-sampled trace segments to "
+                        "DIR/traces.jsonl (docs/observability.md "
+                        "'Distributed tracing')")
     return p
 
 
@@ -1131,6 +1263,7 @@ def run_router(args, replicas: list[tuple[str, str]],
         breaker_failures=args.breaker_failures,
         breaker_open_s=args.breaker_open_s,
         rollout_cb=rollout_cb,
+        run_dir=getattr(args, "run_dir", None),
     )
     router.start_background()
     return router

@@ -40,6 +40,9 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import numpy as np
 
 from ..obs.spans import Telemetry, resolve_telemetry
+from ..obs.tracing import (PARENT_SPAN_HEADER, SAMPLED_HEADER,
+                           TRACES_FILENAME, ProcessTracer, make_segment,
+                           traces_payload)
 from .batcher import BatcherClosed, BatcherSaturated, DynamicBatcher
 from .bundle import BundleError, load_bundle
 
@@ -73,6 +76,8 @@ class PolicyServer:
         warm_install: bool = True,
         quant_bound: float | None = None,
         t0_monotonic: float | None = None,
+        run_dir: str | None = None,
+        trace_head_every: int = 16,
     ):
         self.obs = resolve_telemetry(telemetry)
         self.max_batch = int(max_batch)
@@ -125,6 +130,19 @@ class PolicyServer:
             "startup_s", round(time.monotonic() - self._started_mono, 3))
         self._httpd = _Httpd((host, int(port)), _make_handler(self))
         self.host, self.port = self._httpd.server_address[:2]
+        # per-hop trace segments + tail sampler (obs/tracing.py,
+        # docs/observability.md "Distributed tracing"): proc is
+        # port-qualified so fleet replicas land in distinct lanes of the
+        # assembled trace.  The batcher shares this tracer — its
+        # lifecycle child segments must ride the SAME tail verdict the
+        # handler applies at response time.
+        self.tracer = ProcessTracer(
+            f"server-{self.port}", counters=self.obs.counters,
+            hists=self.obs.hists, hist_name="serve/request_s",
+            head_every=trace_head_every,
+            path=(os.path.join(run_dir, TRACES_FILENAME) if run_dir
+                  else None))
+        self._engine.batcher.tracer = self.tracer
 
     # ----------------------------------------------------------- engine
 
@@ -152,6 +170,10 @@ class PolicyServer:
         if self.warm and len(batcher.buckets) == 1:
             b = batcher.buckets[0]
             batcher.batch_fn(np.zeros((b,) + bundle.obs_shape, np.float32))
+        # hot reload swaps in a fresh batcher mid-flight: it must keep
+        # feeding the same per-process tracer (None during the FIRST
+        # build — __init__ assigns once the bound port names the proc)
+        batcher.tracer = getattr(self, "tracer", None)
         dt = time.perf_counter() - t0
         after = compile_event_counts()
         warm_installed = bool(bundle.warm_status
@@ -193,7 +215,8 @@ class PolicyServer:
 
     # ---------------------------------------------------------- serving
 
-    def predict(self, obs, trace: str | None = None) -> np.ndarray:
+    def predict(self, obs, trace: str | None = None,
+                span: str | None = None) -> np.ndarray:
         # one engine read per attempt; a request racing a hot reload can
         # catch the OLD batcher mid-close (BatcherClosed) on a perfectly
         # healthy server — retry against the freshly-swapped engine
@@ -203,7 +226,7 @@ class PolicyServer:
             try:
                 out = eng.batcher.predict(obs,
                                           timeout=self.request_timeout_s,
-                                          trace=trace)
+                                          trace=trace, span=span)
             except BatcherClosed:
                 if self.draining or eng is self._engine:
                     raise
@@ -363,6 +386,7 @@ class PolicyServer:
         self._inflight_zero.wait(DRAIN_GRACE_S)
         self._engine.batcher.close(drain=drain)
         self._httpd.server_close()
+        self.tracer.flush()  # sampled segments outlive the process
         self.obs.note("drained")
         final = {
             "drained": True,
@@ -411,6 +435,17 @@ def _make_handler(server: PolicyServer):
                 self._reply(200 if h["ok"] else 503, h)
             elif self.path == "/stats":
                 self._reply(200, server.stats())
+            elif self.path.split("?", 1)[0] == "/traces":
+                # sampled segments since a cursor + histogram exemplars
+                # (obs/tracing.py traces_payload) — the collector's
+                # scrape leg of cross-process trace assembly
+                q = self.path.split("since=", 1)
+                try:
+                    since = int(q[1].split("&", 1)[0]) if len(q) == 2 else 0
+                except ValueError:
+                    since = 0
+                self._reply(200, traces_payload(server.tracer, since,
+                                                hists=server.obs.hists))
             elif self.path == "/metrics":
                 body = server.metrics().encode()
                 self.send_response(200)
@@ -457,18 +492,30 @@ def _make_handler(server: PolicyServer):
             # direct clients still get a locally-minted r<N>
             trace = (self.headers.get("X-Trace-Id")
                      or f"r{next(server._req_seq)}")
+            # span parenting crosses the process boundary here: the
+            # router's upstream LEG span arrives as X-Parent-Span, and an
+            # upstream hop that already judged the trace interesting
+            # (retry/hedge legs) forces this process's tail sampler
+            parent_span = self.headers.get(PARENT_SPAN_HEADER) or None
+            forced = self.headers.get(SAMPLED_HEADER) == "1"
+            req_span = server.tracer.span_id()
+            t0 = time.perf_counter()
+            status, shed = 500, False
             headers = {"X-Trace-Id": trace}
             server.track_request()
             try:
                 try:
-                    out = server.predict(data["obs"], trace=trace)
+                    out = server.predict(data["obs"], trace=trace,
+                                         span=req_span)
                 except BatcherSaturated:
+                    status, shed = 503, True
                     self._reply(503,
                                 {"error": "saturated — retry with backoff",
                                  "trace": trace},
                                 {"Retry-After": "1", **headers})
                     return
                 except BatcherClosed:
+                    status = 503
                     self._reply(503, {"error": "draining"}, headers)
                     return
                 except (ValueError, TypeError) as e:
@@ -476,9 +523,11 @@ def _make_handler(server: PolicyServer):
                     # nulls/non-numerics → TypeError from np.asarray) —
                     # genuinely the client's fault; batch-side faults
                     # arrive as BatchError below, never these types
+                    status = 400
                     self._reply(400, {"error": str(e)}, headers)
                     return
                 except TimeoutError as e:
+                    status = 504
                     self._reply(504, {"error": str(e)}, headers)
                     return
                 except Exception as e:  # noqa: BLE001 — a server fault
@@ -492,11 +541,24 @@ def _make_handler(server: PolicyServer):
                     return
                 t_write = time.perf_counter()
                 self._reply(200, {"action": out.tolist()}, headers)
+                status = 200
                 # the write leg of the lifecycle (serialize + socket):
                 # the only piece the batcher's request_s cannot see
-                server.obs.hists.observe("serve/write_s",
-                                         time.perf_counter() - t_write)
+                dt_write = time.perf_counter() - t_write
+                server.obs.hists.observe("serve/write_s", dt_write)
+                server.tracer.add(make_segment(
+                    trace, server.tracer.span_id(), req_span,
+                    server.tracer.proc, "write", t_write, dt_write))
             finally:
+                # the request ROOT span + the tail verdict — recorded
+                # last so every child (batcher lifecycle, write) is
+                # already buffered under this trace id
+                dur = time.perf_counter() - t0
+                server.tracer.add(make_segment(
+                    trace, req_span, parent_span, server.tracer.proc,
+                    "request", t0, dur, attrs={"status": status}))
+                server.tracer.finish(trace, dur, error=status >= 400,
+                                     shed=shed, forced=forced)
                 server.untrack_request()
 
         def _reload(self, data: dict) -> None:
@@ -527,6 +589,7 @@ def run_server(args) -> int:
         max_queue=args.max_queue, telemetry=telemetry, warm=args.warm,
         dtype=args.dtype, warm_install=not args.no_warm,
         t0_monotonic=getattr(args, "_t0_monotonic", None),
+        run_dir=getattr(args, "run_dir", None),
     )
 
     stop = threading.Event()

@@ -77,6 +77,15 @@ echo "== collector file-run probe =="
 # and still passes the full selfcheck
 python estorch_tpu/obs/agg/collector.py --selfcheck
 
+echo "== obs trace selfcheck =="
+# distributed-trace assembly gate (estorch_tpu/obs/agg/traces.py): a
+# synthetic three-process fleet run dir (router + two replicas) with a
+# hedged trace, a torn tail, and a foreign trace — assembly must join
+# the hedge across all three processes with the loser marked cancelled,
+# isolate the foreign trace, skip the torn line, and the Perfetto
+# export must validate.  Stdlib, milliseconds.
+python -m estorch_tpu.obs trace --fleet --selfcheck
+
 echo "== obs regress tail selfcheck =="
 # tail-gate gate (estorch_tpu/obs/export/regress.py compare_tail): a
 # median-clean pair with ~2% of requests slowed 5x (the chaos-shed

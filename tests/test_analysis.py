@@ -1900,7 +1900,7 @@ class TestEngine:
         assert ids == ["R01", "R02", "R03", "R04", "R05", "R06", "R07",
                        "R08", "R09", "R10", "R11", "R12", "R13", "R14",
                        "R15", "R16", "R17", "R18", "R19", "R20", "R21",
-                       "R22"]
+                       "R22", "R23"]
 
     def test_syntax_error_becomes_finding(self):
         found = analyze_source("bad.py", "def broken(:\n")
@@ -2036,7 +2036,131 @@ class TestConfig:
         assert cfg.rule_ids([r.id for r in all_rules()]) == [
             "R01", "R02", "R03", "R04", "R05", "R06", "R07", "R08", "R09",
             "R10", "R11", "R12", "R13", "R14", "R15", "R16", "R17",
-            "R18", "R19", "R20", "R21", "R22"]
+            "R18", "R19", "R20", "R21", "R22", "R23"]
+
+
+# ---------------------------------------------------------------------
+# R23 dropped-trace-context
+# ---------------------------------------------------------------------
+
+class TestR23:
+    """dropped-trace-context — a handler that received X-Trace-Id but
+    whose outbound HTTP hop never forwards it cuts the assembled trace
+    at this process (docs/analysis.md, docs/observability.md
+    'Distributed tracing')."""
+
+    def test_dropped_context_flagged(self):
+        found = findings("""
+            import json
+            import urllib.request
+
+            class Handler:
+                def do_POST(self):
+                    trace = self.headers.get("X-Trace-Id")
+                    req = urllib.request.Request(
+                        "http://up/predict", data=b"{}")
+                    with urllib.request.urlopen(req, timeout=2) as resp:
+                        body = resp.read()
+                    self.reply(200, body, trace)
+        """, "R23")
+        assert len(found) == 1
+        assert "X-Trace-Id" in found[0].message
+
+    def test_httpconnection_request_flagged(self):
+        found = findings("""
+            import http.client
+
+            class Handler:
+                def do_POST(self):
+                    trace = self.headers.get("X-Trace-Id")
+                    conn = http.client.HTTPConnection("up", timeout=2)
+                    conn.request("POST", "/predict", b"{}")
+                    return conn.getresponse().read()
+        """, "R23")
+        assert len(found) == 1
+
+    def test_header_constant_read_flagged(self):
+        """Reading via the TRACE_HEADER constant is the same inbound
+        receipt as the literal."""
+        found = findings("""
+            import urllib.request
+            from estorch_tpu.obs.tracing import TRACE_HEADER
+
+            class Handler:
+                def do_POST(self):
+                    trace = self.headers.get(TRACE_HEADER)
+                    with urllib.request.urlopen("http://up/x",
+                                                timeout=2) as resp:
+                        return resp.read()
+        """, "R23")
+        assert len(found) == 1
+
+    def test_dict_literal_forward_clean(self):
+        """The router's shape: the trace id rides a headers dict keyed
+        by the literal."""
+        assert not findings("""
+            import json
+            import urllib.request
+
+            class Handler:
+                def do_POST(self):
+                    trace = self.headers.get("X-Trace-Id")
+                    req = urllib.request.Request(
+                        "http://up/predict", data=b"{}",
+                        headers={"X-Trace-Id": trace})
+                    with urllib.request.urlopen(req, timeout=2) as resp:
+                        return resp.read()
+        """, "R23")
+
+    def test_add_header_constant_forward_clean(self):
+        assert not findings("""
+            import urllib.request
+            from estorch_tpu.obs.tracing import TRACE_HEADER
+
+            class Handler:
+                def do_POST(self):
+                    trace = self.headers.get(TRACE_HEADER)
+                    req = urllib.request.Request("http://up/predict")
+                    req.add_header(TRACE_HEADER, trace)
+                    with urllib.request.urlopen(req, timeout=2) as resp:
+                        return resp.read()
+        """, "R23")
+
+    def test_subscript_store_forward_clean(self):
+        assert not findings("""
+            import urllib.request
+
+            class Handler:
+                def do_POST(self):
+                    trace = self.headers.get("X-Trace-Id")
+                    headers = {}
+                    headers["X-Trace-Id"] = trace
+                    req = urllib.request.Request("http://up/predict",
+                                                 headers=headers)
+                    with urllib.request.urlopen(req, timeout=2) as resp:
+                        return resp.read()
+        """, "R23")
+
+    def test_response_header_read_clean(self):
+        """A CLIENT reading X-Trace-Id off a response (the loadgen
+        shape) received nothing inbound — out of scope."""
+        assert not findings("""
+            import urllib.request
+
+            def probe(url):
+                with urllib.request.urlopen(url, timeout=2) as resp:
+                    return resp.headers.get("X-Trace-Id")
+        """, "R23")
+
+    def test_no_outbound_hop_clean(self):
+        """Receiving a trace id and answering locally (the replica
+        handler shape) drops nothing — there is no next hop."""
+        assert not findings("""
+            class Handler:
+                def do_POST(self):
+                    trace = self.headers.get("X-Trace-Id")
+                    self.reply(200, {"trace": trace})
+        """, "R23")
 
 
 class TestCLI:

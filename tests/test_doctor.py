@@ -476,6 +476,52 @@ class TestRouterCheck:
         assert rep["router"] == {"ok": True, "retries": 1}
 
 
+class TestTracingCheck:
+    def test_tracing_probe_assembles_across_processes(self):
+        """check_tracing: one forced-sampled request through a real
+        Router to a tracer-equipped toy replica must assemble into a
+        single trace spanning both processes, with a cross-process hop
+        and a schema-clean Perfetto export."""
+        out = doctor.check_tracing()
+        assert out["ok"] is True, out
+        assert out["procs"] == ["router", "replica"]
+        assert out["segments"] >= 3  # route + upstream leg + request
+        assert out["cross_hops"] >= 1
+        assert out["sampled"] == "forced"
+
+    def test_tracing_probe_never_crashes_the_report(self, monkeypatch):
+        from estorch_tpu.serve import router as router_mod
+
+        def boom(*a, **k):
+            raise OSError("no loopback")
+
+        monkeypatch.setattr(router_mod.Router, "__init__", boom)
+        out = doctor.check_tracing()
+        assert out["ok"] is False
+        assert "no loopback" in out["error"]
+
+    def test_report_gains_tracing_row(self, monkeypatch):
+        monkeypatch.setattr(doctor, "check_mesh",
+                            lambda **kw: {"status": "ok"})
+        monkeypatch.setattr(doctor, "check_device",
+                            lambda timeout_s=20.0, platform=None: {
+                                "status": "ok", "platform": "cpu",
+                                "n_devices": 8, "elapsed_s": 0.1,
+                                "timeout_s": timeout_s})
+        monkeypatch.setattr(doctor, "check_collector",
+                            lambda: {"ok": True})
+        monkeypatch.setattr(doctor, "check_router",
+                            lambda: {"ok": True})
+        monkeypatch.setattr(doctor, "check_tracing",
+                            lambda: {"ok": True, "cross_hops": 1})
+        monkeypatch.setattr(doctor, "check_elastic",
+                            lambda **kw: {"status": "ok",
+                                          "elapsed_s": 0.1,
+                                          "timeout_s": 120.0})
+        rep = doctor.report(timeout_s=5.0)
+        assert rep["tracing"] == {"ok": True, "cross_hops": 1}
+
+
 class TestResilienceCheck:
     def test_config_checks_without_probe(self, tmp_path, monkeypatch):
         monkeypatch.setenv("ESTORCH_CKPT_ROOT", str(tmp_path))

@@ -1,0 +1,746 @@
+"""Fleet-wide distributed tracing (estorch_tpu/obs/tracing.py +
+obs/agg/traces.py, docs/observability.md "Distributed tracing").
+
+Anchors: the tail sampler's keep/drop precedence, the per-process
+tracer's pending→verdict lifecycle (late hedge-loser segments follow
+the verdict), the atomic traces.jsonl flush, cross-process assembly
+with flow arrows, the collector's /traces landing (restart cursor
+reset, exemplar grafting onto stored snapshots), the store's exemplar
+window semantics across restart (a buried incarnation's trace ids must
+NOT resurrect), the dash's ``slowest`` column, and THE acceptance
+demo — a real hedged :class:`Router` over tracer-equipped stdlib toy
+replicas whose assembled trace shows BOTH upstream legs across three
+processes with the win attributed and the loser cancelled, plus
+``obs slow --store`` naming the worst trace from the store alone.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from estorch_tpu.obs.agg import dash
+from estorch_tpu.obs.agg import traces as traces_agg
+from estorch_tpu.obs.agg.collector import (Collector, Target,
+                                           append_segments,
+                                           trace_file_path, traces_url)
+from estorch_tpu.obs.agg.store import SeriesStore
+from estorch_tpu.obs.counters import Counters
+from estorch_tpu.obs.export.prometheus import render_exposition
+from estorch_tpu.obs.export.traceevent import validate_trace
+from estorch_tpu.obs.hist import Histogram, Histograms
+from estorch_tpu.obs.tracing import (PARENT_SPAN_HEADER, SAMPLED_HEADER,
+                                     TRACE_HEADER, TRACES_FILENAME,
+                                     ProcessTracer, TraceSampler,
+                                     head_sampled, make_segment,
+                                     read_segments, traces_payload,
+                                     valid_segment)
+from estorch_tpu.serve.router import Router
+
+
+def _seg(tid, span, parent, proc, name, ts, dur, **attrs):
+    s = make_segment(tid, span, parent, proc, name, ts, dur, attrs, ts=ts)
+    s["seq"] = 1
+    return s
+
+
+# =====================================================================
+# tail-based sampling
+# =====================================================================
+
+class TestTraceSampler:
+    def test_outcome_flags_always_keep(self):
+        s = TraceSampler(head_every=10 ** 9)
+        assert s.verdict("t", 0.01, error=True) == "error"
+        assert s.verdict("t", 0.01, shed=True) == "shed"
+        assert s.verdict("t", 0.01, retried=True) == "retry"
+        assert s.verdict("t", 0.01, hedged=True) == "hedge"
+        assert s.verdict("t", 0.01, breaker=True) == "breaker"
+        assert s.verdict("t", 0.01, forced=True) == "forced"
+
+    def test_forced_outranks_error(self):
+        s = TraceSampler(head_every=10 ** 9)
+        assert s.verdict("t", 0.01, forced=True, error=True) == "forced"
+
+    def test_head_sampling_is_deterministic_on_the_id(self):
+        # every process reaches the same verdict with no coordination
+        assert head_sampled("abc", 1)  # 1-in-1 keeps everything
+        for tid in ("a", "b", "c", "d"):
+            assert head_sampled(tid, 7) == head_sampled(tid, 7)
+
+    def test_p99_rule_arms_only_with_enough_samples(self):
+        hists = Histograms()
+        s = TraceSampler(hists=hists, hist_name="router/route_s",
+                         head_every=10 ** 9, p99_min_count=100)
+        # below min_count: disarmed, clean fast trace drops
+        for _ in range(50):
+            hists.observe("router/route_s", 0.010)
+        assert s.verdict("zz-no-head", 0.500) is None
+        for _ in range(100):
+            hists.observe("router/route_s", 0.010)
+        # armed: slower than the live p99 keeps, faster drops
+        assert s.verdict("zz-no-head", 0.500) == "p99"
+        assert s.verdict("zz-no-head", 0.001) is None
+
+
+# =====================================================================
+# per-process tracer lifecycle
+# =====================================================================
+
+class TestProcessTracer:
+    def test_kept_trace_gets_seq_and_sampling_reason_on_root(self):
+        c = Counters()
+        tr = ProcessTracer("router", counters=c, head_every=10 ** 9)
+        root = tr.span_id()
+        tr.add(make_segment("t1", root, None, "router", "route",
+                            0.0, 0.02))
+        tr.add(make_segment("t1", tr.span_id(), root, "router",
+                            "upstream", 0.0, 0.015))
+        assert tr.finish("t1", 0.02, error=True)
+        segs, cursor = tr.since(0)
+        assert len(segs) == 2 and cursor == 2
+        assert all(s["seq"] > 0 for s in segs)
+        roots = [s for s in segs if not s["parent_span_id"]]
+        assert [s["attrs"].get("sampled") for s in roots] == ["error"]
+        assert c.get("traces_sampled") == 1
+
+    def test_dropped_trace_leaves_nothing_and_counts(self):
+        c = Counters()
+        tr = ProcessTracer("router", counters=c, head_every=10 ** 9)
+        tr.add(make_segment("zz-no-head", tr.span_id(), None, "router",
+                            "route", 0.0, 0.001))
+        assert not tr.finish("zz-no-head", 0.001)
+        assert tr.since(0) == ([], 0)
+        assert c.get("traces_dropped") == 1
+
+    def test_late_segment_follows_the_verdict(self):
+        # a cancelled hedge loser's leg lands AFTER finish — it must
+        # join a kept trace, and stay dropped for a dropped one
+        tr = ProcessTracer("router", head_every=10 ** 9)
+        tr.add(make_segment("tk", "router.1", None, "router", "route",
+                            0.0, 0.02))
+        tr.finish("tk", 0.02, hedged=True)
+        tr.add(make_segment("tk", "router.2", "router.1", "router",
+                            "upstream", 0.0, 0.01, {"cancelled": True}))
+        segs, _ = tr.since(0)
+        assert {s["span_id"] for s in segs} == {"router.1", "router.2"}
+        tr.add(make_segment("zz-no-head", "router.3", None, "router",
+                            "route", 0.0, 0.001))
+        tr.finish("zz-no-head", 0.001)
+        tr.add(make_segment("zz-no-head", "router.4", "router.3",
+                            "router", "upstream", 0.0, 0.001))
+        segs, _ = tr.since(0)
+        assert not [s for s in segs if s["trace_id"] == "zz-no-head"]
+
+    def test_flush_is_atomic_append_and_caps_the_file(self, tmp_path):
+        path = str(tmp_path / "run" / TRACES_FILENAME)
+        tr = ProcessTracer("server", head_every=1, path=path,
+                           max_file_lines=5)
+        for i in range(8):
+            tr.add(make_segment(f"t{i}", tr.span_id(), None, "server",
+                                "request", 0.0, 0.01))
+            tr.finish(f"t{i}", 0.01)
+            assert tr.flush() == 1
+        assert tr.flush() == 0  # ring drained — nothing re-flushes
+        assert not os.path.exists(path + ".tmp")
+        rows = read_segments(path)
+        assert len(rows) == 5  # oldest lines evicted by the cap
+        assert rows[-1]["trace_id"] == "t7"
+
+    def test_since_cursor_and_restart_goes_backward(self, tmp_path):
+        tr = ProcessTracer("server", head_every=1)
+        for i in range(3):
+            tr.add(make_segment(f"t{i}", tr.span_id(), None, "server",
+                                "request", 0.0, 0.01))
+            tr.finish(f"t{i}", 0.01)
+        segs, cursor = tr.since(0)
+        assert len(segs) == 3 and cursor == 3
+        segs2, cursor2 = tr.since(cursor)
+        assert segs2 == [] and cursor2 == 3
+        # a restarted process starts seq over: its cursor is SMALLER
+        # than the collector's high-water mark — the reset signal
+        fresh = ProcessTracer("server", head_every=1)
+        _, fresh_cursor = fresh.since(0)
+        assert fresh_cursor < cursor
+
+    def test_traces_payload_carries_exemplars(self):
+        hists = Histograms()
+        hists.observe("serve/request_s", 0.5, exemplar="t-slow")
+        tr = ProcessTracer("server", head_every=1)
+        tr.add(make_segment("t-slow", tr.span_id(), None, "server",
+                            "request", 0.0, 0.5))
+        tr.finish("t-slow", 0.5)
+        p = traces_payload(tr, 0, hists=hists)
+        assert p["proc"] == "server" and p["cursor"] == 1
+        assert [s["trace_id"] for s in p["segments"]] == ["t-slow"]
+        ex = p["exemplars"]["serve/request_s"]
+        assert ["t-slow"] in [ids for ids in ex.values()]
+        # tracer-less process still answers the scrape shape
+        empty = traces_payload(None, 7)
+        assert empty == {"proc": None, "segments": [], "cursor": 7,
+                         "exemplars": {}}
+
+
+# =====================================================================
+# segment schema / file IO
+# =====================================================================
+
+class TestSegmentIO:
+    def test_valid_segment_rejects_malformed_rows(self):
+        good = make_segment("t", "s", None, "p", "n", 0.0, 0.1)
+        assert valid_segment(good)
+        assert not valid_segment("nope")
+        assert not valid_segment({**good, "trace_id": ""})
+        assert not valid_segment({**good, "dur_s": "fast"})
+        assert not valid_segment({**good, "ts": True})
+
+    def test_read_segments_tolerates_torn_tail(self, tmp_path):
+        path = tmp_path / TRACES_FILENAME
+        rows = [make_segment("t", f"s{i}", None, "p", "n", 0.0, 0.1)
+                for i in range(2)]
+        path.write_text("\n".join(json.dumps(r) for r in rows)
+                        + '\nnot json\n{"trace_id": "torn", "sp')
+        got = read_segments(str(path))
+        assert [r["span_id"] for r in got] == ["s0", "s1"]
+        assert read_segments(str(tmp_path / "absent.jsonl")) == []
+
+
+# =====================================================================
+# assembly + export
+# =====================================================================
+
+class TestAssembly:
+    def _fleet(self):
+        return [
+            _seg("t", "router.1", None, "router", "route", 10.0, 0.08,
+                 sampled="retry"),
+            _seg("t", "router.2", "router.1", "router", "upstream",
+                 10.001, 0.07, replica="r0", status=200),
+            _seg("t", "server.1", "router.2", "server", "request",
+                 10.004, 0.06, status=200),
+            _seg("t", "server.2", "server.1", "server", "compute",
+                 10.01, 0.04),
+        ]
+
+    def test_assemble_orders_and_unions_wall_clock(self):
+        asm = traces_agg.assemble(self._fleet())
+        t = asm["t"]
+        assert t["procs"] == ["router", "server"]
+        assert [s["span_id"] for s in t["segments"]] == [
+            "router.1", "router.2", "server.1", "server.2"]
+        assert t["t0"] == 10.0
+        assert t["dur_s"] == pytest.approx(0.08)
+        assert t["sampled"] == "retry"
+
+    def test_cross_process_edges_only_cross_hops(self):
+        t = traces_agg.assemble(self._fleet())["t"]
+        edges = traces_agg.cross_process_edges(t)
+        assert [(p["span_id"], c["span_id"]) for p, c in edges] == [
+            ("router.2", "server.1")]
+
+    def test_export_validates_with_lanes_and_flows(self):
+        t = traces_agg.assemble(self._fleet())["t"]
+        trace = traces_agg.export_fleet_trace([t], files=1)
+        assert validate_trace(trace) == []
+        pids = {e["pid"] for e in trace["traceEvents"]
+                if e["ph"] == "X"}
+        assert len(pids) == 2  # one lane per process
+        flows = [e for e in trace["traceEvents"]
+                 if e["ph"] in ("s", "f")]
+        assert len(flows) == 2  # the one cross-process edge
+
+    def test_format_trace_names_the_hops(self):
+        t = traces_agg.assemble(self._fleet())["t"]
+        text = traces_agg.format_trace(t)
+        assert "sampled=retry" in text
+        assert "replica=r0" in text
+        assert "compute" in text
+
+    def test_trace_files_discovers_fleet_layout_deduped(self, tmp_path):
+        row = json.dumps(_seg("t", "s", None, "p", "n", 0.0, 0.1))
+        (tmp_path / "router").mkdir()
+        (tmp_path / "r0").mkdir()
+        (tmp_path / "router" / TRACES_FILENAME).write_text(row + "\n")
+        (tmp_path / "r0" / TRACES_FILENAME).write_text(row + "\n")
+        (tmp_path / "traces-r0.jsonl").write_text(row + "\n")
+        (tmp_path / "notes.txt").write_text("not a segment file\n")
+        files = traces_agg.trace_files([str(tmp_path), str(tmp_path)])
+        assert len(files) == 3  # same dir twice must not double spans
+        # scraped + fleet copies of the same span dedup on load
+        assert len(traces_agg.load_segments(files)) == 1
+
+
+class TestTraceCLI:
+    def test_fleet_assembles_and_writes_perfetto(self, tmp_path, capsys):
+        d = tmp_path / "router"
+        d.mkdir()
+        with open(d / TRACES_FILENAME, "w") as f:
+            for s in TestAssembly()._fleet():
+                f.write(json.dumps(s) + "\n")
+        rc = traces_agg.main(["--fleet", str(tmp_path), "--print"])
+        assert rc == 0
+        out_path = tmp_path / "fleet_trace.json"
+        assert out_path.exists()
+        assert validate_trace(json.loads(out_path.read_text())) == []
+        out = capsys.readouterr().out
+        assert "1 trace" in out or "trace" in out
+
+    def test_needs_exactly_one_source(self, tmp_path):
+        assert traces_agg.main([]) == 3
+        assert traces_agg.main(["--fleet", str(tmp_path), "--store",
+                                str(tmp_path)]) == 3
+
+    def test_empty_dir_is_rc2(self, tmp_path):
+        assert traces_agg.main(["--fleet", str(tmp_path)]) == 2
+
+    def test_slow_rejects_silly_quantile(self, tmp_path):
+        assert traces_agg.main_slow(["--store", str(tmp_path),
+                                     "--quantile", "1.5"]) == 3
+
+    def test_module_cli_routes_trace_and_slow(self, tmp_path):
+        from estorch_tpu.obs.__main__ import main as obs_main
+
+        assert obs_main(["trace", "--fleet", "--selfcheck"]) == 0
+        assert obs_main(["slow", "--store", str(tmp_path)]) == 1
+
+
+# =====================================================================
+# collector: /traces landing
+# =====================================================================
+
+class TestCollectorTraceLanding:
+    def test_append_segments_caps_and_skips_invalid(self, tmp_path):
+        path = trace_file_path(str(tmp_path), "serve a/b")
+        assert os.path.basename(path) == "traces-serve_a_b.jsonl"
+        good = [make_segment(f"t{i}", "s", None, "p", "n", 0.0, 0.1)
+                for i in range(3)]
+        # invalid rows are skipped (return counts VALID rows landed);
+        # the file itself keeps only the newest max_lines
+        assert append_segments(path, good + ["junk", {"no": "keys"}],
+                               max_lines=2) == 3
+        rows = read_segments(path)
+        assert [r["trace_id"] for r in rows] == ["t1", "t2"]
+        assert append_segments(path, ["junk"]) == 0
+
+    def test_traces_url_swaps_the_path(self):
+        assert traces_url("http://127.0.0.1:9000/metrics") == \
+            "http://127.0.0.1:9000/traces"
+
+    def _collector(self, tmp_path):
+        store = SeriesStore(str(tmp_path / "store"))
+        t = Target("s1", url="http://127.0.0.1:1/metrics")
+        return Collector([t], store, serve_http=False), t, store
+
+    def test_land_traces_grafts_exemplars_and_advances_cursor(
+            self, tmp_path):
+        col, t, store = self._collector(tmp_path)
+        state = col._states["s1"]
+        h = Histogram()
+        h.observe(0.5)
+        sample = {"name": "estorch_serve_request_s",
+                  "labels": {"target": "s1"}, "hist": h.to_dict()}
+        r = {"samples": [sample], "error": None, "trace_error": None,
+             "traces": {"proc": "server", "cursor": 4,
+                        "segments": [make_segment("t-slow", "s", None,
+                                                  "server", "request",
+                                                  0.0, 0.5)],
+                        "exemplars": {"serve/request_s":
+                                      {"7": ["t-slow"]}}}}
+        assert col._land_traces(t, state, r) == 1
+        assert state.trace_cursor == 4
+        assert col.counters["agg_trace_segments_total"] == 1
+        # exemplars grafted onto THIS tick's snapshot (Prometheus text
+        # cannot carry them), keyed by the prometheus metric name
+        assert sample["hist"]["exemplars"] == {"7": ["t-slow"]}
+        assert read_segments(
+            trace_file_path(store.root, "s1"))[0]["trace_id"] == "t-slow"
+
+    def test_backward_cursor_means_restart_and_resets(self, tmp_path):
+        col, t, state_store = self._collector(tmp_path)
+        state = col._states["s1"]
+        state.trace_cursor = 40
+        r = {"samples": [], "error": None, "trace_error": None,
+             "traces": {"proc": "server", "cursor": 2, "segments": [],
+                        "exemplars": {}}}
+        col._land_traces(t, state, r)
+        assert state.trace_cursor == 0  # next tick re-reads the window
+
+    def test_trace_scrape_error_counts_not_raises(self, tmp_path):
+        col, t, _ = self._collector(tmp_path)
+        r = {"samples": [], "error": None,
+             "trace_error": "URLError: refused", "traces": None}
+        assert col._land_traces(t, col._states["s1"], r) == 0
+        assert col.counters["agg_trace_scrape_errors_total"] == 1
+
+    def test_tick_scrapes_metrics_and_traces_together(self, tmp_path):
+        hists = Histograms()
+        tracer = ProcessTracer("server", hists=hists, head_every=1)
+
+        class FakeTarget(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if self.path.startswith("/traces"):
+                    since = int(self.path.split("since=")[-1]) \
+                        if "since=" in self.path else 0
+                    body = json.dumps(traces_payload(
+                        tracer, since, hists=hists)).encode()
+                    ctype = "application/json"
+                else:
+                    body = render_exposition(
+                        {"requests_total": 1}, None, up=True,
+                        histograms=hists.export()).encode()
+                    ctype = "text/plain; version=0.0.4"
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), FakeTarget)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            hists.observe("serve/request_s", 0.5, exemplar="t-slow")
+            tracer.add(make_segment("t-slow", tracer.span_id(), None,
+                                    "server", "request", 0.0, 0.5))
+            tracer.finish("t-slow", 0.5)
+            store = SeriesStore(str(tmp_path / "store"))
+            target = Target(
+                "s1",
+                url=f"http://127.0.0.1:{srv.server_address[1]}/metrics")
+            col = Collector([target], store, serve_http=False)
+            first = col.tick(now=1000.0)
+            assert first["targets"]["s1"]["ok"]
+            assert first["targets"]["s1"]["segments"] == 1
+            # cursor advanced: an idle second tick lands nothing new
+            second = col.tick(now=1001.0)
+            assert second["targets"]["s1"]["segments"] == 0
+            assert col.counters["agg_trace_segments_total"] == 1
+            # the landed exemplar is queryable from the STORE alone
+            h = store.hist_window("estorch_serve_request_s",
+                                  {"target": "s1"}, window_s=60,
+                                  now=1001.0)
+            assert h is not None and h.slow_exemplars(0.5) == ["t-slow"]
+            got = traces_agg.load_segments(
+                traces_agg.store_trace_files(store.root))
+            assert [s["trace_id"] for s in got] == ["t-slow"]
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+
+# =====================================================================
+# store exemplar windows
+# =====================================================================
+
+class TestStoreExemplars:
+    def _snap(self, h):
+        return {"name": "estorch_serve_request_s",
+                "labels": {"target": "a"}, "hist": h.to_dict()}
+
+    def test_window_keeps_only_positive_delta_buckets(self, tmp_path):
+        s = SeriesStore(str(tmp_path / "store"))
+        h = Histogram()
+        h.observe(0.5, exemplar="t-old")
+        s.append([self._snap(h)], ts=1000.0)
+        h.observe(0.004, exemplar="t-new")
+        s.append([self._snap(h)], ts=1500.0)
+        # the window [1400, 1500] saw only the fast bucket grow — the
+        # slow bucket's old exemplar must not be attributed to it
+        w = s.hist_window("estorch_serve_request_s", {"target": "a"},
+                          window_s=100, now=1500.0)
+        assert w.count == 1
+        assert w.slow_exemplars(0.5) == ["t-new"]
+
+    def test_restart_buries_pre_restart_exemplars(self, tmp_path):
+        # exemplar trace ids from a dead incarnation name traces nobody
+        # can assemble — the recent window must not resurrect them
+        s = SeriesStore(str(tmp_path / "store"))
+        h1 = Histogram()
+        for _ in range(10):
+            h1.observe(0.5, exemplar="t-dead")
+        s.append([self._snap(h1)], ts=1000.0)
+        h2 = Histogram()  # restarted process: fresh histogram
+        h2.observe(0.3, exemplar="t-live")
+        s.append([self._snap(h2)], ts=1001.0)
+        w = s.hist_window("estorch_serve_request_s", {"target": "a"},
+                          window_s=60, now=1001.0)
+        assert w.count == 11  # buried counts still fold in…
+        ids = w.slow_exemplars(0.0)
+        assert "t-live" in ids and "t-dead" not in ids  # …ids do not
+
+    def test_exemplars_survive_segment_roll(self, tmp_path):
+        s = SeriesStore(str(tmp_path / "store"), max_segments=3,
+                        segment_max_samples=2)
+        h = Histogram()
+        for i in range(8):
+            h.observe(0.5, exemplar=f"t{i}")
+            s.append([self._snap(h)], ts=1000.0 + i)
+        w = s.hist_window("estorch_serve_request_s", {"target": "a"},
+                          window_s=3, now=1007.0)
+        assert w is not None and "t7" in w.slow_exemplars(0.5)
+
+
+# =====================================================================
+# dash: the `slowest` column
+# =====================================================================
+
+class TestDashSlowest:
+    def _store_with(self, tmp_path, exemplar):
+        s = SeriesStore(str(tmp_path / "store"))
+        h = Histogram()
+        h.observe(0.5, exemplar=exemplar)
+        s.append([{"name": "estorch_up", "labels": {"target": "a"},
+                   "value": 1},
+                  {"name": dash.REQUEST_HIST, "labels": {"target": "a"},
+                   "hist": h.to_dict()}], ts=1000.0)
+        return str(tmp_path / "store")
+
+    def test_snapshot_names_the_worst_trace(self, tmp_path):
+        root = self._store_with(tmp_path, "t-worst")
+        snap = dash.fleet_snapshot(root, window_s=60, now=1000.0)
+        assert snap["targets"][0]["slowest_trace"] == "t-worst"
+        text = dash.render(root, window_s=60, now=1000.0)
+        assert "slowest" in text and "t-worst" in text
+
+    def test_exemplar_less_target_renders_dash(self, tmp_path):
+        root = self._store_with(tmp_path, None)  # tracing off upstream
+        snap = dash.fleet_snapshot(root, window_s=60, now=1000.0)
+        assert snap["targets"][0]["slowest_trace"] is None
+        row = dash.render(root, window_s=60,
+                          now=1000.0).splitlines()[-1]
+        assert " - " in row  # honest '-', not a fabricated id
+
+
+# =====================================================================
+# obs slow: worst traces from the store alone
+# =====================================================================
+
+class TestSlowFromStore:
+    def test_join_exemplars_to_scraped_segments(self, tmp_path, capsys):
+        root = str(tmp_path / "store")
+        s = SeriesStore(root)
+        h = Histogram()
+        for _ in range(200):
+            h.observe(0.010)
+        h.observe(0.800, exemplar="t-tail")
+        s.append([{"name": "estorch_serve_request_s",
+                   "labels": {"target": "s1"}, "hist": h.to_dict()}],
+                 ts=1000.0)
+        segs = [_seg("t-tail", "router.1", None, "router", "route",
+                     999.0, 0.80, sampled="p99"),
+                _seg("t-tail", "server.1", "router.1", "server",
+                     "request", 999.1, 0.78, status=200)]
+        append_segments(trace_file_path(root, "s1"), segs)
+        res = traces_agg.slowest_traces(root, quantile=0.99,
+                                        window_s=3600.0)
+        assert res["metric"] == "estorch_serve_request_s"
+        assert res["ids"] == ["t-tail"]
+        assert [t["trace_id"] for t in res["traces"]] == ["t-tail"]
+        assert res["traces"][0]["procs"] == ["router", "server"]
+        assert res["missing"] == []
+        assert traces_agg.main_slow(["--store", root, "--window",
+                                     "3600"]) == 0
+        out = capsys.readouterr().out
+        assert "t-tail" in out and "server" in out
+
+    def test_exemplar_without_segments_reports_missing(self, tmp_path):
+        root = str(tmp_path / "store")
+        s = SeriesStore(root)
+        h = Histogram()
+        h.observe(0.5, exemplar="t-gone")
+        s.append([{"name": "estorch_serve_request_s",
+                   "labels": {"target": "s1"}, "hist": h.to_dict()}],
+                 ts=1000.0)
+        res = traces_agg.slowest_traces(root, window_s=3600.0)
+        assert res["ids"] == ["t-gone"] and res["missing"] == ["t-gone"]
+        assert res["traces"] == []
+
+    def test_empty_store_answers_honestly(self, tmp_path):
+        res = traces_agg.slowest_traces(str(tmp_path))
+        assert res["metric"] is None and res["traces"] == []
+
+
+# =====================================================================
+# loadgen: the measurement-file join key
+# =====================================================================
+
+class TestLoadgenTraceIds:
+    def test_latency_rows_carry_the_join_key(self, tmp_path):
+        from estorch_tpu.serve.loadgen import write_latency_rows
+
+        path = write_latency_rows([0.01, 0.02, 0.03],
+                                  str(tmp_path / "lat.jsonl"),
+                                  trace_ids=["t-a", "", "t-c"])
+        rows = [json.loads(ln)
+                for ln in open(path).read().splitlines()]
+        assert [r.get("trace_id") for r in rows] == ["t-a", None, "t-c"]
+        assert all(r["endpoint"] == "/predict" for r in rows)
+        # rows without trace ids keep the legacy shape exactly
+        legacy = write_latency_rows([0.01], str(tmp_path / "l2.jsonl"))
+        assert json.loads(open(legacy).read()) == {
+            "endpoint": "/predict", "latency_s": 0.01}
+
+
+# =====================================================================
+# acceptance: a real hedged router's trace assembles across processes
+# =====================================================================
+
+def _traced_toy_replica(proc, run_dir, *, delay_s=0.0):
+    os.makedirs(run_dir, exist_ok=True)
+    tracer = ProcessTracer(proc, head_every=1,
+                           path=os.path.join(run_dir, TRACES_FILENAME))
+    state = {"requests": 0}
+
+    class Toy(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def _j(self, obj):
+            body = json.dumps(obj).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._j({"ok": True, "draining": False,
+                         "queue_depth": 0})
+            else:
+                self._j({"queue_depth": 0, "request_ms": {"p99": 1.0}})
+
+        def do_POST(self):
+            t0 = time.monotonic()
+            trace = self.headers.get(TRACE_HEADER)
+            parent = self.headers.get(PARENT_SPAN_HEADER) or None
+            forced = self.headers.get(SAMPLED_HEADER) == "1"
+            n = int(self.headers.get("Content-Length", 0))
+            data = json.loads(self.rfile.read(n))
+            state["requests"] += 1
+            if delay_s:
+                time.sleep(delay_s)
+            # record BEFORE replying: a cancelled hedge loser's client
+            # is gone, but its segment must still join the trace
+            if trace:
+                dt = time.monotonic() - t0
+                tracer.add(make_segment(trace, tracer.span_id(), parent,
+                                        proc, "request", t0, dt,
+                                        {"status": 200}))
+                tracer.finish(trace, dt, forced=forced)
+            self._j({"action": [v * 2.0 for v in data["obs"]]})
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Toy)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, tracer, state
+
+
+class TestHedgedTraceAcceptance:
+    def test_hedged_trace_assembles_across_three_processes(
+            self, tmp_path):
+        slow_srv, slow_tr, _ = _traced_toy_replica(
+            "replica-slow", str(tmp_path / "r0"), delay_s=0.4)
+        fast_srv, fast_tr, _ = _traced_toy_replica(
+            "replica-fast", str(tmp_path / "r1"))
+        # upstream_timeout_s < the stall: the abandoned loser leg is
+        # GUARANTEED to end in an error while its cancel flag is set,
+        # so the leg records ``cancelled`` deterministically
+        router = Router(
+            [("r-slow", f"127.0.0.1:{slow_srv.server_address[1]}"),
+             ("r-fast", f"127.0.0.1:{fast_srv.server_address[1]}")],
+            port=0, poll_interval_s=30.0, upstream_timeout_s=0.25,
+            hedge=True, hedge_min_ms=60.0,
+            run_dir=str(tmp_path / "router"))
+        router.start_background()
+        try:
+            time.sleep(0.3)
+            url = f"http://{router.host}:{router.port}/predict"
+            for i in range(8):  # rr tiebreak: some start on the stall
+                req = urllib.request.Request(
+                    url, json.dumps({"obs": [float(i)]}).encode(),
+                    {"Content-Type": "application/json",
+                     TRACE_HEADER: f"t-e2e-{i}", SAMPLED_HEADER: "1"})
+                with urllib.request.urlopen(req, timeout=15) as r:
+                    assert json.loads(r.read())["action"] == [2.0 * i]
+                    assert r.headers.get(TRACE_HEADER) == f"t-e2e-{i}"
+            assert router.counters.get("router_hedged_total") >= 1
+            time.sleep(0.8)  # let cancelled losers finish server-side
+        finally:
+            router.shutdown(drain=False)
+            for s in (slow_srv, fast_srv):
+                s.shutdown(), s.server_close()
+        slow_tr.flush(), fast_tr.flush()
+
+        files = traces_agg.trace_files([str(tmp_path)])
+        assembled = traces_agg.assemble(traces_agg.load_segments(files))
+        hedged = [t for t in assembled.values()
+                  if len([s for s in t["segments"]
+                          if s["name"] == "upstream"]) == 2]
+        assert hedged, "no assembled trace carries both hedge legs"
+        t = max(hedged, key=lambda t: len(t["procs"]))
+        legs = [s for s in t["segments"] if s["name"] == "upstream"]
+        cancelled = [s for s in legs if s["attrs"].get("cancelled")]
+        winners = [s for s in legs if s["attrs"].get("status") == 200]
+        assert len(cancelled) == 1 and len(winners) == 1
+        assert winners[0]["attrs"].get("replica") == "r-fast"
+        assert cancelled[0]["attrs"].get("replica") == "r-slow"
+        # the trace spans all three processes: the router, the winner,
+        # and the loser (whose late segment joins via the verdict cache)
+        assert t["procs"][0] == "router"
+        assert set(t["procs"]) == {"router", "replica-fast",
+                                   "replica-slow"}
+        assert traces_agg.cross_process_edges(t)
+        trace = traces_agg.export_fleet_trace([t], files=len(files))
+        assert validate_trace(trace) == []
+
+
+class TestCancelRaceMapsToUpstreamError:
+    def test_cancel_mid_read_records_cancelled_leg(self, monkeypatch):
+        """A hedge cancel races the loser's ``resp.read()``: http.client
+        can surface the concurrent close as errors outside the usual
+        (TimeoutError, OSError, HTTPException) tuple — seen live as
+        ``AttributeError: 'NoneType' object has no attribute 'close'``
+        from a half-torn response.  With the cancel flag set that must
+        take the normal failed-attempt path (loser leg recorded with
+        ``cancelled``, breaker untouched), not kill the leg thread."""
+        import http.client as _hc
+
+        from estorch_tpu.serve.router import UpstreamError
+
+        class TornConn:
+            def __init__(self, *a, **kw):
+                pass
+
+            def request(self, *a, **kw):
+                pass
+
+            def getresponse(self):
+                raise AttributeError(
+                    "'NoneType' object has no attribute 'close'")
+
+            def close(self):
+                pass
+
+        monkeypatch.setattr(_hc, "HTTPConnection", TornConn)
+        router = Router([("r0", "127.0.0.1:1")], port=0,
+                        serve_http=False, poll_interval_s=30.0)
+        rep = router.replicas()[0]
+        with pytest.raises(UpstreamError, match="cancelled mid-read"):
+            router._attempt(rep, b"{}", "t-race",
+                            cancel_box={"cancelled": True}, hedge=True)
+        pend = router.tracer._pending.get("t-race", [])
+        legs = [s for s in pend if s["name"] == "upstream"]
+        assert len(legs) == 1 and legs[0]["attrs"]["cancelled"] is True
+        assert rep.failures == 0 and rep.breaker.allow()
+        # the SAME torn read without a cancel is NOT ours to absorb
+        with pytest.raises(AttributeError):
+            router._attempt(rep, b"{}", "t-race2")
